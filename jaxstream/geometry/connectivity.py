@@ -1,0 +1,170 @@
+"""Cubed-sphere panel connectivity, generated — not hard-coded.
+
+The reference hard-codes its 12-edge / 4-stage communication schedule as a
+literal table (``/root/reference/JAX-DevLab-Examples.py:105-139``, deck p.9)
+and leaves the boundary extract/insert helpers undefined.  Here the
+adjacency is *derived numerically* from the face maps in
+:mod:`jaxstream.geometry.cubed_sphere` (matching edge points in 3-D), so it
+is correct by construction for our face layout, and the race-free stage
+schedule is produced by a proper edge-coloring of the face-adjacency graph
+(the octahedron graph, chromatic index 4) — the deck's "scalable edge
+coloring algorithm" (p.9) made real.
+
+Invariants (tested in ``tests/test_connectivity.py``, mirroring the
+reference's verified properties, SURVEY.md §2.5):
+  * every face has exactly 4 neighbors, each edge matched exactly once;
+  * antipodal face pairs never exchange;
+  * the schedule has 4 stages, each a perfect matching on the 6 faces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+from .cubed_sphere import NUM_FACES, face_points
+
+__all__ = [
+    "EDGE_S",
+    "EDGE_E",
+    "EDGE_N",
+    "EDGE_W",
+    "EdgeLink",
+    "build_connectivity",
+    "edge_pairs",
+    "build_schedule",
+]
+
+# Edge ids: S = beta min, E = alpha max, N = beta max, W = alpha min.
+EDGE_S, EDGE_E, EDGE_N, EDGE_W = 0, 1, 2, 3
+EDGE_NAMES = ("S", "E", "N", "W")
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeLink:
+    """Face ``face``'s edge ``edge`` abuts ``nbr_face``'s edge ``nbr_edge``.
+
+    ``reversed_`` is True when the along-edge index runs in opposite
+    directions on the two faces (the reference's "R"-type orientation ops;
+    its "T" op is the depth/along-edge transpose handled by the canonical
+    strip frame in :mod:`jaxstream.parallel.halo`).
+    """
+
+    face: int
+    edge: int
+    nbr_face: int
+    nbr_edge: int
+    reversed_: bool
+
+
+def _edge_coords(edge: int, t: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(alpha, beta) along an edge at parameter t in [0, 1].
+
+    The along-edge parameter increases with alpha (S/N edges) or with beta
+    (E/W edges) — the canonical along-edge direction used everywhere.
+    """
+    q = np.pi / 4
+    s = -q + t * (2 * q)
+    if edge == EDGE_S:
+        return s, np.full_like(s, -q)
+    if edge == EDGE_N:
+        return s, np.full_like(s, q)
+    if edge == EDGE_W:
+        return np.full_like(s, -q), s
+    if edge == EDGE_E:
+        return np.full_like(s, q), s
+    raise ValueError(edge)
+
+
+def build_connectivity() -> List[List[EdgeLink]]:
+    """adj[face][edge] -> EdgeLink, derived by matching 3-D edge points."""
+    # Symmetric under t -> 1-t (so a reversed edge matches pointwise after
+    # flipping), but not constant spacing collapse: ordering detects reversal.
+    t = np.array([0.1, 0.3, 0.5, 0.7, 0.9])
+    pts = {}
+    for f in range(NUM_FACES):
+        for e in range(4):
+            a, b = _edge_coords(e, t)
+            pts[(f, e)] = face_points(f, a, b)
+
+    adj: List[List[EdgeLink]] = [[None] * 4 for _ in range(NUM_FACES)]  # type: ignore
+    for f in range(NUM_FACES):
+        for e in range(4):
+            found = None
+            for g in range(NUM_FACES):
+                if g == f:
+                    continue
+                for e2 in range(4):
+                    p, q = pts[(f, e)], pts[(g, e2)]
+                    if np.allclose(p, q, atol=1e-12):
+                        found = (g, e2, False)
+                    elif np.allclose(p, q[::-1], atol=1e-12):
+                        found = (g, e2, True)
+                    if found:
+                        break
+                if found:
+                    break
+            if found is None:
+                raise RuntimeError(f"no neighbor found for face {f} edge {e}")
+            adj[f][e] = EdgeLink(f, e, *found)
+    # Symmetry check: the link back must exist and agree on reversal.
+    for f in range(NUM_FACES):
+        for e in range(4):
+            l = adj[f][e]
+            back = adj[l.nbr_face][l.nbr_edge]
+            assert back.nbr_face == f and back.nbr_edge == e
+            assert back.reversed_ == l.reversed_
+    return adj
+
+
+def edge_pairs(adj=None) -> List[Tuple[EdgeLink, EdgeLink]]:
+    """The 12 undirected cube edges as (link, backlink) pairs."""
+    adj = adj or build_connectivity()
+    seen = set()
+    pairs = []
+    for f in range(NUM_FACES):
+        for e in range(4):
+            l = adj[f][e]
+            key = tuple(sorted([(f, e), (l.nbr_face, l.nbr_edge)]))
+            if key in seen:
+                continue
+            seen.add(key)
+            pairs.append((l, adj[l.nbr_face][l.nbr_edge]))
+    assert len(pairs) == 12
+    return pairs
+
+
+def build_schedule(adj=None, num_stages: int = 4) -> List[List[Tuple[EdgeLink, EdgeLink]]]:
+    """Proper edge-coloring of the 12 cube edges into race-free stages.
+
+    Each stage is a perfect matching on the 6 faces: no face (hence no
+    device, at <=1 face/device) is touched twice within a stage — the
+    reference's deadlock/race-avoidance invariant (deck p.9).  Backtracking
+    search; the octahedron graph has chromatic index 4 so 4 stages always
+    succeed.
+    """
+    pairs = edge_pairs(adj)
+
+    stages: List[List[Tuple[EdgeLink, EdgeLink]]] = [[] for _ in range(num_stages)]
+    busy = [set() for _ in range(num_stages)]
+
+    def place(i: int) -> bool:
+        if i == len(pairs):
+            return True
+        l, _ = pairs[i]
+        for s in range(num_stages):
+            if l.face in busy[s] or l.nbr_face in busy[s]:
+                continue
+            busy[s].update((l.face, l.nbr_face))
+            stages[s].append(pairs[i])
+            if place(i + 1):
+                return True
+            busy[s].difference_update((l.face, l.nbr_face))
+            stages[s].pop()
+        return False
+
+    if not place(0):
+        raise RuntimeError(f"edge coloring with {num_stages} stages failed")
+    return stages

@@ -1,0 +1,250 @@
+"""Equiangular (gnomonic) cubed-sphere geometry.
+
+TPU-native re-design of the reference's "Geometry (Math/Mesh)" layer
+(reference: Sharding-the-Sphere deck p.4 "Cube Sphere Dual Quadrilateral
+Mesh", p.6 pipeline; /root/reference/JAX-DevLab-Examples.py implies a
+``(6, N+2, N+2)`` ghosted field layout at :141).  The reference never ships
+geometry code, so everything here is derived from first principles for the
+equiangular gnomonic projection.
+
+Design notes (TPU-first):
+  * All metric terms are precomputed once in float64 NumPy at setup and cast
+    to the run dtype (bfloat16/float32) as JAX arrays — nothing here runs in
+    the hot loop.
+  * Fields are laid out ``(6, M, M)`` with ``M = N + 2*halo`` so the
+    last-two axes map onto the TPU (sublane, lane) = (8, 128) register
+    tiling, and the panel axis (and optionally x/y block axes) map onto the
+    device mesh.
+  * Metric terms are evaluated on the *extended* (halo-included) grid: the
+    equiangular map extends analytically past ±pi/4, so ghost cells own
+    well-defined local coordinates and dual bases.  This is what lets panel
+    -edge fluxes be computed entirely in panel-local coordinates while
+    velocity is carried as a Cartesian 3-vector (the reference's
+    "Cartesian Velocity Exchange", deck p.18).
+
+Face layout convention (ours; the reference's is not published):
+  faces 0..3 are equatorial at longitudes 0, 90, 180, 270 degrees;
+  face 4 is the north cap, face 5 the south cap.  Each face map
+  ``P(X, Y) = c0 + cx*X + cy*Y`` (then normalized) is right-handed:
+  ``cx × cy = c0`` (outward normal), with ``X = tan(alpha)``,
+  ``Y = tan(beta)``, ``alpha, beta ∈ [-pi/4, pi/4]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "FACE_AXES",
+    "NUM_FACES",
+    "face_points",
+    "CubedSphereGrid",
+    "build_grid",
+]
+
+NUM_FACES = 6
+
+# (c0, cx, cy) per face; P = c0 + cx*X + cy*Y, right-handed: cx x cy = c0.
+FACE_AXES = np.array(
+    [
+        [[1, 0, 0], [0, 1, 0], [0, 0, 1]],    # 0: +x, lon 0
+        [[0, 1, 0], [-1, 0, 0], [0, 0, 1]],   # 1: +y, lon 90E
+        [[-1, 0, 0], [0, -1, 0], [0, 0, 1]],  # 2: -x, lon 180
+        [[0, -1, 0], [1, 0, 0], [0, 0, 1]],   # 3: -y, lon 270E
+        [[0, 0, 1], [0, 1, 0], [-1, 0, 0]],   # 4: +z, north
+        [[0, 0, -1], [0, 1, 0], [1, 0, 0]],   # 5: -z, south
+    ],
+    dtype=np.float64,
+)
+
+
+def face_points(face: int, alpha: np.ndarray, beta: np.ndarray) -> np.ndarray:
+    """Unit-sphere Cartesian points for equiangular coords on one face.
+
+    ``alpha``/``beta`` broadcast together; returns shape ``(..., 3)``.
+    """
+    c0, cx, cy = FACE_AXES[face]
+    x = np.tan(np.asarray(alpha, dtype=np.float64))
+    y = np.tan(np.asarray(beta, dtype=np.float64))
+    p = (
+        c0[(None,) * x.ndim]
+        + x[..., None] * cx[(None,) * x.ndim]
+        + y[..., None] * cy[(None,) * y.ndim]
+    )
+    return p / np.linalg.norm(p, axis=-1, keepdims=True)
+
+
+def _basis_and_metric(face: int, alpha: np.ndarray, beta: np.ndarray, radius: float):
+    """Covariant/dual bases + metric at given equiangular coords (float64).
+
+    Returns dict of arrays with trailing vector axis where applicable:
+      r (..,3) position on sphere of given radius,
+      e_a, e_b (..,3) covariant basis d r/d alpha, d r/d beta,
+      a_a, a_b (..,3) dual basis (a^i . e_j = delta_ij, tangent),
+      sqrtg (..,)   = |e_a x e_b . rhat| (area element factor),
+      khat (..,3)  outward radial unit vector.
+    """
+    c0, cx, cy = FACE_AXES[face]
+    alpha = np.asarray(alpha, dtype=np.float64)
+    beta = np.asarray(beta, dtype=np.float64)
+    x = np.tan(alpha)
+    y = np.tan(beta)
+    shp = np.broadcast_shapes(x.shape, y.shape)
+    x = np.broadcast_to(x, shp)
+    y = np.broadcast_to(y, shp)
+    p = c0 + x[..., None] * cx + y[..., None] * cy
+    rho = np.linalg.norm(p, axis=-1, keepdims=True)
+    rhat = p / rho
+    r = radius * rhat
+
+    # dP/dX = cx, dP/dY = cy; d rhat/dX = (cx - rhat (rhat.cx)) / rho, etc.
+    dx_da = 1.0 + x * x  # d tan(alpha)/d alpha
+    dy_db = 1.0 + y * y
+    pc_x = np.sum(rhat * cx, axis=-1, keepdims=True)
+    pc_y = np.sum(rhat * cy, axis=-1, keepdims=True)
+    e_a = radius * dx_da[..., None] * (cx - rhat * pc_x) / rho
+    e_b = radius * dy_db[..., None] * (cy - rhat * pc_y) / rho
+
+    # 2x2 metric and inverse.
+    gaa = np.sum(e_a * e_a, axis=-1)
+    gab = np.sum(e_a * e_b, axis=-1)
+    gbb = np.sum(e_b * e_b, axis=-1)
+    det = gaa * gbb - gab * gab
+    sqrtg = np.sqrt(det)
+    inv_aa = gbb / det
+    inv_ab = -gab / det
+    inv_bb = gaa / det
+    a_a = inv_aa[..., None] * e_a + inv_ab[..., None] * e_b
+    a_b = inv_ab[..., None] * e_a + inv_bb[..., None] * e_b
+    return {
+        "r": r,
+        "rhat": rhat,
+        "e_a": e_a,
+        "e_b": e_b,
+        "a_a": a_a,
+        "a_b": a_b,
+        "sqrtg": sqrtg,
+        "inv_gaa": inv_aa,
+        "inv_gab": inv_ab,
+        "inv_gbb": inv_bb,
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class CubedSphereGrid:
+    """Precomputed cubed-sphere geometry on the halo-extended grid.
+
+    Array layout: ``(6, M, M)`` (scalars) / ``(3, 6, M, M)`` (vectors,
+    Cartesian component leading so the last two axes keep TPU (sublane,
+    lane) tiling) with ``M = n + 2*halo``; index ``[face, j, i]`` where
+    ``i`` runs along alpha (x-like) and ``j`` along beta (y-like).
+    ``*_xf`` quantities live at the *left* alpha-face of each cell (face i
+    is between cells i-1 and i); ``*_yf`` at the *bottom* beta-face.
+    """
+
+    n: int
+    halo: int
+    radius: float
+    dalpha: float
+    # Cell-center quantities, (6, M, M[, 3]).
+    xyz: Any
+    khat: Any
+    lon: Any
+    lat: Any
+    e_a: Any
+    e_b: Any
+    a_a: Any
+    a_b: Any
+    sqrtg: Any
+    area: Any
+    # Left/bottom cell-face quantities for fluxes.
+    sqrtg_xf: Any
+    a_a_xf: Any
+    sqrtg_yf: Any
+    a_b_yf: Any
+
+    @property
+    def m(self) -> int:
+        return self.n + 2 * self.halo
+
+    def interior(self, field):
+        """Slice the interior ``(..., 6, n, n)`` out of an extended field."""
+        h = self.halo
+        return field[..., h : h + self.n, h : h + self.n]
+
+    def total_area(self) -> float:
+        return float(jnp.sum(self.interior(self.area)))
+
+
+def build_grid(
+    n: int,
+    halo: int = 2,
+    radius: float = 1.0,
+    dtype=jnp.float32,
+) -> CubedSphereGrid:
+    """Build the grid: all metric terms in float64, cast to ``dtype``."""
+    m = n + 2 * halo
+    d = (np.pi / 2) / n
+    # Cell-center coords of the extended grid (halo cells extend past +-pi/4).
+    ac = -np.pi / 4 + (np.arange(m) - halo + 0.5) * d
+    # Left-face coords (face i = left face of extended cell i).
+    af = ac - 0.5 * d
+
+    cc: dict[str, list] = {k: [] for k in ("xyz", "khat", "e_a", "e_b", "a_a", "a_b", "sqrtg")}
+    xf: dict[str, list] = {k: [] for k in ("sqrtg", "a_a")}
+    yf: dict[str, list] = {k: [] for k in ("sqrtg", "a_b")}
+    lon_l, lat_l = [], []
+    for f in range(NUM_FACES):
+        # Centers: alpha varies along axis -1 (i), beta along axis -2 (j).
+        bb, aa = np.meshgrid(ac, ac, indexing="ij")
+        g = _basis_and_metric(f, aa, bb, radius)
+        cc["xyz"].append(g["r"])
+        cc["khat"].append(g["rhat"])
+        for k in ("e_a", "e_b", "a_a", "a_b", "sqrtg"):
+            cc[k].append(g[k])
+        lon_l.append(np.arctan2(g["rhat"][..., 1], g["rhat"][..., 0]))
+        lat_l.append(np.arcsin(np.clip(g["rhat"][..., 2], -1.0, 1.0)))
+        # Alpha-faces: alpha at af, beta at centers.
+        bb2, aa2 = np.meshgrid(ac, af, indexing="ij")
+        gx = _basis_and_metric(f, aa2, bb2, radius)
+        xf["sqrtg"].append(gx["sqrtg"])
+        xf["a_a"].append(gx["a_a"])
+        # Beta-faces: alpha at centers, beta at af.
+        bb3, aa3 = np.meshgrid(af, ac, indexing="ij")
+        gy = _basis_and_metric(f, aa3, bb3, radius)
+        yf["sqrtg"].append(gy["sqrtg"])
+        yf["a_b"].append(gy["a_b"])
+
+    def J(arrs):
+        return jnp.asarray(np.stack(arrs), dtype=dtype)
+
+    def Jv(arrs):
+        # (6, M, M, 3) -> (3, 6, M, M): component-leading vector layout.
+        return jnp.asarray(np.moveaxis(np.stack(arrs), -1, 0), dtype=dtype)
+
+    sqrtg = np.stack(cc["sqrtg"])
+    return CubedSphereGrid(
+        n=n,
+        halo=halo,
+        radius=radius,
+        dalpha=d,
+        xyz=Jv(cc["xyz"]),
+        khat=Jv(cc["khat"]),
+        lon=J(lon_l),
+        lat=J(lat_l),
+        e_a=Jv(cc["e_a"]),
+        e_b=Jv(cc["e_b"]),
+        a_a=Jv(cc["a_a"]),
+        a_b=Jv(cc["a_b"]),
+        sqrtg=J(cc["sqrtg"]),
+        area=jnp.asarray(sqrtg * d * d, dtype=dtype),
+        sqrtg_xf=J(xf["sqrtg"]),
+        a_a_xf=Jv(xf["a_a"]),
+        sqrtg_yf=J(yf["sqrtg"]),
+        a_b_yf=Jv(yf["a_b"]),
+    )

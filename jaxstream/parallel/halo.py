@@ -1,0 +1,168 @@
+"""Cubed-sphere halo exchange — global-array (GSPMD) path.
+
+This is the TPU-native rebuild of the reference's "Scalar Halo Exchange V2"
+(``/root/reference/JAX-DevLab-Examples.py:89-246``, deck p.9-11), redesigned:
+
+  * The reference JIT-compiles 12 separate edge-pair closures plus a
+    composed JIT.  Here the whole exchange is one pure function of the
+    extended field, meant to be traced *inside* the single top-level step
+    ``jit`` — the reference's "compile once, no recompile during
+    timestepping" invariant (deck p.10) kept, its redundant per-edge JITs
+    dropped (SURVEY.md §7 pitfalls).
+  * Orientation handling generalizes the reference's 1-deep scalar ops
+    {N, T, R, TR} (``JAX-DevLab-Examples.py:143-163``): strips are read in
+    a canonical (depth, along-edge) frame, so "T" is the strip transpose
+    built into the frame and only the along-edge *reversal* remains as a
+    data op — correct for any halo depth, unlike the reference's T==identity
+    shortcut which only works for 1-deep scalars.
+  * Under ``jax.jit`` with a ``NamedSharding`` over the panel (and x/y)
+    axes, XLA's GSPMD partitioner lowers the 24 directed strip copies to
+    ``collective_permute``s between panel shards — the reference's implicit
+    communication model (SURVEY.md §2.6).  The explicit ``shard_map`` +
+    ``lax.ppermute`` path is built on top of this in
+    :mod:`jaxstream.parallel.shard_halo` (hand-scheduled collectives for the
+    flagship TPU configuration).
+
+Field layout: ``(..., 6, M, M)`` with ``M = n + 2*halo``; leading axes (e.g.
+a Cartesian vector-component axis) are carried through untouched, which is
+exactly the reference's "Cartesian Velocity Exchange" (deck p.18): vector
+fields exchange componentwise with no rotation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..geometry.connectivity import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    EdgeLink,
+    build_connectivity,
+    build_schedule,
+)
+
+__all__ = ["make_halo_exchanger", "read_strip", "write_strip"]
+
+
+def read_strip(field, face: int, edge: int, halo: int, n: int):
+    """Interior boundary strip of ``face``/``edge`` in canonical frame.
+
+    Returns ``(..., halo, n)``: axis -2 is depth (0 = nearest the edge),
+    axis -1 is the along-edge index (increasing alpha for S/N, increasing
+    beta for E/W).  This is the rebuild of the reference's missing
+    ``extract_boundary_data`` (called at ``JAX-DevLab-Examples.py:184-185``
+    but never defined).
+    """
+    h, hn = halo, halo + n
+    a = field[..., face, :, :]
+    if edge == EDGE_S:
+        return a[..., h : 2 * h, h:hn]
+    if edge == EDGE_N:
+        return jnp.flip(a[..., hn - h : hn, h:hn], axis=-2)
+    if edge == EDGE_W:
+        return jnp.swapaxes(a[..., h:hn, h : 2 * h], -1, -2)
+    if edge == EDGE_E:
+        return jnp.swapaxes(jnp.flip(a[..., h:hn, hn - h : hn], axis=-1), -1, -2)
+    raise ValueError(edge)
+
+
+def write_strip(field, face: int, edge: int, strip):
+    """Write a canonical ``(..., halo, n)`` strip into the ghost ring.
+
+    Rebuild of the reference's missing ``set_ghost_data``
+    (``JAX-DevLab-Examples.py:192-195``).
+    """
+    h = strip.shape[-2]
+    n = strip.shape[-1]
+    hn = h + n
+    if edge == EDGE_S:
+        return field.at[..., face, 0:h, h:hn].set(jnp.flip(strip, axis=-2))
+    if edge == EDGE_N:
+        return field.at[..., face, hn : hn + h, h:hn].set(strip)
+    if edge == EDGE_W:
+        return field.at[..., face, h:hn, 0:h].set(
+            jnp.flip(jnp.swapaxes(strip, -1, -2), axis=-1)
+        )
+    if edge == EDGE_E:
+        return field.at[..., face, h:hn, hn : hn + h].set(jnp.swapaxes(strip, -1, -2))
+    raise ValueError(edge)
+
+
+def _fill_corners(field, halo: int, n: int):
+    """Fill the 4 h-by-h ghost corner blocks per face by edge-ghost averaging.
+
+    Three panels meet at each cube corner, so no unique neighbor exists
+    (SURVEY.md §7 "hard parts"); dimension-split stencils never read the
+    corners, and the average keeps them finite for diagnostics/viz.
+    """
+    h, hn = halo, halo + n
+    f = field
+    # SW / SE / NW / NE corner blocks.
+    f = f.at[..., 0:h, 0:h].set(
+        0.5 * (f[..., 0:h, h : h + 1] + f[..., h : h + 1, 0:h])
+    )
+    f = f.at[..., 0:h, hn : hn + h].set(
+        0.5 * (f[..., 0:h, hn - 1 : hn] + f[..., h : h + 1, hn : hn + h])
+    )
+    f = f.at[..., hn : hn + h, 0:h].set(
+        0.5 * (f[..., hn : hn + h, h : h + 1] + f[..., hn - 1 : hn, 0:h])
+    )
+    f = f.at[..., hn : hn + h, hn : hn + h].set(
+        0.5 * (f[..., hn : hn + h, hn - 1 : hn] + f[..., hn - 1 : hn, hn : hn + h])
+    )
+    return f
+
+
+def make_halo_exchanger(
+    n: int,
+    halo: int,
+    adj: Optional[List[List[EdgeLink]]] = None,
+    schedule: Optional[List[List[Tuple[EdgeLink, EdgeLink]]]] = None,
+    fill_corners: bool = True,
+) -> Callable:
+    """Build ``exchange(field) -> field`` for ``(..., 6, M, M)`` fields.
+
+    The returned function is pure and trace-friendly: call it inside the
+    top-level ``jit``.  Exchanges are applied in race-free stage order from
+    the edge-coloring scheduler (functional ``.at[].set`` semantics already
+    make races impossible — deck p.11 — the staging is kept as the
+    documented communication schedule and for the shard_map path's benefit).
+    """
+    adj = adj or build_connectivity()
+    schedule = schedule or build_schedule(adj)
+
+    # Flatten to a static list of directed copies: (dst_face, dst_edge,
+    # src_face, src_edge, reversed).
+    copies = []
+    for stage in schedule:
+        for link, back in stage:
+            copies.append((link.face, link.edge, link.nbr_face, link.nbr_edge, link.reversed_))
+            copies.append((back.face, back.edge, back.nbr_face, back.nbr_edge, back.reversed_))
+
+    m = n + 2 * halo
+
+    def exchange(field):
+        if field.shape[-3:] != (6, m, m):
+            raise ValueError(
+                f"halo exchanger built for n={n}, halo={halo} expects a "
+                f"(..., 6, {m}, {m}) field, got {field.shape}"
+            )
+        strips = []
+        for df, de, sf, se, rev in copies:
+            s = read_strip(field, sf, se, halo, n)
+            if rev:
+                s = jnp.flip(s, axis=-1)
+            strips.append(s)
+        # All reads before all writes: the classic double-buffer exchange,
+        # expressed functionally.
+        for (df, de, _, _, _), s in zip(copies, strips):
+            field = write_strip(field, df, de, s)
+        if fill_corners:
+            field = _fill_corners(field, halo, n)
+        return field
+
+    return exchange

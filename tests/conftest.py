@@ -1,0 +1,31 @@
+"""Test harness: fabricate 8 virtual CPU XLA devices before JAX backend init.
+
+This replicates (and fixes) the reference's multi-device-without-a-cluster
+testing tier: it sets ``XLA_FLAGS=--xla_force_host_platform_device_count``
+via config *after* JAX may already be initialized
+(``/root/reference/JAX-DevLab-Examples.py:64-73`` — a latent ordering bug,
+SURVEY.md §7).  Here the flags are set in conftest, before any test module
+imports jax.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Make the repo root importable regardless of pytest rootdir config.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# In this image a sitecustomize registers a real-TPU 'axon' PJRT backend and
+# force-sets jax_platforms='axon,cpu' (ignoring JAX_PLATFORMS) — so pin the
+# default platform to CPU *after* import, which is honored.  Unit tests run
+# on the 8 virtual CPU devices; TPU-only tests request jax.devices('axon')
+# explicitly.
+import jax  # noqa: E402  (must import after XLA_FLAGS is set)
+
+jax.config.update("jax_platforms", "cpu")
+# Tests use float64 oracles (SURVEY.md §7: "f64-on-CPU oracle"); library
+# code is dtype-explicit so this only sharpens test-side math.
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,69 @@
+"""Connectivity + schedule invariants (mirrors SURVEY.md §2.5 properties)."""
+
+import numpy as np
+
+from jaxstream.geometry.connectivity import (
+    build_connectivity,
+    build_schedule,
+    edge_pairs,
+)
+
+# Antipodal face pairs in our layout: (+x,-x), (+y,-y), (+z,-z).
+ANTIPODAL = {frozenset((0, 2)), frozenset((1, 3)), frozenset((4, 5))}
+
+
+def test_every_edge_matched_once_and_symmetric():
+    adj = build_connectivity()
+    seen = set()
+    for f in range(6):
+        for e in range(4):
+            l = adj[f][e]
+            assert l.face == f and l.edge == e
+            back = adj[l.nbr_face][l.nbr_edge]
+            assert (back.nbr_face, back.nbr_edge) == (f, e)
+            assert back.reversed_ == l.reversed_
+            seen.add((l.nbr_face, l.nbr_edge))
+    # All 24 directed edges appear as someone's neighbor exactly once.
+    assert len(seen) == 24
+
+
+def test_twelve_undirected_edges():
+    assert len(edge_pairs()) == 12
+
+
+def test_antipodal_faces_never_exchange():
+    for l, _ in edge_pairs():
+        assert frozenset((l.face, l.nbr_face)) not in ANTIPODAL
+
+
+def test_four_regular_adjacency():
+    adj = build_connectivity()
+    for f in range(6):
+        nbrs = {adj[f][e].nbr_face for e in range(4)}
+        assert len(nbrs) == 4 and f not in nbrs
+
+
+def test_schedule_is_four_perfect_matchings():
+    stages = build_schedule()
+    assert len(stages) == 4
+    covered = set()
+    for stage in stages:
+        faces = []
+        for l, b in stage:
+            faces += [l.face, l.nbr_face]
+            key = frozenset(((l.face, l.edge), (l.nbr_face, l.nbr_edge)))
+            assert key not in covered
+            covered.add(key)
+        # Perfect matching: each of the 6 faces exactly once per stage.
+        assert sorted(faces) == [0, 1, 2, 3, 4, 5]
+    assert len(covered) == 12
+
+
+def test_reversal_census_stable():
+    # In our face layout exactly 4 of the 12 undirected edges reverse the
+    # along-edge index — the same census as the reference's layout ("(4)
+    # edges need transposition and/or reversal", SURVEY.md §2.5).  Pin it so
+    # accidental geometry changes get caught.
+    revs = sum(1 for l, _ in edge_pairs() if l.reversed_)
+    assert revs == sum(1 for _, b in edge_pairs() if b.reversed_)
+    assert revs == 4

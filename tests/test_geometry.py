@@ -1,0 +1,76 @@
+"""Geometry unit tests: metric identities, areas, analytic cross-checks."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jaxstream.geometry.cubed_sphere import FACE_AXES, build_grid, face_points
+
+
+def test_face_axes_right_handed():
+    for f in range(6):
+        c0, cx, cy = FACE_AXES[f]
+        assert np.allclose(np.cross(cx, cy), c0)
+
+
+def test_total_area_unit_sphere():
+    g = build_grid(24, halo=2, radius=1.0, dtype=jnp.float32)
+    assert abs(g.total_area() - 4 * np.pi) / (4 * np.pi) < 2e-3
+
+
+def test_total_area_earth_radius():
+    a = 6.37122e6
+    g = build_grid(16, halo=1, radius=a, dtype=jnp.float32)
+    assert abs(g.total_area() - 4 * np.pi * a * a) / (4 * np.pi * a * a) < 5e-3
+
+
+def test_dual_basis_identity():
+    g = build_grid(8, halo=1, radius=2.0, dtype=jnp.float64)
+    # a^i . e_j = delta_ij, everywhere including halo cells.
+    def dot(u, v):
+        return jnp.sum(u * v, axis=0)
+
+    assert np.allclose(dot(g.a_a, g.e_a), 1.0, atol=1e-6)
+    assert np.allclose(dot(g.a_b, g.e_b), 1.0, atol=1e-6)
+    assert np.allclose(dot(g.a_a, g.e_b), 0.0, atol=1e-6)
+    assert np.allclose(dot(g.a_b, g.e_a), 0.0, atol=1e-6)
+
+
+def test_bases_tangent_to_sphere():
+    g = build_grid(8, halo=2, radius=1.0, dtype=jnp.float64)
+    for v in (g.e_a, g.e_b, g.a_a, g.a_b):
+        assert np.allclose(np.sum(np.asarray(v * g.khat), axis=0), 0.0, atol=1e-6)
+
+
+def test_sqrtg_analytic():
+    # Equiangular gnomonic: sqrt(g) = a^2 (1+X^2)(1+Y^2) / rho^3.
+    n, h, a = 12, 1, 3.0
+    g = build_grid(n, halo=h, radius=a, dtype=jnp.float64)
+    d = (np.pi / 2) / n
+    ac = -np.pi / 4 + (np.arange(n + 2 * h) - h + 0.5) * d
+    X = np.tan(ac)[None, :]
+    Y = np.tan(ac)[:, None]
+    rho = np.sqrt(1 + X**2 + Y**2)
+    expect = a * a * (1 + X**2) * (1 + Y**2) / rho**3
+    for f in range(6):
+        # Grid arrays are f32 on device (x64 stays off, TPU-first).
+        assert np.allclose(np.asarray(g.sqrtg[f]), expect, rtol=1e-5)
+
+
+def test_pole_faces():
+    g = build_grid(8, halo=1, radius=1.0, dtype=jnp.float64)
+    # Face 4 is the north cap, face 5 the south cap.
+    assert float(jnp.max(g.lat[4])) > 0.6
+    assert float(jnp.min(g.lat[4])) > 0.3
+    assert float(jnp.max(g.lat[5])) < -0.3
+
+
+def test_face_points_cover_sphere_uniquely():
+    # Interior points of different faces never coincide.
+    t = np.linspace(-np.pi / 4 + 0.1, np.pi / 4 - 0.1, 5)
+    pts = [face_points(f, t[:, None], t[None, :]).reshape(-1, 3) for f in range(6)]
+    for i in range(6):
+        for j in range(i + 1, 6):
+            d = np.linalg.norm(pts[i][:, None, :] - pts[j][None, :, :], axis=-1)
+            assert d.min() > 1e-3

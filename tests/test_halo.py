@@ -1,0 +1,119 @@
+"""Halo-exchange correctness: ghost values, idempotence, vectors, convergence."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.parallel.halo import make_halo_exchanger, read_strip, write_strip
+
+
+def smooth(xyz):
+    # Smooth function on the sphere (low-order harmonics): xyz is (3, ...).
+    x, y, z = xyz[0], xyz[1], xyz[2]
+    return 1.0 + x * y + 0.5 * z * z + 0.25 * x
+
+
+def ghost_error(n, halo):
+    g = build_grid(n, halo=halo, radius=1.0, dtype=jnp.float64)
+    f_exact = smooth(g.xyz)  # exact values at every extended cell center
+    field = jnp.where(_interior_mask(n, halo), f_exact, jnp.nan)  # poison ghosts
+    ex = make_halo_exchanger(n, halo)
+    out = ex(field)
+    err = jnp.abs(out - f_exact)
+    # Only edge-ghost cells (not corners) are exchanged data; corners are an
+    # averaged fill, excluded here.
+    mask = _edge_ghost_mask(n, halo)
+    return float(jnp.max(jnp.where(mask, err, 0.0)))
+
+
+def _interior_mask(n, halo):
+    m = n + 2 * halo
+    jj, ii = jnp.meshgrid(jnp.arange(m), jnp.arange(m), indexing="ij")
+    inside = (jj >= halo) & (jj < halo + n) & (ii >= halo) & (ii < halo + n)
+    return jnp.broadcast_to(inside, (6, m, m))
+
+
+def _edge_ghost_mask(n, halo):
+    m = n + 2 * halo
+    jj, ii = jnp.meshgrid(jnp.arange(m), jnp.arange(m), indexing="ij")
+    in_j = (jj >= halo) & (jj < halo + n)
+    in_i = (ii >= halo) & (ii < halo + n)
+    edge = (in_j & ~in_i) | (in_i & ~in_j)
+    return jnp.broadcast_to(edge, (6, m, m))
+
+
+def test_ghosts_no_nans_and_small_error():
+    err = ghost_error(16, 2)
+    assert np.isfinite(err)
+    # Direct neighbor-cell copy: ghost centers and neighbor cell centers
+    # differ by O(dx) at depth>=2 (coordinate-line kink at panel edges), so
+    # values differ by O(dx)*|grad f|; the convergence test below is the
+    # real acceptance criterion.
+    assert err < 0.2
+
+
+def test_ghost_error_converges():
+    e1 = ghost_error(12, 2)
+    e2 = ghost_error(24, 2)
+    assert e2 < e1 * 0.6  # at least ~first-order decay
+
+
+def test_idempotent():
+    n, halo = 8, 2
+    g = build_grid(n, halo=halo, radius=1.0, dtype=jnp.float32)
+    ex = jax.jit(make_halo_exchanger(n, halo))
+    field = smooth(g.xyz).astype(jnp.float32)
+    once = ex(field)
+    twice = ex(once)
+    assert np.array_equal(np.asarray(once), np.asarray(twice))
+
+
+def test_leading_axes_carried():
+    n, halo = 8, 1
+    g = build_grid(n, halo=halo, radius=1.0, dtype=jnp.float32)
+    ex = make_halo_exchanger(n, halo)
+    # A (3, 6, M, M) "vector" field: exchanging componentwise must equal
+    # exchanging each component alone (Cartesian velocity exchange).
+    v = jnp.stack([smooth(g.xyz), g.xyz[0] * 2.0, g.xyz[2] - g.xyz[1]])
+    out = ex(v)
+    for c in range(3):
+        np.testing.assert_array_equal(np.asarray(out[c]), np.asarray(ex(v[c])))
+
+
+def test_strip_read_write_roundtrip():
+    n, halo = 6, 2
+    m = n + 2 * halo
+    rng = np.random.default_rng(0)
+    field = jnp.asarray(rng.standard_normal((6, m, m)))
+    for face in range(6):
+        for edge in range(4):
+            s = read_strip(field, face, edge, halo, n)
+            assert s.shape == (halo, n)
+            # Writing a strip then reading the *ghost* side back through the
+            # interior reader of a shifted frame is covered implicitly by
+            # ghost-value tests; here check write targets ghost cells only.
+            out = write_strip(field, face, edge, jnp.zeros_like(s))
+            h = halo
+            interior = np.asarray(out[face, h : h + n, h : h + n])
+            np.testing.assert_array_equal(
+                interior, np.asarray(field[face, h : h + n, h : h + n])
+            )
+
+
+def test_continuity_across_edges_jit():
+    # A globally smooth field must stay smooth across every panel edge after
+    # exchange: compare one-sided differences across the boundary.
+    n, halo = 24, 2
+    g = build_grid(n, halo=halo, radius=1.0, dtype=jnp.float64)
+    ex = jax.jit(make_halo_exchanger(n, halo))
+    f = smooth(g.xyz)
+    out = ex(jnp.where(_interior_mask(n, halo), f, 1e9))
+    h = halo
+    arr = np.asarray(out)
+    # Across the S edge of every face: |ghost - first interior row| small.
+    for face in range(6):
+        jump = np.abs(arr[face, h - 1, h : h + n] - arr[face, h, h : h + n])
+        assert jump.max() < 0.2, (face, jump.max())
