@@ -1,0 +1,134 @@
+"""YAML-driven configuration.
+
+Source-compatible superset of the reference's config surface: the deck's
+``config.yaml`` has a ``parallelization:`` block with ``tiles_per_edge``,
+``num_devices``, ``device_type`` (screenshot deck p.8; consumed with
+``.get`` defaults at ``/root/reference/JAX-DevLab-Examples.py:21-24``).
+We keep those keys and defaults verbatim and add the sections the full
+framework needs (grid, physics, time, io) — SURVEY.md §5 "Config / flag
+system" rebuild note.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+import yaml
+
+__all__ = [
+    "GridConfig",
+    "ParallelConfig",
+    "PhysicsConfig",
+    "TimeConfig",
+    "IOConfig",
+    "Config",
+    "load_config",
+]
+
+EARTH_RADIUS = 6.37122e6
+EARTH_OMEGA = 7.292e-5
+EARTH_GRAVITY = 9.80616
+
+
+@dataclasses.dataclass(frozen=True)
+class GridConfig:
+    n: int = 48                      # cells per panel edge (C{n})
+    halo: int = 2                    # >=2 for PLR, >=3 for PPM
+    radius: float = EARTH_RADIUS
+    dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    # Reference-compatible keys + defaults (JAX-DevLab-Examples.py:21-24).
+    tiles_per_edge: int = 1
+    num_devices: int = 6
+    device_type: str = "cpu"         # 'cpu' (virtual devices) | 'tpu' | 'gpu'
+    # Extensions.
+    use_shard_map: bool = False      # explicit ppermute path vs GSPMD
+    panel_axis: Optional[int] = None  # device-mesh panel dim (auto if None)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhysicsConfig:
+    gravity: float = EARTH_GRAVITY
+    omega: float = EARTH_OMEGA
+    hyperdiffusion: float = 0.0      # nu4 coefficient (m^4/s)
+    divergence_damping: float = 0.0  # nondimensional d2 coefficient
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeConfig:
+    dt: float = 600.0
+    scheme: str = "ssprk3"
+
+
+@dataclasses.dataclass(frozen=True)
+class IOConfig:
+    history_path: str = "history"
+    history_stride: int = 0          # steps between snapshots; 0 = off
+    checkpoint_path: str = "checkpoints"
+    checkpoint_stride: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    grid: GridConfig = GridConfig()
+    parallelization: ParallelConfig = ParallelConfig()
+    physics: PhysicsConfig = PhysicsConfig()
+    time: TimeConfig = TimeConfig()
+    io: IOConfig = IOConfig()
+
+
+_SECTIONS = {
+    "grid": GridConfig,
+    "parallelization": ParallelConfig,
+    "physics": PhysicsConfig,
+    "time": TimeConfig,
+    "io": IOConfig,
+}
+
+
+def _build_section(cls, data: dict):
+    fields = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - fields
+    if unknown:
+        raise ValueError(
+            f"unknown {cls.__name__} keys {sorted(unknown)}; valid: {sorted(fields)}"
+        )
+    return cls(**data)
+
+
+def load_config(source: Any = None) -> Config:
+    """Build a Config from a YAML path, a YAML string, a dict, or None."""
+    if source is None:
+        return Config()
+    if isinstance(source, Config):
+        return source
+    if isinstance(source, dict):
+        data = source
+    else:
+        text = str(source)
+        if os.path.exists(text):
+            with open(text) as fh:
+                data = yaml.safe_load(fh) or {}
+        else:
+            loaded = yaml.safe_load(text)
+            if not isinstance(loaded, dict):
+                raise ValueError(
+                    f"config source {text!r} is neither an existing file path "
+                    f"nor a YAML mapping"
+                )
+            data = loaded
+    kwargs = {}
+    unknown = set(data) - set(_SECTIONS)
+    if unknown:
+        raise ValueError(
+            f"unknown config sections {sorted(unknown)}; valid: {sorted(_SECTIONS)}"
+        )
+    for name, cls in _SECTIONS.items():
+        if name in data:
+            kwargs[name] = _build_section(cls, data[name] or {})
+    return Config(**kwargs)

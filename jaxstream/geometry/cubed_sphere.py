@@ -167,6 +167,11 @@ class CubedSphereGrid:
     a_a_xf: Any
     sqrtg_yf: Any
     a_b_yf: Any
+    # Inverse-metric components at faces (for Laplacian/diffusion fluxes).
+    ginv_aa_xf: Any
+    ginv_ab_xf: Any
+    ginv_bb_yf: Any
+    ginv_ab_yf: Any
 
     @property
     def m(self) -> int:
@@ -196,8 +201,8 @@ def build_grid(
     af = ac - 0.5 * d
 
     cc: dict[str, list] = {k: [] for k in ("xyz", "khat", "e_a", "e_b", "a_a", "a_b", "sqrtg")}
-    xf: dict[str, list] = {k: [] for k in ("sqrtg", "a_a")}
-    yf: dict[str, list] = {k: [] for k in ("sqrtg", "a_b")}
+    xf: dict[str, list] = {k: [] for k in ("sqrtg", "a_a", "inv_gaa", "inv_gab")}
+    yf: dict[str, list] = {k: [] for k in ("sqrtg", "a_b", "inv_gbb", "inv_gab")}
     lon_l, lat_l = [], []
     for f in range(NUM_FACES):
         # Centers: alpha varies along axis -1 (i), beta along axis -2 (j).
@@ -214,11 +219,15 @@ def build_grid(
         gx = _basis_and_metric(f, aa2, bb2, radius)
         xf["sqrtg"].append(gx["sqrtg"])
         xf["a_a"].append(gx["a_a"])
+        xf["inv_gaa"].append(gx["inv_gaa"])
+        xf["inv_gab"].append(gx["inv_gab"])
         # Beta-faces: alpha at centers, beta at af.
         bb3, aa3 = np.meshgrid(af, ac, indexing="ij")
         gy = _basis_and_metric(f, aa3, bb3, radius)
         yf["sqrtg"].append(gy["sqrtg"])
         yf["a_b"].append(gy["a_b"])
+        yf["inv_gbb"].append(gy["inv_gbb"])
+        yf["inv_gab"].append(gy["inv_gab"])
 
     def J(arrs):
         return jnp.asarray(np.stack(arrs), dtype=dtype)
@@ -247,4 +256,8 @@ def build_grid(
         a_a_xf=Jv(xf["a_a"]),
         sqrtg_yf=J(yf["sqrtg"]),
         a_b_yf=Jv(yf["a_b"]),
+        ginv_aa_xf=J(xf["inv_gaa"]),
+        ginv_ab_xf=J(xf["inv_gab"]),
+        ginv_bb_yf=J(yf["inv_gbb"]),
+        ginv_ab_yf=J(yf["inv_gab"]),
     )

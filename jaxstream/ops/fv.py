@@ -1,0 +1,272 @@
+"""Finite-volume differential operators on the cubed sphere.
+
+The tile-local stencil layer below the halo exchange (SURVEY.md §1.2
+"Numerics"; the reference only *describes* it — deck p.4: "Finite Volume
+(PLR) Method ... 2nd Order").  All operators:
+
+  * take extended fields ``(..., 6, M, M)`` whose ghosts have been filled
+    by :func:`jaxstream.parallel.halo.make_halo_exchanger`,
+  * return interior-shaped results ``(..., 6, n, n)``,
+  * are pure elementwise/stencil math with static shapes — they trace into
+    a single fused XLA computation under the top-level step ``jit`` and are
+    the profile targets for the Pallas kernels in
+    :mod:`jaxstream.ops.pallas` (flag-switched, numerics-identical).
+
+Velocity is a Cartesian 3-vector ``(3, 6, M, M)`` (the reference's
+"Cartesian Velocity Exchange" design, deck p.18): panel-local contravariant
+components are formed on the fly by dotting with the grid's dual basis, so
+no vector rotation is needed at panel edges.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+
+from ..geometry.connectivity import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    build_connectivity,
+    edge_pairs,
+)
+from ..geometry.cubed_sphere import CubedSphereGrid
+from .reconstruct import _sl, plr_face_states, ppm_face_states
+
+__all__ = [
+    "embed_interior",
+    "contravariant",
+    "flux_divergence",
+    "gradient",
+    "vorticity",
+    "laplacian",
+    "kinetic_energy",
+]
+
+
+def embed_interior(grid: CubedSphereGrid, arr, fill=0.0):
+    """Pad an interior ``(..., 6, n, n)`` array out to ``(..., 6, M, M)``."""
+    h = grid.halo
+    pad = [(0, 0)] * (arr.ndim - 2) + [(h, h), (h, h)]
+    return jnp.pad(arr, pad, constant_values=fill)
+
+
+def contravariant(grid: CubedSphereGrid, v):
+    """Contravariant components (u^alpha, u^beta) of a Cartesian vector.
+
+    ``v``: (3, 6, M, M) at cell centers -> two (6, M, M) arrays.
+    """
+    ua = jnp.sum(v * grid.a_a, axis=0)
+    ub = jnp.sum(v * grid.a_b, axis=0)
+    return ua, ub
+
+
+def _face_normal_velocity(grid: CubedSphereGrid, v):
+    """Contravariant normal velocity at interior-bounding faces.
+
+    Returns ``(ux, uy)``: ``ux`` is u^alpha at the n+1 x-faces of each
+    interior row, shape (6, n, n+1); ``uy`` is u^beta at y-faces,
+    shape (6, n+1, n).  Cell-centered Cartesian ``v`` is averaged to the
+    face then dotted with the face dual basis (metric-exact at the face).
+    """
+    h, n = grid.halo, grid.n
+    # x-faces: average v over cells i-1, i for i = h..h+n; rows interior.
+    vxf = 0.5 * (_sl(v, h - 1, h + n, -1) + _sl(v, h, h + n + 1, -1))
+    vxf = _sl(vxf, h, h + n, -2)
+    aaxf = _sl(_sl(grid.a_a_xf, h, h + n + 1, -1), h, h + n, -2)
+    ux = jnp.sum(vxf * aaxf, axis=0)
+    # y-faces.
+    vyf = 0.5 * (_sl(v, h - 1, h + n, -2) + _sl(v, h, h + n + 1, -2))
+    vyf = _sl(vyf, h, h + n, -1)
+    abyf = _sl(_sl(grid.a_b_yf, h, h + n + 1, -2), h, h + n, -1)
+    uy = jnp.sum(vyf * abyf, axis=0)
+    return ux, uy
+
+
+@lru_cache(maxsize=1)
+def _edge_pair_table():
+    return edge_pairs(build_connectivity())
+
+
+# Outward-normal sign of the stored +alpha/+beta face flux at each edge.
+_OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
+
+
+def _read_edge_flux(fx, fy, face, edge, n):
+    """Panel-boundary face flux as a canonical along-edge strip (n,)."""
+    if edge == EDGE_S:
+        return fy[..., face, 0, :]
+    if edge == EDGE_N:
+        return fy[..., face, n, :]
+    if edge == EDGE_W:
+        return fx[..., face, :, 0]
+    if edge == EDGE_E:
+        return fx[..., face, :, n]
+    raise ValueError(edge)
+
+
+def _write_edge_flux(fx, fy, face, edge, strip, n):
+    if edge == EDGE_S:
+        return fx, fy.at[..., face, 0, :].set(strip)
+    if edge == EDGE_N:
+        return fx, fy.at[..., face, n, :].set(strip)
+    if edge == EDGE_W:
+        return fx.at[..., face, :, 0].set(strip), fy
+    if edge == EDGE_E:
+        return fx.at[..., face, :, n].set(strip), fy
+    raise ValueError(edge)
+
+
+def _symmetrize_edge_fluxes(fx, fy, n):
+    """Make panel-edge fluxes exactly antisymmetric across shared edges.
+
+    Each panel computes its own boundary-face flux with its own metric and
+    reconstruction; the two values for one physical edge face differ by
+    O(dx^2), so mass leaks at panel seams (the reference, which computes
+    fluxes per-panel after a ghost copy, has the same leak).  Replacing
+    both with the average outward flux makes the scheme globally
+    conservative to roundoff — the FV analogue of Putman & Lin (2007)'s
+    edge-flux matching.
+    """
+    for link, back in _edge_pair_table():
+        s_a = _read_edge_flux(fx, fy, link.face, link.edge, n)
+        s_b = _read_edge_flux(fx, fy, back.face, back.edge, n)
+        if link.reversed_:
+            s_b = jnp.flip(s_b, axis=-1)
+        out_a = _OUT_SIGN[link.edge] * s_a
+        out_b = _OUT_SIGN[back.edge] * s_b
+        avg = 0.5 * (out_a - out_b)
+        new_a = _OUT_SIGN[link.edge] * avg
+        new_b = _OUT_SIGN[back.edge] * (-avg)
+        if link.reversed_:
+            new_b = jnp.flip(new_b, axis=-1)
+        fx, fy = _write_edge_flux(fx, fy, link.face, link.edge, new_a, n)
+        fx, fy = _write_edge_flux(fx, fy, back.face, back.edge, new_b, n)
+    return fx, fy
+
+
+def flux_divergence(
+    grid: CubedSphereGrid,
+    q,
+    v,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    conservative_edges: bool = False,
+):
+    """Divergence of the advective flux, div(q v), on interior cells.
+
+    Flux-form FV: (1/(sqrt(g) d)) * [ delta_a(sqrt(g) u^a q*) +
+    delta_b(sqrt(g) u^b q*) ] with q* the upwind PLR/PPM face state.
+    ``q``: (6, M, M) extended scalar; ``v``: (3, 6, M, M) Cartesian.
+    Returns (6, n, n).  Mass-conservative by construction — including
+    across panel edges: ghost copies are value-exact and sqrt(g) a^alpha
+    is continuous at edges, so both panels compute bitwise-matching edge
+    fluxes (verified in tests).  ``conservative_edges`` additionally
+    averages the two sides' edge fluxes — a no-op today, insurance for
+    future interpolated (non-copy) ghost fills.
+    """
+    h, n, d = grid.halo, grid.n, grid.dalpha
+    ux, uy = _face_normal_velocity(grid, v)
+
+    recon = ppm_face_states if scheme == "ppm" else plr_face_states
+    kw = {} if scheme == "ppm" else {"limiter": limiter}
+
+    # x-direction: restrict rows first, reconstruct along axis -1.
+    qx = _sl(q, h, h + n, -2)
+    qL, qR = recon(qx, -1, h, n, **kw)
+    sgx = _sl(_sl(grid.sqrtg_xf, h, h + n + 1, -1), h, h + n, -2)
+    fx = sgx * (jnp.maximum(ux, 0.0) * qL + jnp.minimum(ux, 0.0) * qR)
+
+    # y-direction.
+    qy = _sl(q, h, h + n, -1)
+    qL, qR = recon(qy, -2, h, n, **kw)
+    sgy = _sl(_sl(grid.sqrtg_yf, h, h + n + 1, -2), h, h + n, -1)
+    fy = sgy * (jnp.maximum(uy, 0.0) * qL + jnp.minimum(uy, 0.0) * qR)
+
+    if conservative_edges:
+        fx, fy = _symmetrize_edge_fluxes(fx, fy, n)
+
+    sg_c = grid.interior(grid.sqrtg)
+    return (
+        (_sl(fx, 1, None, -1) - _sl(fx, 0, -1, -1))
+        + (_sl(fy, 1, None, -2) - _sl(fy, 0, -1, -2))
+    ) / (sg_c * d)
+
+
+def gradient(grid: CubedSphereGrid, psi):
+    """Tangent-plane gradient of a scalar as a Cartesian 3-vector.
+
+    ``psi``: (6, M, M) extended -> (3, 6, n, n); centered differences.
+    """
+    h, n, d = grid.halo, grid.n, grid.dalpha
+    dpa = (_sl(_sl(psi, h + 1, h + n + 1, -1), h, h + n, -2)
+           - _sl(_sl(psi, h - 1, h + n - 1, -1), h, h + n, -2)) / (2 * d)
+    dpb = (_sl(_sl(psi, h + 1, h + n + 1, -2), h, h + n, -1)
+           - _sl(_sl(psi, h - 1, h + n - 1, -2), h, h + n, -1)) / (2 * d)
+    a_a = grid.interior(grid.a_a)
+    a_b = grid.interior(grid.a_b)
+    return a_a * dpa + a_b * dpb
+
+
+def vorticity(grid: CubedSphereGrid, v):
+    """Radial relative vorticity zeta = k . curl(v) on interior cells.
+
+    zeta = (1/sqrt(g)) (d v_beta / d alpha - d v_alpha / d beta) with
+    v_alpha = v . e_alpha the covariant components; centered differences.
+    ``v``: (3, 6, M, M) -> (6, n, n).
+    """
+    h, n, d = grid.halo, grid.n, grid.dalpha
+    va = jnp.sum(v * grid.e_a, axis=0)
+    vb = jnp.sum(v * grid.e_b, axis=0)
+    dvb_da = (_sl(_sl(vb, h + 1, h + n + 1, -1), h, h + n, -2)
+              - _sl(_sl(vb, h - 1, h + n - 1, -1), h, h + n, -2)) / (2 * d)
+    dva_db = (_sl(_sl(va, h + 1, h + n + 1, -2), h, h + n, -1)
+              - _sl(_sl(va, h - 1, h + n - 1, -2), h, h + n, -1)) / (2 * d)
+    return (dvb_da - dva_db) / grid.interior(grid.sqrtg)
+
+
+def laplacian(grid: CubedSphereGrid, psi):
+    """Laplace-Beltrami operator in conservative flux form.
+
+    lap(psi) = (1/sqrt(g)) [ d_a( sqrt(g)(g^aa psi_a + g^ab psi_b) )
+                           + d_b( sqrt(g)(g^ab psi_a + g^bb psi_b) ) ]
+    with face-centered metric terms; used for diffusion and (iterated,
+    with halo refills between applications) del^4 hyperdiffusion.
+    ``psi``: (6, M, M) -> (6, n, n).
+    """
+    h, n, d = grid.halo, grid.n, grid.dalpha
+
+    # x-faces i = h..h+n on interior rows.
+    pr = _sl(psi, h, h + n, -2)                      # interior rows, all cols
+    dpa = (_sl(pr, h, h + n + 1, -1) - _sl(pr, h - 1, h + n, -1)) / d
+    # d psi/d beta at the x-face: average the centered row-derivative of the
+    # two abutting cells.
+    dpb_c = (_sl(psi, h + 1, h + n + 1, -2) - _sl(psi, h - 1, h + n - 1, -2)) / (2 * d)
+    dpb_f = 0.5 * (_sl(dpb_c, h - 1, h + n, -1) + _sl(dpb_c, h, h + n + 1, -1))
+    sgx = _sl(_sl(grid.sqrtg_xf, h, h + n + 1, -1), h, h + n, -2)
+    iaa = _sl(_sl(grid.ginv_aa_xf, h, h + n + 1, -1), h, h + n, -2)
+    iab = _sl(_sl(grid.ginv_ab_xf, h, h + n + 1, -1), h, h + n, -2)
+    fx = sgx * (iaa * dpa + iab * dpb_f)
+
+    # y-faces j = h..h+n on interior columns.
+    pc = _sl(psi, h, h + n, -1)
+    dpb = (_sl(pc, h, h + n + 1, -2) - _sl(pc, h - 1, h + n, -2)) / d
+    dpa_c = (_sl(psi, h + 1, h + n + 1, -1) - _sl(psi, h - 1, h + n - 1, -1)) / (2 * d)
+    dpa_f = 0.5 * (_sl(dpa_c, h - 1, h + n, -2) + _sl(dpa_c, h, h + n + 1, -2))
+    sgy = _sl(_sl(grid.sqrtg_yf, h, h + n + 1, -2), h, h + n, -1)
+    ibb = _sl(_sl(grid.ginv_bb_yf, h, h + n + 1, -2), h, h + n, -1)
+    iab2 = _sl(_sl(grid.ginv_ab_yf, h, h + n + 1, -2), h, h + n, -1)
+    fy = sgy * (ibb * dpb + iab2 * dpa_f)
+
+    sg_c = grid.interior(grid.sqrtg)
+    return (
+        (_sl(fx, 1, None, -1) - _sl(fx, 0, -1, -1))
+        + (_sl(fy, 1, None, -2) - _sl(fy, 0, -1, -2))
+    ) / (sg_c * d)
+
+
+def kinetic_energy(v):
+    """|v|^2 / 2 for a Cartesian vector field (any trailing shape)."""
+    return 0.5 * jnp.sum(v * v, axis=0)

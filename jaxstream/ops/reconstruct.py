@@ -1,0 +1,130 @@
+"""Slope-limited reconstructions for finite-volume fluxes.
+
+The reference's numerics layer is described but not shipped ("Finite Volume
+(PLR) Method ... 2nd Order", deck p.4, p.13; SURVEY.md §2.2).  These are the
+piecewise-linear (PLR) limiters and the piecewise-parabolic (PPM) face
+values, written axis-agnostically over extended (halo-carrying) arrays so
+the same code serves x- and y-direction fluxes under dimension splitting.
+
+Everything is branch-free elementwise math (``jnp.where``/min/max) — VPU
+-friendly, no data-dependent control flow under ``jit``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["slope", "plr_face_states", "ppm_face_states", "LIMITERS"]
+
+
+def _minmod2(a, b):
+    return 0.5 * (jnp.sign(a) + jnp.sign(b)) * jnp.minimum(jnp.abs(a), jnp.abs(b))
+
+
+def _slope_none(dqm, dqp):
+    # Unlimited centered slope: plain 2nd order (good for smooth fields).
+    return 0.5 * (dqm + dqp)
+
+
+def _slope_minmod(dqm, dqp):
+    return _minmod2(dqm, dqp)
+
+
+def _slope_mc(dqm, dqp):
+    # Monotonized-central: minmod((dqm+dqp)/2, 2 dqm, 2 dqp).
+    sgn = 0.5 * (jnp.sign(dqm) + jnp.sign(dqp))
+    mag = jnp.minimum(
+        0.5 * jnp.abs(dqm + dqp), 2.0 * jnp.minimum(jnp.abs(dqm), jnp.abs(dqp))
+    )
+    return sgn * mag
+
+
+def _slope_vanleer(dqm, dqp):
+    prod = dqm * dqp
+    return jnp.where(prod > 0, 2.0 * prod / (dqm + dqp + 1e-300), 0.0)
+
+
+LIMITERS = {
+    "none": _slope_none,
+    "minmod": _slope_minmod,
+    "mc": _slope_mc,
+    "vanleer": _slope_vanleer,
+}
+
+
+def _sl(arr, lo, hi, axis):
+    idx = [slice(None)] * arr.ndim
+    idx[axis] = slice(lo, hi)
+    return arr[tuple(idx)]
+
+
+def slope(q, axis: int, limiter: str = "mc"):
+    """Limited slope for cells 1..len-2 along ``axis`` (shrinks by 2)."""
+    lim = LIMITERS[limiter]
+    qm = _sl(q, 0, -2, axis)
+    qc = _sl(q, 1, -1, axis)
+    qp = _sl(q, 2, None, axis)
+    return lim(qc - qm, qp - qc)
+
+
+def plr_face_states(q, axis: int, h: int, n: int, limiter: str = "mc"):
+    """Left/right states at the n+1 interior-bounding faces along ``axis``.
+
+    ``q`` is extended along ``axis`` (length n + 2h, h >= 2).  Face i (for
+    i = h..h+n) separates cells i-1 and i; returns ``(qL, qR)`` each of
+    length n+1 along ``axis``.
+    """
+    lim = LIMITERS[limiter]
+    # Slopes for cells h-1..h+n (n+2 of them).
+    c0 = _sl(q, h - 2, h + n, axis)
+    c1 = _sl(q, h - 1, h + n + 1, axis)
+    c2 = _sl(q, h, h + n + 2, axis)
+    sigma = lim(c1 - c0, c2 - c1)
+    recon_hi = c1 + 0.5 * sigma
+    recon_lo = c1 - 0.5 * sigma
+    qL = _sl(recon_hi, 0, n + 1, axis)  # upwind state from cell i-1
+    qR = _sl(recon_lo, 1, n + 2, axis)  # upwind state from cell i
+    return qL, qR
+
+
+def ppm_face_states(q, axis: int, h: int, n: int):
+    """PPM (piecewise-parabolic, Colella-Woodward) face states.
+
+    Needs h >= 3 (reads the 4-cell stencil around each face and the
+    limited 6th-order-ish edge interpolant).  Returns ``(qL, qR)`` at the
+    n+1 faces, with the standard PPM monotonicity limiting applied to the
+    parabola in each upwind cell.  This is the reference deck's roadmap
+    "PPM upgrade" (SURVEY.md §2.2) in axis-agnostic form.
+    """
+    if h < 3:
+        raise ValueError(f"PPM needs halo >= 3, got {h}")
+
+    # Edge value at face i: 7/12 (q_{i-1}+q_i) - 1/12 (q_{i-2}+q_{i+1}),
+    # computed for faces h-1 .. h+n+1 (n+3 faces) so each of the cells
+    # h-1..h+n has both its edges.
+    qm2 = _sl(q, h - 3, h + n, axis)
+    qm1 = _sl(q, h - 2, h + n + 1, axis)
+    qp0 = _sl(q, h - 1, h + n + 2, axis)
+    qp1 = _sl(q, h, h + n + 3, axis)
+    edge = (7.0 / 12.0) * (qm1 + qp0) - (1.0 / 12.0) * (qm2 + qp1)
+
+    # Per-cell left/right edge values for cells h-1..h+n (n+2 cells).
+    ql_c = _sl(edge, 0, n + 2, axis)
+    qr_c = _sl(edge, 1, n + 3, axis)
+    qc = _sl(q, h - 1, h + n + 1, axis)
+
+    # PPM limiter (CW84 eq. 1.10): enforce monotonicity of the parabola.
+    # 1) If qc is a local extremum w.r.t. its edges, flatten.
+    extremum = (qr_c - qc) * (qc - ql_c) <= 0
+    ql_c = jnp.where(extremum, qc, ql_c)
+    qr_c = jnp.where(extremum, qc, qr_c)
+    # 2) Clip overshooting parabolas.
+    dq = qr_c - ql_c
+    q6 = 6.0 * (qc - 0.5 * (ql_c + qr_c))
+    ql_c = jnp.where(dq * q6 > dq * dq, 3.0 * qc - 2.0 * qr_c, ql_c)
+    qr_c = jnp.where(-(dq * dq) > dq * q6, 3.0 * qc - 2.0 * ql_c, qr_c)
+
+    # Face i takes the right edge of cell i-1 (qL) and left edge of cell i.
+    qL = _sl(qr_c, 0, n + 1, axis)
+    qR = _sl(ql_c, 1, n + 2, axis)
+    return qL, qR

@@ -1,0 +1,153 @@
+"""Device-mesh and sharding setup (the reference's ``setup_sharding``, redone).
+
+Reference behavior being rebuilt (``/root/reference/JAX-DevLab-Examples.py:
+19-85``): read the ``parallelization`` config block; validate
+``num_tiles = 6 * tiles_per_edge**2`` against the device count with
+remediation-text errors; build a device mesh and a ``NamedSharding`` that
+partitions the panel axis; support a virtual-CPU-device tier for testing.
+
+TPU-native redesign:
+  * 3-D mesh ``('panel', 'y', 'x')`` instead of the reference's 1-D
+    ``('tiles',)`` — panels shard over 'panel', and each panel's interior
+    block-decomposes over 'y' x 'x' (the reference's planned
+    ``tiles_per_edge > 1``, which it explicitly left unimplemented at
+    ``JAX-DevLab-Examples.py:31-37``).
+  * The virtual-device flag ordering bug is avoided: we never set
+    ``XLA_FLAGS`` after backend init; the testing tier *requests* CPU
+    devices (``jax.devices('cpu')``) which works under any default
+    platform, and documents that the host-device-count flag must be set
+    before Python starts (tests/conftest.py does it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config, ParallelConfig
+from ..utils.logging import get_logger
+
+__all__ = ["ShardingSetup", "setup_sharding", "shard_state"]
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingSetup:
+    mesh: Optional[Mesh]
+    num_devices: int
+    panel: int
+    sy: int
+    sx: int
+
+    @property
+    def scalar_spec(self) -> P:
+        return P("panel", "y", "x")
+
+    def spec_for(self, ndim: int) -> P:
+        """PartitionSpec for an array whose last 3 axes are (6, ny, nx)."""
+        return P(*((None,) * (ndim - 3) + ("panel", "y", "x")))
+
+    def sharding_for(self, ndim: int):
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, self.spec_for(ndim))
+
+
+def _pick_devices(kind: str, count: int):
+    kind = (kind or "cpu").lower()
+    if kind == "cpu":
+        devs = jax.devices("cpu")
+    elif kind in ("tpu", "gpu", "axon", "default"):
+        devs = jax.devices()
+    else:
+        raise ValueError(f"unknown device_type {kind!r}; use 'cpu', 'tpu' or 'gpu'")
+    if len(devs) < count:
+        raise ValueError(
+            f"requested num_devices={count} but only {len(devs)} {kind} devices "
+            f"exist. For CPU testing, start Python with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={count}."
+        )
+    return devs[:count]
+
+
+def _factor_mesh(num_devices: int, tiles_per_edge: int):
+    """(panel, sy, sx) device-mesh dims for D devices at tiling t."""
+    p = math.gcd(num_devices, 6)
+    rest = num_devices // p
+    # Near-square split of the per-panel block grid.
+    sy = int(math.sqrt(rest))
+    while rest % sy:
+        sy -= 1
+    sx = rest // sy
+    if sy > tiles_per_edge or sx > tiles_per_edge:
+        raise ValueError(
+            f"num_devices={num_devices} needs a {sy}x{sx} sub-panel split but "
+            f"tiles_per_edge={tiles_per_edge} only allows up to "
+            f"{tiles_per_edge}x{tiles_per_edge}; raise tiles_per_edge."
+        )
+    return p, sy, sx
+
+
+def setup_sharding(config: Any = None) -> ShardingSetup:
+    """Build the device mesh + shardings from a Config (or its dict form)."""
+    if isinstance(config, Config):
+        par = config.parallelization
+    elif isinstance(config, ParallelConfig):
+        par = config
+    elif config is None:
+        par = ParallelConfig()
+    else:  # raw dict, reference-style: config['parallelization'].get(...)
+        block = dict(config.get("parallelization", {}))
+        par = ParallelConfig(
+            tiles_per_edge=block.get("tiles_per_edge", 1),
+            num_devices=block.get("num_devices", 6),
+            device_type=block.get("device_type", "cpu"),
+            use_shard_map=block.get("use_shard_map", False),
+        )
+
+    t = par.tiles_per_edge
+    if t < 1:
+        raise ValueError(f"tiles_per_edge must be >= 1, got {t}")
+    num_tiles = 6 * t * t
+    d = par.num_devices
+    if d > num_tiles:
+        raise ValueError(
+            f"num_devices={d} exceeds num_tiles={num_tiles} "
+            f"(= 6 * tiles_per_edge^2). Reduce num_devices or raise "
+            f"tiles_per_edge."
+        )
+    if num_tiles % d != 0:
+        divisors = [k for k in range(1, num_tiles + 1) if num_tiles % k == 0]
+        raise ValueError(
+            f"num_tiles={num_tiles} is not divisible by num_devices={d}. "
+            f"Valid device counts: {divisors}."
+        )
+
+    if d == 1:
+        log.info("sharding: single device (no mesh)")
+        return ShardingSetup(mesh=None, num_devices=1, panel=1, sy=1, sx=1)
+
+    p, sy, sx = _factor_mesh(d, t)
+    devs = np.array(_pick_devices(par.device_type, d)).reshape(p, sy, sx)
+    mesh = Mesh(devs, ("panel", "y", "x"))
+    log.info(
+        "sharding: %d %s devices as mesh panel=%d y=%d x=%d (tiles_per_edge=%d)",
+        d, par.device_type, p, sy, sx, t,
+    )
+    return ShardingSetup(mesh=mesh, num_devices=d, panel=p, sy=sy, sx=sx)
+
+
+def shard_state(setup: ShardingSetup, state):
+    """device_put every leaf with the rank-appropriate (panel,y,x) spec."""
+    if setup.mesh is None:
+        return state
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, setup.sharding_for(a.ndim)), state
+    )

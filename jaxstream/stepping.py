@@ -1,0 +1,104 @@
+"""Time integration under a single top-level ``jit``.
+
+The reference's core performance message is "compile once, no recompilation
+during timestepping" (deck p.10; ``JAX-DevLab-Examples.py:94-96``).  Here
+that is realized the idiomatic-JAX way: one ``jit`` wraps the *whole* step
+(halo exchange + RHS + stage combination), and multi-step integration runs
+under ``lax.scan``/``lax.fori_loop`` so Python never re-enters the loop —
+stronger than the reference's 12-small-JITs-plus-composed-JIT mechanism
+(SURVEY.md §7 pitfalls).
+
+Schemes are written over pytrees so any model state (scalar h, Cartesian
+velocity, tracers...) integrates unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import tree_util as jtu
+
+__all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper", "integrate"]
+
+
+def _axpy(y, dt, k):
+    return jtu.tree_map(lambda a, b: a + dt * b, y, k)
+
+
+def euler_step(rhs: Callable, y, t, dt):
+    return _axpy(y, dt, rhs(y, t))
+
+
+def ssprk3_step(rhs: Callable, y, t, dt):
+    """Shu-Osher strong-stability-preserving RK3 (the north-star scheme)."""
+    y1 = _axpy(y, dt, rhs(y, t))
+    y2 = jtu.tree_map(
+        lambda a, b: 0.75 * a + 0.25 * b, y, _axpy(y1, dt, rhs(y1, t + dt))
+    )
+    y3 = _axpy(y2, dt, rhs(y2, t + 0.5 * dt))
+    return jtu.tree_map(lambda a, b: (a + 2.0 * b) / 3.0, y, y3)
+
+
+def rk4_step(rhs: Callable, y, t, dt):
+    k1 = rhs(y, t)
+    k2 = rhs(_axpy(y, 0.5 * dt, k1), t + 0.5 * dt)
+    k3 = rhs(_axpy(y, 0.5 * dt, k2), t + 0.5 * dt)
+    k4 = rhs(_axpy(y, dt, k3), t + dt)
+    return jtu.tree_map(
+        lambda a, b1, b2, b3, b4: a + (dt / 6.0) * (b1 + 2 * b2 + 2 * b3 + b4),
+        y, k1, k2, k3, k4,
+    )
+
+
+SCHEMES = {"euler": euler_step, "ssprk3": ssprk3_step, "rk4": rk4_step}
+
+
+def make_stepper(rhs: Callable, dt: float, scheme: str = "ssprk3") -> Callable:
+    """``step(y, t) -> y_next``; jit it (or trace it inside a larger jit)."""
+    stepper = SCHEMES[scheme]
+
+    def step(y, t):
+        return stepper(rhs, y, t, dt)
+
+    return step
+
+
+def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float):
+    """Run ``nsteps`` under one compiled ``lax.fori_loop``.
+
+    Returns ``(y_final, t_final)``.  The carry keeps time as a traced
+    scalar so restarts resume mid-run without recompiling.
+    """
+
+    def body(_, carry):
+        y, t = carry
+        return step(y, t), t + dt
+
+    y, t = jax.lax.fori_loop(
+        0, nsteps, body, (y0, jnp.asarray(t0, dtype=jnp.float32))
+    )
+    return y, t
+
+
+def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float,
+                           stride: int, snapshot: Callable):
+    """As :func:`integrate`, also stacking ``snapshot(y)`` every ``stride``
+    steps via ``lax.scan`` (history output stays on device until fetched)."""
+
+    def body(_, c):
+        yy, tt = c
+        return step(yy, tt), tt + dt
+
+    def chunk(carry, _):
+        carry = jax.lax.fori_loop(0, stride, body, carry)
+        return carry, snapshot(carry[0])
+
+    nchunks, rem = divmod(nsteps, stride)
+    (y, t), hist = jax.lax.scan(
+        chunk, (y0, jnp.asarray(t0, dtype=jnp.float32)), None, length=nchunks
+    )
+    if rem:  # don't silently drop the trailing nsteps % stride steps
+        y, t = jax.lax.fori_loop(0, rem, body, (y, t))
+    return y, t, hist

@@ -1,0 +1,44 @@
+"""Scalar diagnostics: conservation integrals and Williamson error norms.
+
+The reference's scientific observability channel (SURVEY.md §5 "Metrics"):
+mass/energy/enstrophy integrals and the normalized l1/l2/linf error norms
+of Williamson et al. (1992) used for TC2 parity in ``BASELINE.json``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..geometry.cubed_sphere import CubedSphereGrid
+
+__all__ = ["total_mass", "total_energy", "potential_enstrophy", "error_norms"]
+
+
+def _wsum(grid: CubedSphereGrid, field_int):
+    return jnp.sum(field_int * grid.interior(grid.area))
+
+
+def total_mass(grid: CubedSphereGrid, h_int):
+    """integral h dA (h interior (6,n,n))."""
+    return _wsum(grid, h_int)
+
+
+def total_energy(grid: CubedSphereGrid, h_int, v_int, gravity: float, b_int=0.0):
+    """integral [ h |v|^2/2 + g h (h/2 + b) ] dA."""
+    ke = 0.5 * jnp.sum(v_int * v_int, axis=0)
+    return _wsum(grid, h_int * ke + gravity * h_int * (0.5 * h_int + b_int))
+
+
+def potential_enstrophy(grid: CubedSphereGrid, h_int, abs_vort_int):
+    """integral (zeta + f)^2 / (2h) dA."""
+    return _wsum(grid, abs_vort_int**2 / (2.0 * h_int))
+
+
+def error_norms(grid: CubedSphereGrid, field_int, ref_int):
+    """Williamson normalized l1, l2, linf norms of (field - ref)."""
+    w = grid.interior(grid.area)
+    diff = field_int - ref_int
+    l1 = jnp.sum(jnp.abs(diff) * w) / jnp.sum(jnp.abs(ref_int) * w)
+    l2 = jnp.sqrt(jnp.sum(diff**2 * w) / jnp.sum(ref_int**2 * w))
+    linf = jnp.max(jnp.abs(diff)) / jnp.max(jnp.abs(ref_int))
+    return {"l1": l1, "l2": l2, "linf": linf}

@@ -1,0 +1,24 @@
+"""Structured logging (replaces the reference's print banners,
+``JAX-DevLab-Examples.py:26-28,59-85,218,235,245`` — SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "jaxstream") -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("JAXSTREAM_LOG", "INFO").upper()
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        root = logging.getLogger("jaxstream")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(name if name.startswith("jaxstream") else f"jaxstream.{name}")
