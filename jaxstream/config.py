@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Optional
+from typing import Any
 
 import yaml
 
@@ -46,9 +46,10 @@ class ParallelConfig:
     tiles_per_edge: int = 1
     num_devices: int = 6
     device_type: str = "cpu"         # 'cpu' (virtual devices) | 'tpu' | 'gpu'
-    # Extensions.
-    use_shard_map: bool = False      # explicit ppermute path vs GSPMD
-    panel_axis: Optional[int] = None  # device-mesh panel dim (auto if None)
+    # Extension: explicit shard_map+ppermute stepping (needs num_devices=6,
+    # one face per device) instead of the GSPMD-inferred path.  Honored by
+    # jaxstream.parallel.sharded_model.make_stepper_for.
+    use_shard_map: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

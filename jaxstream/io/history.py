@@ -1,0 +1,98 @@
+"""History / geometry / IC output — the pipeline's zarr boxes (deck p.6).
+
+``HistoryWriter`` appends prognostic-state snapshots along an unlimited
+time dimension; ``save_geometry`` persists the mesh/metric arrays; both
+write the zarr-v2 directory format via :mod:`jaxstream.io.zarrlite`
+(openable by the real zarr/xarray stack).
+
+Device arrays are fetched with ``np.asarray`` at write time — keep the
+write stride coarse (the solver's history output is the only
+host<->device transfer in the loop, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..geometry.cubed_sphere import CubedSphereGrid
+from .zarrlite import ZarrGroup, open_group
+
+__all__ = ["HistoryWriter", "save_geometry", "load_geometry_arrays"]
+
+
+class HistoryWriter:
+    """Append state snapshots to a zarr group with a record time axis."""
+
+    def __init__(self, path: str, attrs: Optional[Dict] = None):
+        if os.path.exists(os.path.join(path, ".zgroup")):
+            self.group = open_group(path)
+            tarr = self.group["time"]
+            self._len = tarr.shape[0]
+        else:
+            self.group = ZarrGroup.create(
+                path, {**(attrs or {}), "conventions": "jaxstream-history-1"}
+            )
+            self._len = 0
+
+    def append(self, state: Dict, t: float) -> int:
+        """Write one snapshot; returns its record index."""
+        i = self._len
+        if "time" not in self.group:
+            self.group.create_array(
+                "time", shape=(0,), dtype=np.float64, chunks=(1,)
+            )
+        tarr = self.group["time"]
+        tarr.write_index0(i, np.asarray(float(t)))
+        for name, arr in state.items():
+            a = np.asarray(arr)
+            if name not in self.group:
+                self.group.create_array(
+                    name,
+                    shape=(0,) + a.shape,
+                    dtype=a.dtype,
+                    chunks=(1,) + a.shape,
+                )
+            self.group[name].write_index0(i, a)
+        self._len = i + 1
+        return i
+
+    def read(self, name: str) -> np.ndarray:
+        return self.group[name].read()
+
+    @property
+    def times(self) -> np.ndarray:
+        return self.group["time"].read() if "time" in self.group else np.array([])
+
+    def __len__(self) -> int:
+        return self._len
+
+
+def save_geometry(path: str, grid: CubedSphereGrid) -> None:
+    """Persist every array field of the grid plus its scalar metadata."""
+    g = ZarrGroup.create(
+        path,
+        {
+            "n": grid.n,
+            "halo": grid.halo,
+            "radius": grid.radius,
+            "dalpha": grid.dalpha,
+            "conventions": "jaxstream-geometry-1",
+        },
+    )
+    for f in dataclasses.fields(grid):
+        v = getattr(grid, f.name)
+        if hasattr(v, "shape"):
+            a = np.asarray(v)
+            g.create_array(f.name, a.shape, a.dtype).write_full(a)
+
+
+def load_geometry_arrays(path: str) -> Dict[str, np.ndarray]:
+    """Read back the geometry arrays (plus attrs under key '__attrs__')."""
+    g = open_group(path)
+    out: Dict[str, np.ndarray] = {k: g[k].read() for k in g.keys()}
+    out["__attrs__"] = g.attrs  # type: ignore[assignment]
+    return out

@@ -1,0 +1,224 @@
+"""Minimal zarr-v2 directory store — writer/reader, zero dependencies.
+
+The reference pipeline persists geometry, initial conditions, and history
+as zarr (deck p.6: three "jax.zarr" boxes).  The ``zarr`` package is not
+in this image, so this module implements the on-disk **zarr v2 spec**
+directly (``.zgroup``/``.zarray``/``.zattrs`` JSON + C-order raw chunk
+files, ``compressor: null``): directories written here open unchanged
+with the real ``zarr``/xarray stack, and vice versa for uncompressed
+v2 stores.
+
+Scope: C-order, little-endian dtypes, no compressor, no filters — the
+right trade for simulation output on a parallel filesystem (XLA device
+arrays stream straight to disk with no codec pass).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ZarrGroup", "ZarrArray", "open_group"]
+
+_FILL = {"f": 0.0, "i": 0, "u": 0, "b": False}
+
+
+def _dtype_str(dt: np.dtype) -> str:
+    dt = np.dtype(dt)
+    if dt.byteorder == "=":
+        return "<" + dt.str[1:] if dt.itemsize > 1 else "|" + dt.str[1:]
+    return dt.str
+
+
+class ZarrArray:
+    """One zarr-v2 array (chunked, uncompressed)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, ".zarray")) as fh:
+            self.meta = json.load(fh)
+        self.shape = tuple(self.meta["shape"])
+        self.chunks = tuple(self.meta["chunks"])
+        self.dtype = np.dtype(self.meta["dtype"])
+
+    # -- creation ------------------------------------------------------------
+    @staticmethod
+    def create(
+        path: str,
+        shape: Sequence[int],
+        dtype,
+        chunks: Optional[Sequence[int]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> "ZarrArray":
+        os.makedirs(path, exist_ok=True)
+        dtype = np.dtype(dtype)
+        chunks = tuple(chunks) if chunks else tuple(shape)
+        meta = {
+            "zarr_format": 2,
+            "shape": list(shape),
+            "chunks": list(int(c) for c in chunks),
+            "dtype": _dtype_str(dtype),
+            "compressor": None,
+            "fill_value": _FILL.get(dtype.kind, 0),
+            "order": "C",
+            "filters": None,
+        }
+        with open(os.path.join(path, ".zarray"), "w") as fh:
+            json.dump(meta, fh, indent=1)
+        if attrs:
+            with open(os.path.join(path, ".zattrs"), "w") as fh:
+                json.dump(attrs, fh, indent=1)
+        return ZarrArray(path)
+
+    # -- chunk addressing ----------------------------------------------------
+    def _grid(self) -> Tuple[int, ...]:
+        return tuple(
+            -(-s // c) for s, c in zip(self.shape, self.chunks)
+        )
+
+    def _chunk_file(self, idx: Tuple[int, ...]) -> str:
+        return os.path.join(self.path, ".".join(str(i) for i in idx))
+
+    # -- I/O -----------------------------------------------------------------
+    def write_full(self, data: np.ndarray) -> None:
+        """Write the entire array (any chunking)."""
+        data = np.ascontiguousarray(data, dtype=self.dtype)
+        if data.shape != self.shape:
+            raise ValueError(f"shape {data.shape} != array {self.shape}")
+        for idx in np.ndindex(*self._grid()):
+            sel = tuple(
+                slice(i * c, min((i + 1) * c, s))
+                for i, c, s in zip(idx, self.chunks, self.shape)
+            )
+            block = data[sel]
+            # Pad partial edge chunks to full chunk shape (zarr v2 layout).
+            if block.shape != self.chunks:
+                full = np.full(self.chunks, self.meta["fill_value"],
+                               dtype=self.dtype)
+                full[tuple(slice(0, e) for e in block.shape)] = block
+                block = full
+            with open(self._chunk_file(idx), "wb") as fh:
+                fh.write(np.ascontiguousarray(block).tobytes())
+
+    def write_index0(self, i: int, data: np.ndarray) -> None:
+        """Write one slab along axis 0 (requires chunks[0] == 1)."""
+        if self.chunks[0] != 1:
+            raise ValueError("write_index0 needs chunks[0] == 1")
+        # NB: not ascontiguousarray — that would promote 0-d slabs to 1-d.
+        data = np.asarray(data, dtype=self.dtype)
+        if data.shape != self.shape[1:]:
+            raise ValueError(f"slab shape {data.shape} != {self.shape[1:]}")
+        if i >= self.shape[0]:  # grow along the record dimension
+            self.resize0(i + 1)
+        grid_rest = tuple(
+            -(-s // c) for s, c in zip(self.shape[1:], self.chunks[1:])
+        )
+        for rest in np.ndindex(*grid_rest):
+            sel = tuple(
+                slice(j * c, min((j + 1) * c, s))
+                for j, c, s in zip(rest, self.chunks[1:], self.shape[1:])
+            )
+            block = data[sel]
+            if block.shape != tuple(self.chunks[1:]):
+                full = np.full(self.chunks[1:], self.meta["fill_value"],
+                               dtype=self.dtype)
+                full[tuple(slice(0, e) for e in block.shape)] = block
+                block = full
+            with open(self._chunk_file((i,) + rest), "wb") as fh:
+                fh.write(np.ascontiguousarray(block[None]).tobytes())
+
+    def resize0(self, new_len: int) -> None:
+        self.shape = (new_len,) + self.shape[1:]
+        self.meta["shape"] = list(self.shape)
+        with open(os.path.join(self.path, ".zarray"), "w") as fh:
+            json.dump(self.meta, fh, indent=1)
+
+    def read(self) -> np.ndarray:
+        out = np.full(self.shape, self.meta["fill_value"], dtype=self.dtype)
+        cshape = self.chunks
+        for idx in np.ndindex(*self._grid()):
+            f = self._chunk_file(idx)
+            if not os.path.exists(f):
+                continue
+            block = np.frombuffer(
+                open(f, "rb").read(), dtype=self.dtype
+            ).reshape(cshape)
+            sel = tuple(
+                slice(i * c, min((i + 1) * c, s))
+                for i, c, s in zip(idx, cshape, self.shape)
+            )
+            out[sel] = block[tuple(slice(0, s.stop - s.start) for s in sel)]
+        return out
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".zattrs")
+        if os.path.exists(p):
+            with open(p) as fh:
+                return json.load(fh)
+        return {}
+
+
+class ZarrGroup:
+    """A zarr-v2 group: nested arrays/groups + attributes."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @staticmethod
+    def create(path: str, attrs: Optional[Dict[str, Any]] = None) -> "ZarrGroup":
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, ".zgroup"), "w") as fh:
+            json.dump({"zarr_format": 2}, fh)
+        if attrs:
+            with open(os.path.join(path, ".zattrs"), "w") as fh:
+                json.dump(attrs, fh, indent=1)
+        return ZarrGroup(path)
+
+    def create_array(self, name: str, shape, dtype, chunks=None, attrs=None):
+        return ZarrArray.create(
+            os.path.join(self.path, name), shape, dtype, chunks, attrs
+        )
+
+    def create_group(self, name: str, attrs=None) -> "ZarrGroup":
+        return ZarrGroup.create(os.path.join(self.path, name), attrs)
+
+    def __getitem__(self, name: str):
+        p = os.path.join(self.path, name)
+        if os.path.exists(os.path.join(p, ".zarray")):
+            return ZarrArray(p)
+        if os.path.exists(os.path.join(p, ".zgroup")):
+            return ZarrGroup(p)
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        p = os.path.join(self.path, name)
+        return os.path.exists(os.path.join(p, ".zarray")) or os.path.exists(
+            os.path.join(p, ".zgroup")
+        )
+
+    def keys(self):
+        if not os.path.isdir(self.path):
+            return
+        for name in sorted(os.listdir(self.path)):
+            if name.startswith("."):
+                continue
+            if name in self:
+                yield name
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".zattrs")
+        if os.path.exists(p):
+            with open(p) as fh:
+                return json.load(fh)
+        return {}
+
+
+def open_group(path: str) -> ZarrGroup:
+    if not os.path.exists(os.path.join(path, ".zgroup")):
+        raise FileNotFoundError(f"no zarr group at {path}")
+    return ZarrGroup(path)

@@ -69,18 +69,22 @@ class Model:
         """
         # Cache the compiled integrator: a fresh jit per call would retrace
         # and recompile the whole loop every run() (restarts, sweeps).
-        key = (nsteps, dt, t0, scheme, history_stride, id(snapshot))
+        # Keying on the snapshot object itself (not id()) keeps a strong
+        # reference, so a freed-and-reallocated callable can't alias a key.
+        # t0 is a *traced* argument, not part of the key: resuming a
+        # segmented run at a new start time reuses the compiled loop.
+        key = (nsteps, dt, scheme, history_stride, snapshot)
         fn = self._run_cache.get(key)
         if fn is None:
             step = self.make_step(dt, scheme)
             if history_stride > 0:
                 snap = snapshot or (lambda s: s)
                 fn = jax.jit(
-                    lambda y: integrate_with_history(
-                        step, y, t0, nsteps, dt, history_stride, snap
+                    lambda y, t: integrate_with_history(
+                        step, y, t, nsteps, dt, history_stride, snap
                     )
                 )
             else:
-                fn = jax.jit(lambda y: integrate(step, y, t0, nsteps, dt))
+                fn = jax.jit(lambda y, t: integrate(step, y, t, nsteps, dt))
             self._run_cache[key] = fn
-        return fn(state)
+        return fn(state, t0)

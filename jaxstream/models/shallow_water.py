@@ -118,13 +118,14 @@ class ShallowWater(Model):
         v_int = v_int - k * jnp.sum(v_int * k, axis=0)
         kxv = _cross(k, v_int)
         dv = -(zeta + self.fcor) * kxv - grad_b
-        # Project the tendency onto the tangent plane.
-        dv = dv - k * jnp.sum(dv * k, axis=0)
 
         if self.nu4 > 0.0:
             dh = dh + self._hyperdiffuse(h_ext)
-            dv = dv + jnp.stack(
-                [self._hyperdiffuse(v_ext[c]) for c in range(3)]
-            )
+            # Batched over the component axis (laplacian/exchange operate on
+            # trailing axes).  Componentwise Laplacian of a tangent field is
+            # not tangent on the sphere — add BEFORE the projection below.
+            dv = dv + self._hyperdiffuse(v_ext)
 
+        # Project the full tendency onto the tangent plane.
+        dv = dv - k * jnp.sum(dv * k, axis=0)
         return {"h": dh, "v": dv}

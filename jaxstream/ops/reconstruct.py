@@ -74,12 +74,11 @@ def plr_face_states(q, axis: int, h: int, n: int, limiter: str = "mc"):
     i = h..h+n) separates cells i-1 and i; returns ``(qL, qR)`` each of
     length n+1 along ``axis``.
     """
-    lim = LIMITERS[limiter]
+    if h < 2:
+        raise ValueError(f"PLR fluxes need halo >= 2, got halo={h}")
     # Slopes for cells h-1..h+n (n+2 of them).
-    c0 = _sl(q, h - 2, h + n, axis)
     c1 = _sl(q, h - 1, h + n + 1, axis)
-    c2 = _sl(q, h, h + n + 2, axis)
-    sigma = lim(c1 - c0, c2 - c1)
+    sigma = slope(_sl(q, h - 2, h + n + 2, axis), axis, limiter)
     recon_hi = c1 + 0.5 * sigma
     recon_lo = c1 - 0.5 * sigma
     qL = _sl(recon_hi, 0, n + 1, axis)  # upwind state from cell i-1

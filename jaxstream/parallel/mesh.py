@@ -45,6 +45,7 @@ class ShardingSetup:
     panel: int
     sy: int
     sx: int
+    use_shard_map: bool = False
 
     @property
     def scalar_spec(self) -> P:
@@ -64,8 +65,16 @@ def _pick_devices(kind: str, count: int):
     kind = (kind or "cpu").lower()
     if kind == "cpu":
         devs = jax.devices("cpu")
-    elif kind in ("tpu", "gpu", "axon", "default"):
+    elif kind == "default":
         devs = jax.devices()
+    elif kind in ("tpu", "gpu", "axon"):
+        try:
+            devs = jax.devices(kind)
+        except RuntimeError:
+            if kind != "tpu":
+                raise
+            # This image exposes the TPU through the 'axon' PJRT plugin.
+            devs = jax.devices("axon")
     else:
         raise ValueError(f"unknown device_type {kind!r}; use 'cpu', 'tpu' or 'gpu'")
     if len(devs) < count:
@@ -141,7 +150,8 @@ def setup_sharding(config: Any = None) -> ShardingSetup:
         "sharding: %d %s devices as mesh panel=%d y=%d x=%d (tiles_per_edge=%d)",
         d, par.device_type, p, sy, sx, t,
     )
-    return ShardingSetup(mesh=mesh, num_devices=d, panel=p, sy=sy, sx=sx)
+    return ShardingSetup(mesh=mesh, num_devices=d, panel=p, sy=sy, sx=sx,
+                         use_shard_map=par.use_shard_map)
 
 
 def shard_state(setup: ShardingSetup, state):

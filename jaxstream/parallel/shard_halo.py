@@ -1,0 +1,129 @@
+"""Explicit shard_map halo exchange: hand-scheduled ``lax.ppermute``.
+
+The TPU-native flagship communication path (SURVEY.md §2.6): instead of
+letting GSPMD infer collectives from whole-array updates (the reference's
+implicit model, ``/root/reference/JAX-DevLab-Examples.py:192-195``), the
+exchange runs *inside* ``jax.shard_map`` with the cube's 12 edge swaps
+lowered to four ``lax.ppermute`` collectives over the ``'panel'`` mesh
+axis — riding ICI with compile-time source/target pairs.
+
+The mapping is exact: the reference's 4-stage race-free schedule (deck
+p.9) is a proper edge coloring whose stages are perfect matchings on the
+6 faces, so each stage *is* one bijective ``ppermute`` — every device
+sends exactly one strip and receives exactly one strip per stage.  The
+race-freedom invariant the reference enforces by staging becomes a
+structural property of the collective.
+
+Per-device variation (which of my 4 edges participates this stage;
+whether the along-edge index reverses) cannot be Python control flow in
+an SPMD program, so it is carried as *data*: small ``(6, 4)`` parameter
+arrays sharded ``P('panel')``, selected with ``jnp.take``/``lax.switch``
+on the local scalar.  The program stays uniform; the data differs.
+
+Scope: one face per device along the panel axis (``panel=6``).  Sub-panel
+tiling (``tiles_per_edge > 1``) runs through the GSPMD path in
+:mod:`jaxstream.parallel.halo`; extending this explicit path to block
+meshes is roadmap work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..geometry.connectivity import build_connectivity, build_schedule
+from .halo import _fill_corners, read_strip, write_strip
+
+__all__ = ["ShardHaloProgram", "make_shard_halo_program"]
+
+
+class ShardHaloProgram:
+    """Static schedule + per-device parameters for the ppermute exchange.
+
+    Attributes:
+      perms: list of 4 permutation lists [(src, dst), ...] — one bijection
+        per stage, passed to ``lax.ppermute``.
+      edge_sel: (6, 4) int32, ``edge_sel[f, s]`` = which edge of face f
+        exchanges in stage s (my *send* strip and my *write* ghost edge).
+      rev_sel: (6, 4) bool, whether the pair's along-edge index reverses.
+    """
+
+    def __init__(self, axis_name: str = "panel"):
+        adj = build_connectivity()
+        schedule = build_schedule(adj)
+        self.axis_name = axis_name
+        self.perms = []
+        edge_sel = np.zeros((6, len(schedule)), dtype=np.int32)
+        rev_sel = np.zeros((6, len(schedule)), dtype=bool)
+        for s, stage in enumerate(schedule):
+            perm = []
+            for link, back in stage:
+                perm.append((link.face, link.nbr_face))
+                perm.append((back.face, back.nbr_face))
+                edge_sel[link.face, s] = link.edge
+                edge_sel[back.face, s] = back.edge
+                rev_sel[link.face, s] = link.reversed_
+                rev_sel[back.face, s] = back.reversed_
+            # Perfect matching => bijection on all 6 faces.
+            assert sorted(d for _, d in perm) == list(range(6))
+            self.perms.append(perm)
+        self.edge_sel = jnp.asarray(edge_sel)
+        self.rev_sel = jnp.asarray(rev_sel)
+
+    @property
+    def params(self):
+        """The (6, 4) per-device parameter arrays; shard with P('panel')."""
+        return {"edge_sel": self.edge_sel, "rev_sel": self.rev_sel}
+
+
+def make_shard_halo_program(
+    n: int,
+    halo: int,
+    axis_name: str = "panel",
+    fill_corners: bool = True,
+):
+    """Build ``(program, local_exchange)`` for use inside ``shard_map``.
+
+    ``local_exchange(block, edge_sel, rev_sel)`` operates on a local
+    ``(..., 1, M, M)`` extended block (one face per device) with this
+    device's ``(1, 4)`` parameter rows, and performs the full cube-edge
+    halo fill in 4 ``ppermute`` stages.
+    """
+    program = ShardHaloProgram(axis_name)
+    perms = program.perms
+
+    def local_exchange(block, edge_sel, rev_sel):
+        if block.shape[-3] != 1:
+            raise ValueError(
+                f"shard-halo path expects one face per device; got local "
+                f"panel extent {block.shape[-3]} (use the GSPMD path in "
+                f"jaxstream.parallel.halo for other tilings)"
+            )
+        writers = [
+            functools.partial(write_strip, face=0, edge=e) for e in range(4)
+        ]
+        for s, perm in enumerate(perms):
+            e_s = edge_sel[0, s]
+            r_s = rev_sel[0, s]
+            # All 4 canonical strips; select mine for this stage by data.
+            strips = jnp.stack(
+                [read_strip(block, 0, e, halo, n) for e in range(4)]
+            )
+            strip = jnp.take(strips, e_s, axis=0)
+            strip = jnp.where(r_s, jnp.flip(strip, axis=-1), strip)
+            strip = lax.ppermute(strip, axis_name, perm)
+            block = lax.switch(
+                e_s, [lambda b, st, w=w: w(b, strip=st) for w in writers],
+                block, strip,
+            )
+        if fill_corners:
+            block = _fill_corners(block, halo, n)
+        return block
+
+    return program, local_exchange

@@ -1,0 +1,120 @@
+"""Run a whole model step inside ``shard_map`` (explicit-collective path).
+
+This composes :mod:`jaxstream.parallel.shard_halo` with the model layer:
+the full SSPRK3 step — ghost fills via ``lax.ppermute``, FV stencils on
+local blocks — executes as one SPMD program over the ``('panel','y','x')``
+mesh, under a single top-level ``jit``.  This is the "hand-scheduled
+collectives preserving the reference's race-free staging" design
+(SURVEY.md §2.6) as opposed to the GSPMD-inferred path used by default.
+
+Mechanics: every face-indexed array the model owns (grid metric terms,
+Coriolis, topography, ...) is passed into ``shard_map`` as a sharded
+argument; inside, a shallow-copied model is rebound to the local shards
+and its unchanged ``rhs`` runs on ``(..., 1, M, M)`` blocks — the numerics
+code is identical between the single-device, GSPMD, and explicit paths
+(one source of truth, three execution strategies).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..geometry.cubed_sphere import CubedSphereGrid
+from ..stepping import SCHEMES
+from .mesh import ShardingSetup
+from .shard_halo import make_shard_halo_program
+
+__all__ = ["make_sharded_stepper", "make_stepper_for", "shard_params"]
+
+
+def make_stepper_for(model, setup, example_state, dt: float,
+                     scheme: str = "ssprk3"):
+    """Dispatch on the config's ``use_shard_map`` flag.
+
+    Explicit ppermute path when requested (and the mesh fits), otherwise
+    the GSPMD path: plain ``jit`` over the model step — sharded inputs
+    make XLA infer the collectives (the reference's implicit model).
+    """
+    if setup is not None and setup.use_shard_map:
+        return make_sharded_stepper(model, setup, example_state, dt, scheme)
+    return jax.jit(model.make_step(dt, scheme))
+
+
+def _grid_arrays(grid: CubedSphereGrid):
+    out = {}
+    for f in dataclasses.fields(grid):
+        v = getattr(grid, f.name)
+        if isinstance(v, jax.Array):
+            out[f.name] = v
+    return out
+
+
+def _face_spec(a) -> P:
+    """PartitionSpec for an array whose trailing axes are (6, ny, nx)."""
+    if a.ndim == 2:  # (6, 4) per-device parameter tables
+        return P("panel", None)
+    return P(*((None,) * (a.ndim - 3) + ("panel", "y", "x")))
+
+
+def shard_params(setup: ShardingSetup, tree):
+    """device_put a pytree of face-axis arrays with P('panel', ...)."""
+    mesh = setup.mesh
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, _face_spec(a))), tree
+    )
+
+
+def make_sharded_stepper(model, setup: ShardingSetup, example_state,
+                         dt: float, scheme: str = "ssprk3"):
+    """Build ``step(state, t) -> state`` running fully inside shard_map.
+
+    Requires the explicit-path mesh shape: panel axis of size 6, one face
+    per device (``sy = sx = 1``); state arrays are the usual interior
+    ``(6, n, n)`` / ``(3, 6, n, n)`` pytrees sharded over 'panel'.
+    ``example_state`` is only read for its tree structure/ranks.
+    """
+    if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
+        raise ValueError(
+            f"explicit shard_map path needs mesh (panel=6, y=1, x=1); got "
+            f"panel={setup.panel}, y={setup.sy}, x={setup.sx}. Use the "
+            f"GSPMD path (jax.jit over NamedSharding) for other layouts."
+        )
+    mesh = setup.mesh
+    grid = model.grid
+    program, local_exchange = make_shard_halo_program(grid.n, grid.halo)
+
+    garrs = _grid_arrays(grid)
+    aux = {k: v for k, v in vars(model).items()
+           if isinstance(v, jax.Array) and v.ndim >= 3}
+    params = {"grid": garrs, "aux": aux, "halo": dict(program.params)}
+    params = shard_params(setup, params)
+    stepper = SCHEMES[scheme]
+
+    def local_step(p, state, t):
+        grid_l = dataclasses.replace(grid, **p["grid"])
+        m = copy.copy(model)
+        m.grid = grid_l
+        for k, v in p["aux"].items():
+            setattr(m, k, v)
+        es, rs = p["halo"]["edge_sel"], p["halo"]["rev_sel"]
+        m.exchange = lambda f: local_exchange(f, es, rs)
+        return stepper(m.rhs, state, t, dt)
+
+    state_specs = jax.tree_util.tree_map(_face_spec, example_state)
+    in_specs = (jax.tree_util.tree_map(_face_spec, params), state_specs, P())
+
+    smapped = jax.shard_map(
+        local_step, mesh=mesh, in_specs=in_specs, out_specs=state_specs,
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state, t):
+        return smapped(params, state, t)
+
+    return step

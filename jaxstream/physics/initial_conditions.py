@@ -18,6 +18,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from ..config import EARTH_RADIUS
 from ..geometry.cubed_sphere import CubedSphereGrid
 
 __all__ = [
@@ -116,7 +117,7 @@ def williamson_tc2(
     grid: CubedSphereGrid,
     gravity: float,
     omega: float,
-    u0: float = 2 * np.pi * 6.37122e6 / (12 * 86400),
+    u0: float = 2 * np.pi * EARTH_RADIUS / (12 * 86400),
     gh0: float = 2.94e4,
     alpha_rot: float = 0.0,
 ):

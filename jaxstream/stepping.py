@@ -76,8 +76,10 @@ def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float):
         y, t = carry
         return step(y, t), t + dt
 
+    # dtype=float -> float64 under jax_enable_x64, else float32: long runs
+    # in x64 mode keep full time resolution (t ~ 1e6 s overwhelms f32 ulp).
     y, t = jax.lax.fori_loop(
-        0, nsteps, body, (y0, jnp.asarray(t0, dtype=jnp.float32))
+        0, nsteps, body, (y0, jnp.asarray(t0, dtype=float))
     )
     return y, t
 
@@ -97,7 +99,7 @@ def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float
 
     nchunks, rem = divmod(nsteps, stride)
     (y, t), hist = jax.lax.scan(
-        chunk, (y0, jnp.asarray(t0, dtype=jnp.float32)), None, length=nchunks
+        chunk, (y0, jnp.asarray(t0, dtype=float)), None, length=nchunks
     )
     if rem:  # don't silently drop the trailing nsteps % stride steps
         y, t = jax.lax.fori_loop(0, rem, body, (y, t))

@@ -1,0 +1,83 @@
+"""I/O: zarr-v2 store round-trips, history appends, Orbax checkpoints."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.io.checkpoint import CheckpointManager
+from jaxstream.io.history import HistoryWriter, load_geometry_arrays, save_geometry
+from jaxstream.io.zarrlite import ZarrArray, ZarrGroup, open_group
+
+
+def test_zarr_array_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    for shape, chunks in [((6, 10, 10), None), ((5, 7), (2, 3)),
+                          ((4,), (4,)), ((3, 5, 2, 2), (1, 5, 2, 2))]:
+        a = rng.normal(size=shape).astype(np.float32)
+        p = str(tmp_path / f"arr_{len(shape)}_{chunks is None}")
+        za = ZarrArray.create(p, a.shape, a.dtype, chunks)
+        za.write_full(a)
+        np.testing.assert_array_equal(ZarrArray(p).read(), a)
+
+
+def test_zarr_v2_metadata_is_spec_shaped(tmp_path):
+    p = str(tmp_path / "g")
+    g = ZarrGroup.create(p, {"hello": 1})
+    g.create_array("x", (4, 6), np.float64, (2, 3))
+    meta = json.load(open(os.path.join(p, "x", ".zarray")))
+    assert meta["zarr_format"] == 2
+    assert meta["compressor"] is None
+    assert meta["order"] == "C"
+    assert meta["dtype"] == "<f8"
+    assert json.load(open(os.path.join(p, ".zgroup"))) == {"zarr_format": 2}
+
+
+def test_history_append_and_reopen(tmp_path):
+    p = str(tmp_path / "hist")
+    w = HistoryWriter(p, attrs={"case": "tc2"})
+    s0 = {"h": np.full((6, 4, 4), 1.0, np.float32)}
+    s1 = {"h": np.full((6, 4, 4), 2.0, np.float32)}
+    assert w.append(s0, 0.0) == 0
+    assert w.append(s1, 600.0) == 1
+    # Re-open and keep appending.
+    w2 = HistoryWriter(p)
+    assert len(w2) == 2
+    w2.append({"h": np.full((6, 4, 4), 3.0, np.float32)}, 1200.0)
+    h = w2.read("h")
+    assert h.shape == (3, 6, 4, 4)
+    np.testing.assert_allclose(h[:, 0, 0, 0], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(w2.times, [0.0, 600.0, 1200.0])
+    assert w2.group.attrs["case"] == "tc2"
+
+
+def test_geometry_roundtrip(tmp_path):
+    grid = build_grid(6, halo=2, dtype=jnp.float32)
+    p = str(tmp_path / "geom")
+    save_geometry(p, grid)
+    back = load_geometry_arrays(p)
+    assert back["__attrs__"]["n"] == 6
+    np.testing.assert_array_equal(back["sqrtg"], np.asarray(grid.sqrtg))
+    np.testing.assert_array_equal(back["xyz"], np.asarray(grid.xyz))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    state = {
+        "h": jnp.arange(6 * 4 * 4, dtype=jnp.float32).reshape(6, 4, 4),
+        "v": jnp.ones((3, 6, 4, 4), dtype=jnp.float32),
+    }
+    mgr.save(10, state, t=6000.0)
+    mgr.save(20, state, t=12000.0)
+    assert mgr.latest_step() == 20
+    restored, t = mgr.restore()
+    assert t == 12000.0
+    np.testing.assert_array_equal(np.asarray(restored["h"]),
+                                  np.asarray(state["h"]))
+    # Restore a specific step.
+    r10, t10 = CheckpointManager(str(tmp_path / "ckpt")).restore(10)
+    assert t10 == 6000.0

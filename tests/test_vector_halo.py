@@ -1,0 +1,77 @@
+"""Vector (rotated) halo exchange vs the Cartesian-component route.
+
+The rotation matrices satisfy T @ (u^a', u^b')_nbr = a^local . v_cart
+identically, so exchanging contravariant components must agree with
+exchanging the Cartesian vector and projecting — to roundoff.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.parallel.halo import make_halo_exchanger
+from jaxstream.parallel.vector_halo import (
+    make_vector_halo_exchanger,
+    to_cartesian,
+    to_contravariant,
+)
+
+
+def _tangent_field(grid):
+    """A smooth global tangent vector field (f64-safe)."""
+    x, y, z = (np.asarray(grid.xyz[i]) for i in range(3))
+    w = np.stack([y * z + 0.3, z * x - 0.1, x * y + 0.2])  # arbitrary smooth
+    k = np.asarray(grid.khat)
+    w = w - k * (w * k).sum(axis=0)
+    return jnp.asarray(w)
+
+
+def _ghost_mask(n, halo):
+    m = n + 2 * halo
+    mask = np.zeros((m, m), dtype=bool)
+    mask[:halo, halo:halo + n] = True
+    mask[halo + n:, halo:halo + n] = True
+    mask[halo:halo + n, :halo] = True
+    mask[halo:halo + n, halo + n:] = True
+    return mask
+
+
+def test_rotated_exchange_matches_cartesian_route():
+    n, halo = 12, 2
+    grid = build_grid(n, halo=halo, dtype=jnp.float64)
+    v = _tangent_field(grid)
+
+    cart_ex = make_halo_exchanger(n, halo, fill_corners=False)
+    vec_ex = make_vector_halo_exchanger(grid, fill_corners=False)
+
+    # Route A: exchange Cartesian components, then project locally.
+    v_ex = cart_ex(v)
+    uv_a = to_contravariant(grid, v_ex)
+
+    # Route B: project locally, then exchange with rotation.
+    uv = to_contravariant(grid, v)
+    uv_b = vec_ex(uv)
+
+    mask = _ghost_mask(n, halo)
+    diff = np.abs(np.asarray(uv_a) - np.asarray(uv_b))[:, :, mask]
+    scale = np.abs(np.asarray(uv_a))[:, :, mask].max()
+    assert diff.max() <= 1e-12 * max(scale, 1.0)
+
+
+def test_roundtrip_contravariant_cartesian():
+    grid = build_grid(8, halo=2, dtype=jnp.float64)
+    v = _tangent_field(grid)
+    v2 = to_cartesian(grid, to_contravariant(grid, v))
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(v), atol=1e-12)
+
+
+def test_vector_exchanger_rejects_bad_shape():
+    grid = build_grid(8, halo=2, dtype=jnp.float64)
+    ex = make_vector_halo_exchanger(grid)
+    try:
+        ex(jnp.zeros((3, 6, grid.m, grid.m)))
+    except ValueError as e:
+        assert "expects" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
