@@ -63,17 +63,24 @@ def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=200):
     state = model.initial_state(h_ext, v_ext)
 
     step = model.make_step(dt, "ssprk3")
-    run_warm = jax.jit(lambda y: integrate(step, y, 0.0, warm_steps, dt))
-    run_timed = jax.jit(lambda y: integrate(step, y, 0.0, timed_steps, dt))
+
+    # One compiled executable for any step count: nsteps rides the carry as
+    # a traced bound (fori_loop lowers to a while), so the timed region is
+    # pure device execution — no recompile between warmup and timing (the
+    # reference's "no recompilation during timestepping" invariant, deck
+    # p.10, applied to the benchmark harness itself).
+    run = jax.jit(
+        lambda y, nsteps: integrate(step, y, 0.0, nsteps, dt), donate_argnums=0
+    )
 
     t0 = time.perf_counter()
-    state_w, _ = run_warm(state)
+    state_w, _ = run(state, warm_steps)
     jax.block_until_ready(state_w)
     log(f"bench: warmup {warm_steps} steps (incl. compile) "
         f"{time.perf_counter() - t0:.1f}s on {jax.devices()[0].platform}")
 
     t0 = time.perf_counter()
-    out, _ = run_timed(state_w)
+    out, _ = run(state_w, timed_steps)
     jax.block_until_ready(out)
     wall = time.perf_counter() - t0
 
