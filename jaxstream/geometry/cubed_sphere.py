@@ -42,8 +42,11 @@ import jax.numpy as jnp
 __all__ = [
     "FACE_AXES",
     "NUM_FACES",
+    "extended_coords",
     "face_points",
+    "sphere_to_face_coords",
     "CubedSphereGrid",
+    "LazyCubedSphereGrid",
     "build_grid",
 ]
 
@@ -61,6 +64,43 @@ FACE_AXES = np.array(
     ],
     dtype=np.float64,
 )
+
+
+def extended_coords(n: int, halo: int):
+    """1-D equiangular coordinates of the halo-extended grid (float64).
+
+    Returns ``(ac, af, d)``: cell-center coords (M,), left-face coords
+    (M,), and the spacing d = (pi/2)/n.  Single source of truth for every
+    consumer (eager grid, lazy grid, Pallas kernels).
+    """
+    m = n + 2 * halo
+    d = (np.pi / 2) / n
+    ac = -np.pi / 4 + (np.arange(m) - halo + 0.5) * d
+    return ac, ac - 0.5 * d, d
+
+
+def sphere_to_face_coords(xyz: np.ndarray):
+    """Inverse gnomonic map: unit vectors -> (face, alpha, beta).
+
+    ``xyz``: (..., 3) points on (or off — they are centrally projected to)
+    the unit sphere.  Returns ``(face, alpha, beta)`` with ``face`` int
+    (..., ), ``alpha``/``beta`` in [-pi/4, pi/4].  The owning face is the
+    one whose outward axis has the largest positive projection, which
+    partitions the sphere exactly (ties on edges resolve to the lowest
+    face index).  Used for lat/lon regridding (analysis/viz layer, deck
+    p.6, p.12-13) and observation sampling.
+    """
+    p = np.asarray(xyz, dtype=np.float64)
+    c0 = FACE_AXES[:, 0, :]                      # (6, 3)
+    proj = np.tensordot(p, c0, axes=([-1], [-1]))  # (..., 6)
+    face = np.argmax(proj, axis=-1)
+    fa = FACE_AXES[face]                          # (..., 3, 3)
+    d0 = np.sum(p * fa[..., 0, :], axis=-1)
+    dx = np.sum(p * fa[..., 1, :], axis=-1)
+    dy = np.sum(p * fa[..., 2, :], axis=-1)
+    alpha = np.arctan2(dx, d0)
+    beta = np.arctan2(dy, d0)
+    return face, alpha, beta
 
 
 def face_points(face: int, alpha: np.ndarray, beta: np.ndarray) -> np.ndarray:
@@ -186,19 +226,220 @@ class CubedSphereGrid:
         return float(jnp.sum(self.interior(self.area)))
 
 
+class LazyCubedSphereGrid:
+    """Metric terms computed on the fly from 1-D coordinate arrays.
+
+    The equiangular cubed-sphere metric is *rank-1 separable*: with
+    ``X = tan(alpha)`` varying only along columns and ``Y = tan(beta)``
+    only along rows, every metric quantity is a closed-form elementwise
+    function of broadcast 1-D arrays plus per-face constant frames.
+    Storing the full ``(3, 6, M, M)`` basis arrays (as
+    :class:`CubedSphereGrid` does) makes the FV stencils HBM-bound on
+    *geometry* traffic; recomputing them inside the traced step costs a few
+    dozen VPU flops per cell — the canonical TPU trade (HBM bandwidth is
+    the scarce resource, deck p.19's roofline: FV-PLR AI ~ 0.25 flops/byte).
+    XLA fuses the broadcasts into the consuming stencil kernels and CSEs
+    repeated uses within one trace, so each quantity is materialized at
+    most once per fusion, streamed from registers not HBM.
+
+    Exposes the same attribute surface as :class:`CubedSphereGrid`; each
+    metric attribute is a property that emits (traceable) jnp expressions.
+    """
+
+    def __init__(self, n: int, halo: int, radius: float, dtype):
+        self.n = n
+        self.halo = halo
+        self.radius = radius
+        self.dtype = dtype
+        ac, af, d = extended_coords(n, halo)
+        self.dalpha = d
+        # 1-D gnomonic coordinates (f64 tan, then cast) — the only stored
+        # geometry: 2 x (M,) vectors instead of ~20 x (6, M, M) fields.
+        self._xc = jnp.asarray(np.tan(ac), dtype=dtype)
+        self._xf = jnp.asarray(np.tan(af), dtype=dtype)
+        # Per-face frames as (3, 6, 1, 1) for component-leading broadcast.
+        fa = np.transpose(FACE_AXES, (2, 1, 0))[:, :, :, None, None]
+        self._c0 = jnp.asarray(fa[:, 0, :, :, :], dtype=dtype)
+        self._cx = jnp.asarray(fa[:, 1, :, :, :], dtype=dtype)
+        self._cy = jnp.asarray(fa[:, 2, :, :, :], dtype=dtype)
+
+    @property
+    def m(self) -> int:
+        return self.n + 2 * self.halo
+
+    def interior(self, field):
+        h = self.halo
+        return field[..., h : h + self.n, h : h + self.n]
+
+    def total_area(self) -> float:
+        return float(jnp.sum(self.interior(self.area)))
+
+    # -- core expression builders -------------------------------------------
+    def _xy(self, at: str):
+        """Broadcastable (1,1,M)/(1,M,1) X,Y for centers/x-faces/y-faces."""
+        xc = self._xc[None, None, :]
+        yc = self._xc[None, :, None]
+        if at == "cc":
+            return xc, yc
+        if at == "xf":
+            return self._xf[None, None, :], yc
+        if at == "yf":
+            return xc, self._xf[None, :, None]
+        raise ValueError(at)
+
+    def _basis(self, at: str):
+        """Dict of lazily-built metric expressions at cc/xf/yf points.
+
+        Same math as :func:`_basis_and_metric`, as jnp broadcasts; unused
+        entries are dead-code-eliminated by XLA.
+        """
+        x, y = self._xy(at)  # (1, 1|M, M|1) each
+        one = jnp.asarray(1.0, self.dtype)
+        rho2 = one + x * x + y * y
+        rho = jnp.sqrt(rho2)
+        # p: (3, 6, M, M) by broadcast; rhat = p / rho.
+        p = self._c0 + x[None] * self._cx + y[None] * self._cy
+        rhat = p / rho[None]
+        dx_da = one + x * x
+        dy_db = one + y * y
+        pc_x = jnp.sum(rhat * self._cx, axis=0)
+        pc_y = jnp.sum(rhat * self._cy, axis=0)
+        R = jnp.asarray(self.radius, self.dtype)
+        e_a = (R * dx_da / rho)[None] * (self._cx - rhat * pc_x[None])
+        e_b = (R * dy_db / rho)[None] * (self._cy - rhat * pc_y[None])
+        # Closed-form 2x2 metric of the equiangular map (avoids forming the
+        # dot products of e_a/e_b, keeping fusions small):
+        #   g_aa = R^2 (1+X^2)^2 (1+Y^2) / rho^4
+        #   g_bb = R^2 (1+X^2) (1+Y^2)^2 / rho^4
+        #   g_ab = -R^2 (1+X^2)(1+Y^2) X Y / rho^4
+        #   det  = R^4 (1+X^2)^2 (1+Y^2)^2 / rho^6 -> sqrtg = R^2 dxda dydb / rho^3
+        R2 = R * R
+        rho4 = rho2 * rho2
+        gcom = R2 * dx_da * dy_db / rho4
+        gaa = gcom * dx_da
+        gbb = gcom * dy_db
+        gab = -gcom * x * y
+        det = gaa * gbb - gab * gab
+        sqrtg = R2 * dx_da * dy_db / (rho2 * rho)
+        inv_aa = gbb / det
+        inv_ab = -gab / det
+        inv_bb = gaa / det
+        return {
+            "rhat": rhat,
+            "e_a": e_a,
+            "e_b": e_b,
+            "a_a": inv_aa[None] * e_a + inv_ab[None] * e_b,
+            "a_b": inv_ab[None] * e_a + inv_bb[None] * e_b,
+            # Face-independent, but consumers (zeros_like, stacking) expect
+            # the (6, M, M) face axis; broadcast_to stays lazy under XLA.
+            "sqrtg": jnp.broadcast_to(sqrtg, (NUM_FACES, self.m, self.m)),
+            "inv_gaa": inv_aa,
+            "inv_gab": inv_ab,
+            "inv_gbb": inv_bb,
+        }
+
+    # -- CubedSphereGrid-compatible attribute surface -----------------------
+    @property
+    def xyz(self):
+        return jnp.asarray(self.radius, self.dtype) * self._basis("cc")["rhat"]
+
+    @property
+    def khat(self):
+        return self._basis("cc")["rhat"]
+
+    @property
+    def lon(self):
+        r = self._basis("cc")["rhat"]
+        return jnp.arctan2(r[1], r[0])
+
+    @property
+    def lat(self):
+        r = self._basis("cc")["rhat"]
+        return jnp.arcsin(jnp.clip(r[2], -1.0, 1.0))
+
+    @property
+    def e_a(self):
+        return self._basis("cc")["e_a"]
+
+    @property
+    def e_b(self):
+        return self._basis("cc")["e_b"]
+
+    @property
+    def a_a(self):
+        return self._basis("cc")["a_a"]
+
+    @property
+    def a_b(self):
+        return self._basis("cc")["a_b"]
+
+    @property
+    def sqrtg(self):
+        return self._basis("cc")["sqrtg"]
+
+    @property
+    def area(self):
+        return self.sqrtg * jnp.asarray(self.dalpha * self.dalpha, self.dtype)
+
+    @property
+    def sqrtg_xf(self):
+        return self._basis("xf")["sqrtg"]
+
+    @property
+    def a_a_xf(self):
+        return self._basis("xf")["a_a"]
+
+    @property
+    def sqrtg_yf(self):
+        return self._basis("yf")["sqrtg"]
+
+    @property
+    def a_b_yf(self):
+        return self._basis("yf")["a_b"]
+
+    @property
+    def ginv_aa_xf(self):
+        return self._basis("xf")["inv_gaa"]
+
+    @property
+    def ginv_ab_xf(self):
+        return self._basis("xf")["inv_gab"]
+
+    @property
+    def ginv_bb_yf(self):
+        return self._basis("yf")["inv_gbb"]
+
+    @property
+    def ginv_ab_yf(self):
+        return self._basis("yf")["inv_gab"]
+
+
 def build_grid(
     n: int,
     halo: int = 2,
     radius: float = 1.0,
     dtype=jnp.float32,
-) -> CubedSphereGrid:
-    """Build the grid: all metric terms in float64, cast to ``dtype``."""
-    m = n + 2 * halo
-    d = (np.pi / 2) / n
-    # Cell-center coords of the extended grid (halo cells extend past +-pi/4).
-    ac = -np.pi / 4 + (np.arange(m) - halo + 0.5) * d
-    # Left-face coords (face i = left face of extended cell i).
-    af = ac - 0.5 * d
+    metrics: str = "eager",
+):
+    """Build the grid geometry.
+
+    ``metrics='eager'`` (default) returns a :class:`CubedSphereGrid` whose
+    metric terms are precomputed in float64 and cast to ``dtype`` — the
+    accuracy reference, and the right choice for low-precision ``dtype``
+    experiments (bfloat16 values are still f64-rounded).
+
+    ``metrics='lazy'`` returns a :class:`LazyCubedSphereGrid` whose metric
+    terms are recomputed (and fused) inside the traced step instead of
+    streamed from HBM — the fast path for TPU production runs.  The whole
+    metric chain then evaluates in ``dtype``; use float32 or wider (the
+    f32-vs-f64 agreement is ~1e-6 relative, tests/test_lazy_metrics.py).
+    """
+    if metrics == "lazy":
+        return LazyCubedSphereGrid(n, halo, radius, dtype)
+    if metrics != "eager":
+        raise ValueError(f"metrics must be 'eager' or 'lazy', got {metrics!r}")
+    # Centers/left-faces of the extended grid (halos extend past +-pi/4).
+    ac, af, d = extended_coords(n, halo)
 
     cc: dict[str, list] = {k: [] for k in ("xyz", "khat", "e_a", "e_b", "a_a", "a_b", "sqrtg")}
     xf: dict[str, list] = {k: [] for k in ("sqrtg", "a_a", "inv_gaa", "inv_gab")}

@@ -65,6 +65,7 @@ class ShallowWater(Model):
         scheme: str = "plr",
         limiter: str = "mc",
         nu4: float = 0.0,
+        backend: str = "jnp",
     ):
         super().__init__(grid)
         if scheme == "ppm" and grid.halo < 3:
@@ -74,6 +75,21 @@ class ShallowWater(Model):
         self.scheme = scheme
         self.limiter = limiter
         self.nu4 = nu4
+        # backend='pallas' fuses the whole stencil section of the RHS into
+        # one TPU kernel per face (jaxstream.ops.pallas.swe_rhs); 'jnp' is
+        # the reference implementation and parity oracle.
+        if backend not in ("jnp", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self._pallas_rhs = None
+        if backend.startswith("pallas"):
+            from ..ops.pallas.swe_rhs import make_swe_rhs_pallas
+
+            self._pallas_rhs = make_swe_rhs_pallas(
+                grid.n, grid.halo, grid.dalpha, grid.radius,
+                gravity, omega, scheme=scheme, limiter=limiter,
+                interpret=(backend == "pallas_interpret"),
+            )
+        self.backend = backend
         # Coriolis parameter f = 2 Omega sin(lat) at interior centers.
         self.fcor = 2.0 * omega * jnp.sin(grid.interior(grid.lat))
         self.khat_int = grid.interior(grid.khat)
@@ -100,6 +116,16 @@ class ShallowWater(Model):
 
         h_ext = self.fill(state["h"])
         v_ext = self.fill(state["v"])
+
+        if self._pallas_rhs is not None:
+            dh, dv = self._pallas_rhs(h_ext, v_ext, self.b_ext)
+            if self.nu4 > 0.0:
+                dh = dh + self._hyperdiffuse(h_ext)
+                dv_hyp = self._hyperdiffuse(v_ext)
+                kk = self.khat_int
+                dv_hyp = dv_hyp - kk * jnp.sum(dv_hyp * kk, axis=0)
+                dv = dv + dv_hyp
+            return {"h": dh, "v": dv}
 
         # Continuity: dh/dt = -div(h v).
         dh = -flux_divergence(

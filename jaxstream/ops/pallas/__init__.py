@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot FV stencils.
+
+The performance-critical stencil path (SURVEY.md §7 step 6: "flux
+-divergence, Coriolis, PPM advection stencils as Pallas TPU kernels behind
+a flag (pure-JAX fallback retained for parity testing)").
+"""
+
+from .swe_rhs import make_swe_rhs_pallas
+
+__all__ = ["make_swe_rhs_pallas"]

@@ -1,0 +1,266 @@
+"""Fused Pallas TPU kernel for the shallow-water RHS.
+
+The reference's numerics are memory-bound ("Traditional FV-PLR ... AI ~0.25
+flops/byte", deck p.19), so the TPU-native answer is *fusion*: one Pallas
+kernel per cubed-sphere face computes the complete SWE right-hand side —
+contravariant face velocities, PLR-upwind fluxes, divergence, vorticity,
+Bernoulli gradient, Coriolis — in VMEM, reading the (already ghost-filled)
+state exactly once from HBM and writing only the tendencies.  No stencil
+intermediate ever round-trips through HBM.
+
+Geometry is not read from memory at all: the equiangular metric is rank-1
+separable (see :class:`jaxstream.geometry.cubed_sphere.LazyCubedSphereGrid`),
+so the kernel rebuilds every basis vector from two (1, M) gnomonic
+coordinate rows plus a per-face 3x3 frame in SMEM — a few dozen VPU flops
+per cell in exchange for ~100 MB/step of HBM traffic.
+
+Numerics are identical (to f32 roundoff) to the pure-JAX path in
+:mod:`jaxstream.ops.fv` — the PLR/PPM reconstructions are literally the
+same code (:mod:`jaxstream.ops.reconstruct` is axis-agnostic jnp and traces
+fine inside a Pallas kernel).  The pure-JAX path stays the reference
+implementation and the parity-test oracle (SURVEY.md §7: Pallas kernels
+"flag-switched, numerics-identical").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...geometry.cubed_sphere import FACE_AXES, extended_coords
+from ..reconstruct import plr_face_states, ppm_face_states
+
+__all__ = ["make_swe_rhs_pallas"]
+
+
+def _frame_scalars(ref, k):
+    """Read one 3-vector of a face frame from SMEM as Python-level scalars."""
+    return ref[0, k, 0], ref[0, k, 1], ref[0, k, 2]
+
+
+def _basis(xr, yc, c0, cx, cy, radius, need):
+    """Metric quantities on the grid xr x yc (broadcast (1,mx) x (my,1)).
+
+    ``c0``/``cx``/``cy`` are tuples of 3 scalars (the face frame).  Returns
+    a dict restricted to ``need`` — everything is closed-form in
+    X = tan(alpha), Y = tan(beta) (same math as LazyCubedSphereGrid._basis,
+    specialized to scalar frame components so it vectorizes on the VPU
+    without a leading component axis).
+    """
+    one = jnp.float32(1.0)
+    R = jnp.float32(radius)
+    x2 = xr * xr
+    y2 = yc * yc
+    rho2 = one + x2 + y2
+    rho = jnp.sqrt(rho2)
+    inv_rho = one / rho
+    dxda = one + x2
+    dydb = one + y2
+
+    out = {}
+    p = [c0[i] + xr * cx[i] + yc * cy[i] for i in range(3)]
+    rhat = [p[i] * inv_rho for i in range(3)]
+    if "rhat" in need:
+        out["rhat"] = rhat
+    if "sqrtg" in need:
+        out["sqrtg"] = R * R * dxda * dydb * inv_rho / rho2
+    if "e" in need or "a" in need:
+        pcx = rhat[0] * cx[0] + rhat[1] * cx[1] + rhat[2] * cx[2]
+        pcy = rhat[0] * cy[0] + rhat[1] * cy[1] + rhat[2] * cy[2]
+        fa = R * dxda * inv_rho
+        fb = R * dydb * inv_rho
+        e_a = [fa * (cx[i] - rhat[i] * pcx) for i in range(3)]
+        e_b = [fb * (cy[i] - rhat[i] * pcy) for i in range(3)]
+        if "e" in need:
+            out["e_a"] = e_a
+            out["e_b"] = e_b
+        if "a" in need:
+            # Closed-form 2x2 inverse metric of the equiangular map.
+            R2 = R * R
+            rho4 = rho2 * rho2
+            gcom = R2 * dxda * dydb / rho4
+            gaa = gcom * dxda
+            gbb = gcom * dydb
+            gab = -gcom * xr * yc
+            det = gaa * gbb - gab * gab
+            inv_aa = gbb / det
+            inv_ab = -gab / det
+            inv_bb = gaa / det
+            out["a_a"] = [inv_aa * e_a[i] + inv_ab * e_b[i] for i in range(3)]
+            out["a_b"] = [inv_ab * e_a[i] + inv_bb * e_b[i] for i in range(3)]
+    return out
+
+
+def make_swe_rhs_pallas(
+    n: int,
+    halo: int,
+    dalpha: float,
+    radius: float,
+    gravity: float,
+    omega: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """Build ``rhs(h_ext, v_ext, b_ext) -> (dh, dv)`` as one fused kernel.
+
+    Inputs are extended ``(6, M, M)`` / ``(3, 6, M, M)`` fields with ghosts
+    already filled; outputs are interior tendencies ``(6, n, n)`` /
+    ``(3, 6, n, n)`` — drop-in for the stencil section of
+    :meth:`jaxstream.models.shallow_water.ShallowWater.rhs`.
+    """
+    m = n + 2 * halo
+    h0, h1 = halo, halo + n
+    d = float(dalpha)
+    inv2d = 1.0 / (2.0 * d)
+
+    if scheme == "ppm":
+        recon = functools.partial(ppm_face_states, h=halo, n=n)
+    else:
+        recon = functools.partial(
+            plr_face_states, h=halo, n=n, limiter=limiter
+        )
+
+    # 1-D gnomonic coordinates, shaped for broadcast inside the kernel
+    # (same source of truth as the grid builders).
+    ac, af, _ = extended_coords(n, halo)
+    x_row = jnp.asarray(np.tan(ac), jnp.float32)[None, :]     # (1, M)
+    xf_row = jnp.asarray(np.tan(af), jnp.float32)[None, :]    # (1, M)
+    x_col = jnp.asarray(np.tan(ac), jnp.float32)[:, None]     # (M, 1)
+    xf_col = jnp.asarray(np.tan(af), jnp.float32)[:, None]    # (M, 1)
+    frames = jnp.asarray(FACE_AXES, jnp.float32)              # (6, 3, 3)
+
+    def kernel(frame_ref, xr_ref, xfr_ref, yc_ref, yfc_ref, h_ref, v_ref,
+               b_ref, dh_ref, dv_ref):
+        c0 = _frame_scalars(frame_ref, 0)
+        cx = _frame_scalars(frame_ref, 1)
+        cy = _frame_scalars(frame_ref, 2)
+        g = jnp.float32(gravity)
+        two_omega = jnp.float32(2.0 * omega)
+
+        xr = xr_ref[:]                       # (1, M)
+        xfr = xfr_ref[:]                     # (1, M)
+        yc = yc_ref[:]                       # (M, 1) — same coords, beta axis
+        yfc = yfc_ref[:]
+
+        hf = h_ref[0]                        # (M, M)
+        v = [v_ref[0, 0], v_ref[1, 0], v_ref[2, 0]]
+        bf = b_ref[0]
+
+        # ---- continuity: dh = -div(h v), PLR-upwind flux form ------------
+        # x-faces i = h0..h1 on interior rows: coords (xf cols, center rows).
+        bx = _basis(xfr[:, h0:h1 + 1], yc[h0:h1], c0, cx, cy, radius,
+                    need=("a", "sqrtg"))
+        vxf = [0.5 * (v[i][h0:h1, h0 - 1:h1] + v[i][h0:h1, h0:h1 + 1])
+               for i in range(3)]
+        ux = (vxf[0] * bx["a_a"][0] + vxf[1] * bx["a_a"][1]
+              + vxf[2] * bx["a_a"][2])                       # (n, n+1)
+        qx = hf[h0:h1, :]                                    # (n, M)
+        qL, qR = recon(qx, -1)
+        fx = bx["sqrtg"] * (jnp.maximum(ux, 0.0) * qL
+                            + jnp.minimum(ux, 0.0) * qR)     # (n, n+1)
+
+        # y-faces.
+        by = _basis(xr[:, h0:h1], yfc[h0:h1 + 1], c0, cx, cy, radius,
+                    need=("a", "sqrtg"))
+        vyf = [0.5 * (v[i][h0 - 1:h1, h0:h1] + v[i][h0:h1 + 1, h0:h1])
+               for i in range(3)]
+        uy = (vyf[0] * by["a_b"][0] + vyf[1] * by["a_b"][1]
+              + vyf[2] * by["a_b"][2])                       # (n+1, n)
+        qy = hf[:, h0:h1]                                    # (M, n)
+        qL, qR = recon(qy, -2)
+        fy = by["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
+                            + jnp.minimum(uy, 0.0) * qR)     # (n+1, n)
+
+        bc = _basis(xr[:, h0:h1], yc[h0:h1], c0, cx, cy, radius,
+                    need=("rhat", "sqrtg", "a"))
+        inv_sg_d = 1.0 / (bc["sqrtg"] * jnp.float32(d))
+        dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
+        dh_ref[0] = dh
+
+        # ---- momentum: vector-invariant with Cartesian velocity ----------
+        # Band = interior +- 1 ring, for the centered first derivatives.
+        b0, b1 = h0 - 1, h1 + 1
+        bb = _basis(xr[:, b0:b1], yc[b0:b1], c0, cx, cy, radius, need=("e",))
+        vb_band = [v[i][b0:b1, b0:b1] for i in range(3)]     # (n+2, n+2)
+        va = (vb_band[0] * bb["e_a"][0] + vb_band[1] * bb["e_a"][1]
+              + vb_band[2] * bb["e_a"][2])
+        vbeta = (vb_band[0] * bb["e_b"][0] + vb_band[1] * bb["e_b"][1]
+                 + vb_band[2] * bb["e_b"][2])
+        # zeta = (d vbeta/d alpha - d va/d beta) / sqrtg, interior cells.
+        dvb_da = (vbeta[1:-1, 2:] - vbeta[1:-1, :-2]) * jnp.float32(inv2d)
+        dva_db = (va[2:, 1:-1] - va[:-2, 1:-1]) * jnp.float32(inv2d)
+        zeta = (dvb_da - dva_db) / bc["sqrtg"]
+
+        # Bernoulli function on the band: g (h + b) + |v|^2 / 2.
+        ke = 0.5 * (vb_band[0] * vb_band[0] + vb_band[1] * vb_band[1]
+                    + vb_band[2] * vb_band[2])
+        bern = g * (hf[b0:b1, b0:b1] + bf[b0:b1, b0:b1]) + ke
+        dpa = (bern[1:-1, 2:] - bern[1:-1, :-2]) * jnp.float32(inv2d)
+        dpb = (bern[2:, 1:-1] - bern[:-2, 1:-1]) * jnp.float32(inv2d)
+
+        k = bc["rhat"]                                       # interior khat
+        fcor = two_omega * k[2]
+        absv = zeta + fcor
+
+        vi = [v[i][h0:h1, h0:h1] for i in range(3)]
+        # Tangentialize, then k x v, then assemble and re-project.
+        vdotk = vi[0] * k[0] + vi[1] * k[1] + vi[2] * k[2]
+        vt = [vi[i] - k[i] * vdotk for i in range(3)]
+        kxv = [k[1] * vt[2] - k[2] * vt[1],
+               k[2] * vt[0] - k[0] * vt[2],
+               k[0] * vt[1] - k[1] * vt[0]]
+        a_a, a_b = bc["a_a"], bc["a_b"]
+        dv = [-absv * kxv[i] - (a_a[i] * dpa + a_b[i] * dpb)
+              for i in range(3)]
+        dvdotk = dv[0] * k[0] + dv[1] * k[1] + dv[2] * k[2]
+        for i in range(3):
+            dv_ref[i, 0] = dv[i] - k[i] * dvdotk
+
+    grid_spec = pl.GridSpec(
+        grid=(6,),
+        in_specs=[
+            pl.BlockSpec((1, 3, 3), lambda f: (f, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 1, m, m), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 1, n, n), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((3, 6, n, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+
+    def rhs(h_ext, v_ext, b_ext) -> Tuple[jax.Array, jax.Array]:
+        dh, dv = call(frames, x_row, xf_row, x_col, xf_col,
+                      h_ext, v_ext, b_ext)
+        return dh, dv
+
+    return rhs

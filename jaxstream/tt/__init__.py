@@ -1,0 +1,35 @@
+"""Tensor-Train compressed numerics (the reference's research direction).
+
+Deck p.3/p.5/p.19: TT compression of panel fields and the compressed
+-algebra layer; operator-level TT numerics are roadmap (SURVEY.md §2.2).
+"""
+
+from .tensor_train import (
+    TTTensor,
+    quantize_shape,
+    tt_add,
+    tt_compress_field,
+    tt_decompose,
+    tt_decompress_field,
+    tt_dot,
+    tt_hadamard,
+    tt_norm,
+    tt_reconstruct,
+    tt_round,
+    tt_scale,
+)
+
+__all__ = [
+    "TTTensor",
+    "quantize_shape",
+    "tt_add",
+    "tt_compress_field",
+    "tt_decompose",
+    "tt_decompress_field",
+    "tt_dot",
+    "tt_hadamard",
+    "tt_norm",
+    "tt_reconstruct",
+    "tt_round",
+    "tt_scale",
+]

@@ -1,0 +1,259 @@
+"""Tensor-Train (TT/QTT) compressed fields.
+
+The reference's research direction (deck p.3: TT compresses N x N fields
+to O(d N r^2), r << N, citing LANL's 124x speedup on Cartesian-2D SWE,
+Danis et al. 2024, arXiv:2408.03483; deck p.5/p.19: TT numerics turn
+memory-bound stencils (AI ~ 0.25 flops/byte) into compute-bound r x r
+matmuls (AI ~ 5 flops/byte) — "Ideal for TPU/GPU devices").  The deck
+ships no TT code; this module provides the compression layer:
+
+  * ``tt_decompose`` — TT-SVD (Oseledets 2011) over an arbitrary-order
+    tensor, with either fixed max rank or a relative Frobenius tolerance
+    distributed over the unfoldings.
+  * ``quantize``/``dequantize`` — the QTT reshape: a (2^k, 2^k) panel
+    field becomes a k-dimensional (4, 4, ..., 4) tensor whose TT ranks
+    stay small for smooth atmospheric fields (this is what makes
+    "TT-friendly 2D tiles", deck p.4, concrete).
+  * TT algebra: ``tt_add``, ``tt_scale``, ``tt_hadamard``, and
+    ``tt_round`` (rank re-truncation after algebra).
+  * ``tt_dot``, ``tt_norm`` — inner products without decompression.
+
+Everything is jnp + einsum — the r x r core contractions are exactly the
+small-matmul workload the deck's roofline analysis targets at the MXU.
+Operator-level TT numerics (applying FV stencils directly on cores) are
+the round-2+ roadmap (SURVEY.md §2.2 "Optional/roadmap").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "TTTensor",
+    "tt_decompose",
+    "tt_reconstruct",
+    "tt_round",
+    "tt_add",
+    "tt_scale",
+    "tt_hadamard",
+    "tt_dot",
+    "tt_norm",
+    "quantize_shape",
+    "tt_compress_field",
+    "tt_decompress_field",
+]
+
+
+@dataclasses.dataclass
+class TTTensor:
+    """A tensor in TT format: cores[k] has shape (r_k, n_k, r_{k+1}).
+
+    ``qtt_meta`` carries the field-reshape bookkeeping of
+    :func:`tt_compress_field` (original 2-D shape + per-axis factors); the
+    algebra ops propagate it so compress -> algebra -> decompress works.
+    """
+
+    cores: List[jnp.ndarray]
+    qtt_meta: Optional[Tuple] = None
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(c.shape[1] for c in self.cores)
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        return (1,) + tuple(c.shape[2] for c in self.cores)
+
+    @property
+    def n_params(self) -> int:
+        return sum(int(np.prod(c.shape)) for c in self.cores)
+
+    def compression_ratio(self) -> float:
+        full = int(np.prod(self.shape))
+        return full / max(self.n_params, 1)
+
+
+def tt_decompose(
+    tensor,
+    max_rank: Optional[int] = None,
+    rel_tol: Optional[float] = None,
+) -> TTTensor:
+    """TT-SVD: sequential truncated SVDs of the unfoldings.
+
+    ``rel_tol`` is a relative Frobenius-norm error budget for the whole
+    decomposition (distributed as tol/sqrt(d-1) per unfolding, the
+    standard Oseledets bound); ``max_rank`` caps every bond dimension.
+    """
+    a = jnp.asarray(tensor)
+    dims = a.shape
+    d = len(dims)
+    if d < 2:
+        raise ValueError("TT needs an order >= 2 tensor")
+    delta = None
+    if rel_tol is not None:
+        delta = rel_tol * float(jnp.linalg.norm(a.ravel())) / math.sqrt(d - 1)
+
+    cores: List[jnp.ndarray] = []
+    r_prev = 1
+    mat = a.reshape(r_prev * dims[0], -1)
+    for k in range(d - 1):
+        u, s, vt = jnp.linalg.svd(mat, full_matrices=False)
+        r = int(s.shape[0])
+        if delta is not None:
+            # Largest truncation whose dropped tail stays under delta.
+            tail = jnp.sqrt(jnp.cumsum(s[::-1] ** 2))[::-1]
+            keep = int(jnp.sum(tail > delta))
+            r = max(1, min(r, keep))
+        if max_rank is not None:
+            r = min(r, max_rank)
+        cores.append(u[:, :r].reshape(r_prev, dims[k], r))
+        mat = (s[:r, None] * vt[:r, :])
+        r_prev = r
+        if k < d - 2:
+            mat = mat.reshape(r_prev * dims[k + 1], -1)
+    cores.append(mat.reshape(r_prev, dims[-1], 1))
+    return TTTensor(cores)
+
+
+def tt_reconstruct(tt: TTTensor) -> jnp.ndarray:
+    """Contract cores back to the full tensor."""
+    out = tt.cores[0]  # (1, n0, r1)
+    for c in tt.cores[1:]:
+        out = jnp.einsum("...a,abc->...bc", out, c)
+    return out[0, ..., 0]
+
+
+def tt_round(tt: TTTensor, max_rank: Optional[int] = None,
+             rel_tol: Optional[float] = None) -> TTTensor:
+    """Re-truncate ranks after TT algebra (right-to-left QR, then TT-SVD).
+
+    Small tensors: implemented as reconstruct + decompose, which is exact
+    and simple; fine for the compression-layer scope (operator-level TT
+    keeps everything in cores and needs the proper two-sweep rounding —
+    roadmap).
+    """
+    out = tt_decompose(tt_reconstruct(tt), max_rank=max_rank,
+                       rel_tol=rel_tol)
+    out.qtt_meta = tt.qtt_meta
+    return out
+
+
+def _join_meta(x: TTTensor, y: TTTensor) -> Optional[Tuple]:
+    if x.qtt_meta is not None and y.qtt_meta is not None \
+            and x.qtt_meta != y.qtt_meta:
+        raise ValueError(
+            f"QTT layouts differ: {x.qtt_meta} vs {y.qtt_meta}"
+        )
+    return x.qtt_meta if x.qtt_meta is not None else y.qtt_meta
+
+
+def _block_diag_cores(a: jnp.ndarray, b: jnp.ndarray, first: bool,
+                      last: bool) -> jnp.ndarray:
+    ra0, n, ra1 = a.shape
+    rb0, _, rb1 = b.shape
+    if first:
+        return jnp.concatenate([a, b], axis=2)
+    if last:
+        return jnp.concatenate([a, b], axis=0)
+    out = jnp.zeros((ra0 + rb0, n, ra1 + rb1), dtype=a.dtype)
+    out = out.at[:ra0, :, :ra1].set(a)
+    out = out.at[ra0:, :, ra1:].set(b)
+    return out
+
+
+def tt_add(x: TTTensor, y: TTTensor) -> TTTensor:
+    """x + y via block-diagonal core stacking (ranks add; round after)."""
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    d = len(x.cores)
+    return TTTensor([
+        _block_diag_cores(cx, cy, k == 0, k == d - 1)
+        for k, (cx, cy) in enumerate(zip(x.cores, y.cores))
+    ], qtt_meta=_join_meta(x, y))
+
+
+def tt_scale(x: TTTensor, s) -> TTTensor:
+    cores = list(x.cores)
+    cores[0] = cores[0] * s
+    return TTTensor(cores, qtt_meta=x.qtt_meta)
+
+
+def tt_hadamard(x: TTTensor, y: TTTensor) -> TTTensor:
+    """Elementwise product: Kronecker product of bond spaces (ranks multiply)."""
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    cores = []
+    for cx, cy in zip(x.cores, y.cores):
+        c = jnp.einsum("anb,cnd->acnbd", cx, cy)
+        r0 = cx.shape[0] * cy.shape[0]
+        r1 = cx.shape[2] * cy.shape[2]
+        cores.append(c.reshape(r0, cx.shape[1], r1))
+    return TTTensor(cores, qtt_meta=_join_meta(x, y))
+
+
+def tt_dot(x: TTTensor, y: TTTensor):
+    """<x, y> contracted core-by-core (never forms the full tensor)."""
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    env = jnp.ones((1, 1), dtype=x.cores[0].dtype)
+    for cx, cy in zip(x.cores, y.cores):
+        env = jnp.einsum("ac,anb,cnd->bd", env, cx, cy)
+    return env[0, 0]
+
+
+def tt_norm(x: TTTensor):
+    return jnp.sqrt(jnp.maximum(tt_dot(x, x), 0.0))
+
+
+def quantize_shape(n: int, base: int = 4) -> List[int]:
+    """Factor n into `base` factors (QTT); remainder goes in one trailing dim."""
+    dims = []
+    while n % base == 0 and n > base:
+        dims.append(base)
+        n //= base
+    dims.append(n)
+    return dims
+
+
+def tt_compress_field(field2d, max_rank: Optional[int] = None,
+                      rel_tol: Optional[float] = 1e-6,
+                      base: int = 4) -> TTTensor:
+    """QTT-compress one (ny, nx) panel field.
+
+    Reshapes to the quantized (base, ..., base) tensor with *interleaved*
+    y/x factors (locality-preserving ordering — keeps smooth-field ranks
+    low) and TT-decomposes.
+    """
+    f = jnp.asarray(field2d)
+    ny, nx = f.shape
+    dy, dx = quantize_shape(ny, base), quantize_shape(nx, base)
+    if len(dy) != len(dx) or len(dy) < 2:
+        # Plain order-2 TT (= truncated SVD) on ragged or tiny shapes.
+        return tt_decompose(f, max_rank=max_rank, rel_tol=rel_tol)
+    # (y0..yk, x0..xk) -> interleave -> (y0, x0, y1, x1, ...)
+    t = f.reshape(tuple(dy) + tuple(dx))
+    k = len(dy)
+    perm = [i for pair in zip(range(k), range(k, 2 * k)) for i in pair]
+    t = jnp.transpose(t, perm)
+    merged = t.reshape(tuple(dy[i] * dx[i] for i in range(k)))
+    tt = tt_decompose(merged, max_rank=max_rank, rel_tol=rel_tol)
+    tt.qtt_meta = (ny, nx, tuple(dy), tuple(dx))
+    return tt
+
+
+def tt_decompress_field(tt: TTTensor) -> jnp.ndarray:
+    """Inverse of :func:`tt_compress_field` (meta survives TT algebra)."""
+    meta = tt.qtt_meta
+    full = tt_reconstruct(tt)
+    if meta is None:
+        return full
+    ny, nx, dy, dx = meta
+    k = len(dy)
+    t = full.reshape(tuple(v for pair in zip(dy, dx) for v in pair))
+    inv = [2 * i for i in range(k)] + [2 * i + 1 for i in range(k)]
+    return jnp.transpose(t, inv).reshape(ny, nx)

@@ -1,0 +1,22 @@
+"""Visualization: per-face panels, lat/lon maps, 3-D sphere renders.
+
+The reference's Analysis/Viz pipeline stage (deck p.6; figures p.12-13,
+p.17-18).  Imported lazily so headless/compute-only deployments don't pay
+the matplotlib import.
+"""
+
+from .plots import (
+    latlon_index_map,
+    plot_faces,
+    plot_latlon,
+    plot_sphere,
+    to_latlon,
+)
+
+__all__ = [
+    "latlon_index_map",
+    "plot_faces",
+    "plot_latlon",
+    "plot_sphere",
+    "to_latlon",
+]

@@ -1,0 +1,58 @@
+"""Lazy (on-the-fly, rank-1-separable) metrics vs the eager f64 grid.
+
+The lazy grid is the TPU fast path (geometry recomputed inside the traced
+step instead of streamed from HBM); it must agree with the eager
+float64-precomputed grid to dtype precision, and a full SWE step over it
+must reproduce the eager step.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.models.shallow_water import ShallowWater
+from jaxstream.physics.initial_conditions import williamson_tc2
+
+METRIC_ATTRS = [
+    "xyz", "khat", "lon", "lat", "e_a", "e_b", "a_a", "a_b", "sqrtg",
+    "area", "sqrtg_xf", "a_a_xf", "sqrtg_yf", "a_b_yf",
+    "ginv_aa_xf", "ginv_ab_xf", "ginv_bb_yf", "ginv_ab_yf",
+]
+
+
+@pytest.mark.parametrize("dtype,rtol", [(jnp.float64, 1e-12), (jnp.float32, 2e-5)])
+def test_lazy_matches_eager(dtype, rtol):
+    n, halo = 12, 2
+    eager = build_grid(n, halo=halo, radius=2.5, dtype=dtype)
+    lazy = build_grid(n, halo=halo, radius=2.5, dtype=dtype, metrics="lazy")
+    assert lazy.m == eager.m and lazy.dalpha == pytest.approx(eager.dalpha)
+    for name in METRIC_ATTRS:
+        a = np.asarray(getattr(eager, name), dtype=np.float64)
+        b = np.broadcast_to(
+            np.asarray(getattr(lazy, name), dtype=np.float64), a.shape
+        )
+        # Relative to the field's overall scale (metric terms are O(1)-O(R^2)).
+        scale = np.max(np.abs(a)) + 1e-300
+        np.testing.assert_allclose(b, a, atol=rtol * scale, err_msg=name)
+
+
+def test_swe_step_parity_lazy_vs_eager():
+    n = 16
+    kw = dict(halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    out = {}
+    for mode in ("eager", "lazy"):
+        grid = build_grid(n, metrics=mode, **kw)
+        model = ShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA)
+        h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+        state = model.initial_state(h_ext, v_ext)
+        out[mode], _ = model.run(state, nsteps=5, dt=600.0)
+    np.testing.assert_allclose(
+        np.asarray(out["lazy"]["h"]), np.asarray(out["eager"]["h"]),
+        rtol=1e-10,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["lazy"]["v"]), np.asarray(out["eager"]["v"]),
+        rtol=0, atol=1e-10 * float(np.max(np.abs(out["eager"]["v"]))),
+    )

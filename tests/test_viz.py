@@ -1,0 +1,53 @@
+"""Viz layer: regridding exactness and figure generation smoke tests."""
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+from jaxstream.geometry.cubed_sphere import (
+    build_grid,
+    face_points,
+    sphere_to_face_coords,
+)
+from jaxstream.viz import plot_faces, plot_latlon, plot_sphere, to_latlon
+
+
+def test_inverse_gnomonic_roundtrip():
+    rng = np.random.default_rng(0)
+    # Random points, away from exact edges.
+    for face in range(6):
+        a = rng.uniform(-0.7, 0.7, 100)
+        b = rng.uniform(-0.7, 0.7, 100)
+        p = face_points(face, a, b)
+        f2, a2, b2 = sphere_to_face_coords(p)
+        assert np.all(f2 == face)
+        np.testing.assert_allclose(a2, a, atol=1e-12)
+        np.testing.assert_allclose(b2, b, atol=1e-12)
+
+
+def test_latlon_regrid_smooth_field():
+    grid = build_grid(24, halo=2)
+    # z-coordinate (= sin(lat)) is smooth and face-independent.
+    z = np.asarray(grid.interior(grid.xyz))[2]
+    ll = to_latlon(z, nlat=91, nlon=180)
+    lat = np.linspace(-90, 90, 91) * np.pi / 180
+    expect = np.sin(lat)[:, None] * np.ones((1, 180))
+    # Nearest-cell sampling at C24: error bounded by the cell size ~ 4 deg.
+    assert np.max(np.abs(ll - expect)) < np.pi / 2 / 24 * 1.5
+
+
+def test_figures_render(tmp_path):
+    grid = build_grid(8, halo=2)
+    z = np.asarray(grid.interior(grid.xyz))[2]
+    f1 = plot_faces(z, title="t", units="m", path=str(tmp_path / "faces.png"))
+    f2 = plot_latlon(z, nlat=19, nlon=36, path=str(tmp_path / "ll.png"))
+    f3 = plot_sphere(z, path=str(tmp_path / "sph.png"))
+    for f in (f1, f2, f3):
+        assert f is not None
+    for name in ("faces.png", "ll.png", "sph.png"):
+        assert (tmp_path / name).stat().st_size > 1000
+    import matplotlib.pyplot as plt
+
+    plt.close("all")
