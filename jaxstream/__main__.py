@@ -1,0 +1,90 @@
+"""Command-line entry: ``python -m jaxstream <cmd>``.
+
+Subcommands:
+  run <config.yaml>   end-to-end simulation from a config file
+  info [config.yaml]  devices / mesh / grid summary without running
+  schedule            print the race-free cube-edge exchange schedule
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_run(args):
+    from .simulation import Simulation
+
+    sim = Simulation(args.config)
+    sim.run(args.nsteps)
+    print(json.dumps({
+        "steps": sim.step_count,
+        "t_seconds": sim.t,
+        "diagnostics": sim.diagnostics(),
+    }))
+
+
+def _cmd_info(args):
+    import jax
+
+    from .config import load_config
+    from .parallel.mesh import setup_sharding
+
+    cfg = load_config(args.config)
+    devs = jax.devices()
+    print(f"jax {jax.__version__}; {len(devs)} device(s): "
+          f"{[f'{d.platform}:{d.id}' for d in devs]}")
+    print(f"grid: C{cfg.grid.n} halo={cfg.grid.halo} dtype={cfg.grid.dtype} "
+          f"({6 * cfg.grid.n ** 2} cells)")
+    par = cfg.parallelization
+    print(f"parallelization: tiles_per_edge={par.tiles_per_edge} "
+          f"num_devices={par.num_devices} device_type={par.device_type} "
+          f"use_shard_map={par.use_shard_map}")
+    if par.num_devices > 1:
+        try:
+            setup = setup_sharding(cfg)
+            print(f"mesh: panel={setup.panel} y={setup.sy} x={setup.sx}")
+        except ValueError as e:
+            print(f"mesh: unavailable here ({e})")
+    print(f"model: {cfg.model.initial_condition} scheme={cfg.model.scheme} "
+          f"backend={cfg.model.backend}; dt={cfg.time.dt}s "
+          f"duration={cfg.time.duration_days}d")
+
+
+def _cmd_schedule(args):
+    from .geometry.connectivity import build_connectivity, build_schedule
+
+    schedule = build_schedule(build_connectivity())
+    for s, stage in enumerate(schedule):
+        pairs = ", ".join(
+            f"F{l.face}.{'NESW'[l.edge]}<->F{b.face}.{'NESW'[b.edge]}"
+            f"{'(rev)' if l.reversed_ else ''}"
+            for l, b in stage
+        )
+        print(f"stage {s}: {pairs}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="jaxstream")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("run", help="run a simulation from a config file")
+    pr.add_argument("config")
+    pr.add_argument("--nsteps", type=int, default=None,
+                    help="override the configured duration")
+    pr.set_defaults(fn=_cmd_run)
+
+    pi = sub.add_parser("info", help="show devices / mesh / config summary")
+    pi.add_argument("config", nargs="?", default=None)
+    pi.set_defaults(fn=_cmd_info)
+
+    ps = sub.add_parser("schedule", help="print the halo-exchange schedule")
+    ps.set_defaults(fn=_cmd_schedule)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

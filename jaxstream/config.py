@@ -38,6 +38,7 @@ class GridConfig:
     halo: int = 2                    # >=2 for PLR, >=3 for PPM
     radius: float = EARTH_RADIUS
     dtype: str = "float32"
+    metrics: str = "eager"           # 'eager' (precomputed f64) | 'lazy' (fused)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,12 +59,25 @@ class PhysicsConfig:
     omega: float = EARTH_OMEGA
     hyperdiffusion: float = 0.0      # nu4 coefficient (m^4/s)
     divergence_damping: float = 0.0  # nondimensional d2 coefficient
+    diffusivity: float = 1.0e5       # kappa (m^2/s) for the diffusion model
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "auto"               # 'auto' | 'shallow_water' | 'advection' | 'diffusion'
+    initial_condition: str = "tc2"   # tc1/cosine_bell | checkerboard | tc2 | tc5 | tc6 | galewsky
+    scheme: str = "plr"              # 'plr' | 'ppm' reconstruction
+    limiter: str = "mc"              # 'minmod' | 'mc' | 'vanleer' | 'none'
+    backend: str = "jnp"             # 'jnp' | 'pallas' RHS stencils
+    ic_angle: float = 0.0            # flow-orientation angle (TC1/TC2 alpha)
 
 
 @dataclasses.dataclass(frozen=True)
 class TimeConfig:
     dt: float = 600.0
     scheme: str = "ssprk3"
+    duration_days: float = 1.0       # total integration length ...
+    nsteps: int = 0                  # ... or an explicit step count (wins if > 0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +93,7 @@ class Config:
     grid: GridConfig = GridConfig()
     parallelization: ParallelConfig = ParallelConfig()
     physics: PhysicsConfig = PhysicsConfig()
+    model: ModelConfig = ModelConfig()
     time: TimeConfig = TimeConfig()
     io: IOConfig = IOConfig()
 
@@ -87,6 +102,7 @@ _SECTIONS = {
     "grid": GridConfig,
     "parallelization": ParallelConfig,
     "physics": PhysicsConfig,
+    "model": ModelConfig,
     "time": TimeConfig,
     "io": IOConfig,
 }

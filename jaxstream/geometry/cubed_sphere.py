@@ -331,8 +331,9 @@ class LazyCubedSphereGrid:
             "a_a": inv_aa[None] * e_a + inv_ab[None] * e_b,
             "a_b": inv_ab[None] * e_a + inv_bb[None] * e_b,
             # Face-independent, but consumers (zeros_like, stacking) expect
-            # the (6, M, M) face axis; broadcast_to stays lazy under XLA.
-            "sqrtg": jnp.broadcast_to(sqrtg, (NUM_FACES, self.m, self.m)),
+            # the face axis; broadcast_to stays lazy under XLA.  Sized from
+            # the frames so per-face local blocks (shard_map) stay (1, M, M).
+            "sqrtg": jnp.broadcast_to(sqrtg, (self._c0.shape[1], self.m, self.m)),
             "inv_gaa": inv_aa,
             "inv_gab": inv_ab,
             "inv_gbb": inv_bb,

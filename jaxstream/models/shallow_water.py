@@ -82,6 +82,12 @@ class ShallowWater(Model):
             raise ValueError(f"unknown backend {backend!r}")
         self._pallas_rhs = None
         if backend.startswith("pallas"):
+            if grid.sqrtg.dtype != jnp.float32:
+                raise ValueError(
+                    f"backend='pallas' supports float32 grids only (the TPU "
+                    f"kernel is f32); got grid dtype {grid.sqrtg.dtype}. Use "
+                    f"backend='jnp' or build the grid with dtype=float32."
+                )
             from ..ops.pallas.swe_rhs import make_swe_rhs_pallas
 
             self._pallas_rhs = make_swe_rhs_pallas(

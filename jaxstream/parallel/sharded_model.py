@@ -46,16 +46,33 @@ def make_stepper_for(model, setup, example_state, dt: float,
 
 
 def _grid_arrays(grid: CubedSphereGrid):
+    """jax.Array attributes of a grid (dense dataclass or lazy plain class)."""
+    if dataclasses.is_dataclass(grid):
+        names = [f.name for f in dataclasses.fields(grid)]
+    else:  # LazyCubedSphereGrid stores 1-D coords + (3, 6, 1, 1) frames
+        names = list(vars(grid))
     out = {}
-    for f in dataclasses.fields(grid):
-        v = getattr(grid, f.name)
+    for name in names:
+        v = getattr(grid, name)
         if isinstance(v, jax.Array):
-            out[f.name] = v
+            out[name] = v
     return out
+
+
+def _rebind(obj, updates):
+    """dataclasses.replace for dataclasses; copy+setattr otherwise."""
+    if dataclasses.is_dataclass(obj):
+        return dataclasses.replace(obj, **updates)
+    new = copy.copy(obj)
+    for k, v in updates.items():
+        setattr(new, k, v)
+    return new
 
 
 def _face_spec(a) -> P:
     """PartitionSpec for an array whose trailing axes are (6, ny, nx)."""
+    if a.ndim <= 1:  # 1-D coordinate vectors (lazy grid): replicate
+        return P(*((None,) * a.ndim))
     if a.ndim == 2:  # (6, 4) per-device parameter tables
         return P("panel", None)
     return P(*((None,) * (a.ndim - 3) + ("panel", "y", "x")))
@@ -96,7 +113,7 @@ def make_sharded_stepper(model, setup: ShardingSetup, example_state,
     stepper = SCHEMES[scheme]
 
     def local_step(p, state, t):
-        grid_l = dataclasses.replace(grid, **p["grid"])
+        grid_l = _rebind(grid, p["grid"])
         m = copy.copy(model)
         m.grid = grid_l
         for k, v in p["aux"].items():

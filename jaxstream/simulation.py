@@ -1,0 +1,240 @@
+"""End-to-end simulation driver: the framework shell around the solvers.
+
+This is the rebuilt form of the reference's implied top-level run loop
+(SURVEY.md §3.4): ``load config.yaml -> geometry [zarr] -> initial
+conditions -> setup_sharding() -> timestep loop (no recompilation) with
+periodic history [zarr] / restart [Orbax] -> analysis``.  The reference
+shows only the ``setup_sharding`` method of its unseen driver class
+(``/root/reference/JAX-DevLab-Examples.py:19-85``); :class:`Simulation`
+is that class built out in full, config-driven end to end.
+
+Design notes (TPU-first):
+  * The inner loop is segments of ``lax.fori_loop`` under one cached
+    ``jit`` — host contact only at history/checkpoint boundaries, so the
+    per-step path is pure device execution ("no recompilation during
+    timestepping", deck p.10).
+  * Sharding is transparent: with ``num_devices > 1`` the state is
+    device_put with a ``('panel','y','x')`` NamedSharding (GSPMD path) or
+    stepped inside ``shard_map`` with explicit ``lax.ppermute`` halos
+    (``use_shard_map: true``); the numerics are byte-identical either way.
+  * Restart is automatic: if the checkpoint directory has a saved step,
+    the run resumes from it (sharding-aware restore).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .config import Config, load_config
+from .geometry.cubed_sphere import build_grid
+from .io.checkpoint import CheckpointManager
+from .io.history import HistoryWriter, save_geometry
+from .models.advection import TracerAdvection
+from .models.diffusion import ThermalDiffusion
+from .models.shallow_water import ShallowWater
+from .parallel.mesh import setup_sharding, shard_state
+from .parallel.sharded_model import make_stepper_for
+from .physics import initial_conditions as ics
+from .stepping import integrate
+from .utils import diagnostics as diag
+from .utils.logging import get_logger
+
+__all__ = ["Simulation", "run_from_config"]
+
+log = get_logger(__name__)
+
+_DTYPES = {"float32": jnp.float32, "float64": jnp.float64, "bfloat16": jnp.bfloat16}
+
+#: initial-condition name -> model family it drives
+IC_FAMILY = {
+    "tc1": "advection",
+    "cosine_bell": "advection",
+    "checkerboard": "diffusion",
+    "tc2": "shallow_water",
+    "tc5": "shallow_water",
+    "tc6": "shallow_water",
+    "galewsky": "shallow_water",
+}
+
+
+class Simulation:
+    """Config -> grid -> model+IC -> sharding -> run loop -> outputs."""
+
+    def __init__(self, config: Any = None):
+        self.config: Config = load_config(config)
+        cfg = self.config
+        dtype = _DTYPES[cfg.grid.dtype]
+        mcfg = cfg.model
+        halo = cfg.grid.halo
+        if mcfg.scheme == "ppm":
+            halo = max(halo, 3)
+        self.grid = build_grid(
+            cfg.grid.n, halo=halo, radius=cfg.grid.radius, dtype=dtype,
+            metrics=cfg.grid.metrics,
+        )
+        self.model, self.state = self._build_model_and_state()
+        self.t = 0.0
+        self.step_count = 0
+
+        par = cfg.parallelization
+        self.setup = None
+        if par.num_devices > 1:
+            self.setup = setup_sharding(cfg)
+            self.state = shard_state(self.setup, self.state)
+        self._step = make_stepper_for(
+            self.model, self.setup, self.state, cfg.time.dt, cfg.time.scheme
+        )
+        self._segment_cache: Dict[int, Callable] = {}
+
+        io = cfg.io
+        self.history: Optional[HistoryWriter] = None
+        self.checkpoints: Optional[CheckpointManager] = None
+        if io.history_stride > 0:
+            save_geometry(io.history_path + ".geometry", self.grid)
+            self.history = HistoryWriter(
+                io.history_path, attrs={"model": mcfg.name, "ic": mcfg.initial_condition}
+            )
+        if io.checkpoint_stride > 0:
+            self.checkpoints = CheckpointManager(io.checkpoint_path)
+            self._maybe_resume()
+
+    # ------------------------------------------------------------------ build
+    def _build_model_and_state(self):
+        cfg = self.config
+        m, p, g = cfg.model, cfg.physics, self.grid
+        name = m.initial_condition
+        family = IC_FAMILY.get(name)
+        if family is None:
+            raise ValueError(
+                f"unknown initial_condition {name!r}; valid: {sorted(IC_FAMILY)}"
+            )
+        if m.name not in ("auto", family):
+            raise ValueError(
+                f"model.name={m.name!r} is incompatible with "
+                f"initial_condition={name!r} (which drives {family!r})"
+            )
+        if family == "advection":
+            u0 = 2 * math.pi * g.radius / (12 * 86400.0)
+            wind = ics.solid_body_wind(g, u0, alpha_rot=m.ic_angle)
+            model = TracerAdvection(g, wind, scheme=m.scheme, limiter=m.limiter)
+            q = ics.cosine_bell(g)
+            return model, model.initial_state(q)
+        if family == "diffusion":
+            model = ThermalDiffusion(g, kappa=p.diffusivity)
+            return model, model.initial_state(ics.checkerboard(g))
+        b_ext = None
+        if name == "tc2":
+            h, v = ics.williamson_tc2(g, p.gravity, p.omega, alpha_rot=m.ic_angle)
+        elif name == "tc5":
+            h, v, b_ext = ics.williamson_tc5(g, p.gravity, p.omega)
+        elif name == "tc6":
+            h, v = ics.williamson_tc6(g, p.gravity, p.omega)
+        else:
+            h, v = ics.galewsky(g, p.gravity, p.omega)
+        model = ShallowWater(
+            g, gravity=p.gravity, omega=p.omega, b_ext=b_ext,
+            scheme=m.scheme, limiter=m.limiter, nu4=p.hyperdiffusion,
+            backend=m.backend,
+        )
+        return model, model.initial_state(h, v)
+
+    # ---------------------------------------------------------------- running
+    def _maybe_resume(self):
+        step = self.checkpoints.latest_step()
+        if step is None:
+            return
+        self.state, self.t = self.checkpoints.restore(step, sharding_setup=self.setup)
+        self.step_count = step
+        log.info("resumed from checkpoint step %d (t=%.0f s)", step, self.t)
+
+    def _run_segment(self, k: int):
+        fn = self._segment_cache.get(k)
+        if fn is None:
+            dt = self.config.time.dt
+            fn = jax.jit(
+                lambda y, t: integrate(self._step, y, t, k, dt)
+            )
+            self._segment_cache[k] = fn
+        self.state, t = fn(self.state, self.t)
+        self.t = float(t)
+        self.step_count += k
+
+    def _emit(self):
+        if self.history is not None:
+            self.history.append(
+                {k: np.asarray(v) for k, v in self.state.items()}, self.t
+            )
+        for k, v in self.diagnostics().items():
+            log.info("step %-8d t=%10.0fs  %s=%.10g", self.step_count, self.t, k, v)
+
+    def diagnostics(self) -> Dict[str, float]:
+        """Scalar invariants for the current state (model-appropriate)."""
+        g, s = self.grid, self.state
+        out: Dict[str, float] = {}
+        if "h" in s:
+            p = self.config.physics
+            out["mass"] = float(diag.total_mass(g, s["h"]))
+            b = self.model.b_ext
+            b_int = g.interior(b) if b is not None else 0.0
+            out["energy"] = float(
+                diag.total_energy(g, s["h"], s["v"], p.gravity, b_int)
+            )
+        elif "q" in s:
+            out["tracer_mass"] = float(diag.total_mass(g, s["q"]))
+            out["tracer_max"] = float(jnp.max(s["q"]))
+        elif "T" in s:
+            out["heat"] = float(diag.total_mass(g, s["T"]))
+        return out
+
+    def total_steps(self) -> int:
+        tc = self.config.time
+        if tc.nsteps > 0:
+            return tc.nsteps
+        return int(round(tc.duration_days * 86400.0 / tc.dt))
+
+    def run(self, nsteps: Optional[int] = None):
+        """Integrate to ``nsteps`` total (default: the config's duration).
+
+        Returns the final state.  History/checkpoints fire on their
+        configured strides; everything between strides is one compiled
+        device loop.
+        """
+        total = self.total_steps() if nsteps is None else nsteps
+        io = self.config.io
+        strides = [s for s in (io.history_stride, io.checkpoint_stride) if s > 0]
+        seg = math.gcd(*strides) if strides else 0
+        if self.step_count == 0 and self.history is not None:
+            self._emit()  # record the initial condition
+        wall0 = time.perf_counter()
+        while self.step_count < total:
+            k = min(seg, total - self.step_count) if seg else total - self.step_count
+            self._run_segment(k)
+            if io.history_stride and self.step_count % io.history_stride == 0:
+                self._emit()
+            if (
+                self.checkpoints is not None
+                and self.step_count % io.checkpoint_stride == 0
+            ):
+                self.checkpoints.save(self.step_count, self.state, self.t)
+        jax.block_until_ready(self.state)
+        wall = time.perf_counter() - wall0
+        days = total * self.config.time.dt / 86400.0
+        log.info(
+            "ran %d steps (%.2f sim-days) in %.2fs wall -> %.2f sim-days/sec",
+            total, days, wall, days / wall if wall > 0 else float("inf"),
+        )
+        return self.state
+
+
+def run_from_config(source: Any, nsteps: Optional[int] = None):
+    """One-call entry: build a Simulation from ``source`` and run it."""
+    sim = Simulation(source)
+    sim.run(nsteps)
+    return sim

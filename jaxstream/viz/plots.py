@@ -105,7 +105,7 @@ def plot_latlon(field, halo: int = 0, title: str = "", units: str = "",
     ll = to_latlon(field, nlat, nlon, halo)
     fig, ax = plt.subplots(figsize=(10, 5), constrained_layout=True)
     im = ax.pcolormesh(
-        np.linspace(0, 360, ll.shape[-1]),
+        np.linspace(0, 360, ll.shape[-1], endpoint=False),
         np.linspace(-90, 90, ll.shape[-2]),
         ll, cmap=cmap,
     )

@@ -16,16 +16,6 @@ from jaxstream.models.shallow_water import ShallowWater
 from jaxstream.physics.initial_conditions import williamson_tc2, williamson_tc5
 
 
-def _models(n, backend_kwargs, **kw):
-    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
-    ref = ShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, **kw)
-    pal = ShallowWater(
-        grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA,
-        backend="pallas_interpret", **kw,
-    )
-    return grid, ref, pal
-
-
 @pytest.mark.parametrize("case", ["tc2", "tc5"])
 def test_rhs_parity(case):
     n = 16
