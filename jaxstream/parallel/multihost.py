@@ -1,0 +1,164 @@
+"""Multi-host runtime: DCN across hosts, ICI within (SURVEY.md §2.6).
+
+The reference runs single-process with GSPMD-implicit collectives and
+names no communication backend; its declared scaling direction is more
+tiles over more chips ("flexible 1 to 54+ devices", deck p.8).  On TPU
+pods that means multi-host SPMD: one Python process per host, all hosts
+running the same program, with XLA routing collectives over ICI inside a
+slice and DCN between slices.  This module is that tier:
+
+  * :func:`initialize` — ``jax.distributed.initialize`` from explicit
+    args or the TPU environment (on Cloud TPU all arguments are
+    auto-detected; on CPU/GPU clusters pass coordinator/num/id or set
+    ``JAXSTREAM_COORD/NPROC/PROC_ID``).
+  * :func:`pod_mesh` — the global ``('panel', 'y', 'x')`` mesh over all
+    processes' devices, laid out so the panel axis (the halo-exchange
+    axis: 12 edge permutes/step) stays *within* a host's ICI domain
+    whenever ``local_device_count >= 6``, and the y/x block axes — whose
+    sub-panel halos are nearest-neighbor — span hosts.
+  * :func:`process_local_state` — build each host's shard of a global
+    array without materializing the global (per-host IC evaluation +
+    ``jax.make_array_from_process_local_data``).
+
+Single-process callers get the same API: ``initialize`` is a no-op and
+``pod_mesh`` degrades to the local-device mesh, so driver code is
+identical from laptop CPU to pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.logging import get_logger
+
+__all__ = ["initialize", "pod_mesh", "process_local_state", "is_distributed"]
+
+log = get_logger(__name__)
+
+_initialized = False
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Start the JAX distributed runtime (idempotent; no-op single-host).
+
+    On Cloud TPU VMs all three arguments auto-detect from the metadata
+    server; elsewhere they come from the arguments or the environment
+    (``JAXSTREAM_COORD``, ``JAXSTREAM_NPROC``, ``JAXSTREAM_PROC_ID``).
+    Must run before the backend initializes (same ordering rule as the
+    reference's virtual-device flag, which it got wrong —
+    ``JAX-DevLab-Examples.py:64-73``; SURVEY.md §7 pitfalls).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("JAXSTREAM_COORD")
+    num_processes = num_processes or _env_int("JAXSTREAM_NPROC")
+    process_id = process_id if process_id is not None else _env_int("JAXSTREAM_PROC_ID")
+    # A pod means multiple workers; single-entry TPU_WORKER_HOSTNAMES (set
+    # by some single-chip TPU runtimes) must NOT trigger auto-init.
+    workers = [w for w in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if w]
+    on_tpu_pod = len(workers) > 1 or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+    if coordinator_address is None and not on_tpu_pod:
+        log.info("distributed: single-process (no coordinator configured)")
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        # Pod-looking env without a reachable coordinator (or already
+        # initialized): stay single-process rather than dying at import
+        # time of every driver.
+        log.warning("distributed: auto-init failed (%s); running single-process", e)
+        return
+    _initialized = True
+    log.info(
+        "distributed: process %d/%d, %d local + %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def pod_mesh(
+    devices: Optional[Sequence] = None,
+    panel: int = 6,
+    axis_names=("panel", "y", "x"),
+) -> Mesh:
+    """Global 3-D mesh over every process's devices, ICI-aware.
+
+    Device order: ``jax.devices()`` is grouped by process, and within a
+    process by ICI locality.  Reshaping that order to ``(panel, y, x)``
+    row-major puts the fastest-varying axis ('x') on adjacent devices, so
+    with ``local >= 6`` whole panels sit inside one host: the 12
+    cube-edge permutes ride ICI, and only sub-panel strip halos (y/x
+    axes) cross DCN — the traffic that is both smallest and
+    nearest-neighbor.  With fewer local devices than panels the panel
+    axis necessarily spans hosts; the mesh is still valid, just
+    DCN-heavier (log notes which).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    d = len(devs)
+    if d % panel:
+        raise ValueError(
+            f"device count {d} not divisible by panel={panel}; pass an "
+            f"explicit device subset (got {d} global devices)"
+        )
+    rest = d // panel
+    sy = int(np.sqrt(rest))
+    while rest % sy:
+        sy -= 1
+    sx = rest // sy
+    arr = np.array(devs).reshape(panel, sy, sx)
+    local = jax.local_device_count()
+    if local >= panel and d > local:
+        log.info("pod mesh: panel axis within hosts (ICI); y/x over DCN")
+    elif d > local:
+        log.info("pod mesh: panel axis spans hosts (DCN on the edge permutes)")
+    return Mesh(arr, axis_names)
+
+
+def process_local_state(mesh: Mesh, spec: P, make_local):
+    """Assemble a globally-sharded array from per-host pieces.
+
+    ``make_local(index: tuple[slice, ...], global_shape) -> np.ndarray``
+    is called once per host with the index block this host owns; the
+    result becomes the local shard (no host ever holds the global array
+    — required once C-scale fields exceed one host's memory).
+    """
+    sharding = NamedSharding(mesh, spec)
+
+    def build(global_shape):
+        # All local devices' slices; make_local evaluates only this block.
+        local_idx = sharding.addressable_devices_indices_map(tuple(global_shape))
+        # Union of this host's slices is a contiguous block in each dim
+        # for row-major meshes; evaluate per device shard and stitch.
+        arrays = [
+            jax.device_put(np.ascontiguousarray(make_local(idx, global_shape)), dev)
+            for dev, idx in local_idx.items()
+        ]
+        return jax.make_array_from_single_device_arrays(
+            tuple(global_shape), sharding, arrays
+        )
+
+    return build

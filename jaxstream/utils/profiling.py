@@ -1,0 +1,177 @@
+"""Profiling and roofline analysis — the observability subsystem.
+
+The reference does performance analysis offline with a roofline model
+("Roofline (TPU v4 class)": peak BW 900 GB/s, 275 TFLOP/s FP32, ridge
+305.6 flops/byte; FV-PLR at 870 flops/cell, AI ~ 0.25 — deck p.19;
+SURVEY.md §5 "Tracing / profiling" + §6).  This module makes that frame a
+first-class tool:
+
+  * :func:`cost_analysis` asks XLA itself for the compiled program's
+    flops and bytes — no hand counting, and it reflects what fusion
+    actually kept.
+  * :func:`roofline` turns (flops, bytes, measured seconds) into the
+    deck's chart: arithmetic intensity, achieved vs roof throughput,
+    and which resource binds.
+  * :class:`StepTimer` measures steady-state step time without compile
+    skew; :func:`trace` wraps ``jax.profiler`` for TensorBoard traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import statistics
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+
+__all__ = [
+    "HardwareRoof", "TPU_V4_CLASS", "TPU_V5E", "TPU_V5P",
+    "cost_analysis", "roofline", "Roofline", "StepTimer", "trace",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareRoof:
+    """Peak memory bandwidth and compute for a roofline chart."""
+    name: str
+    hbm_gbps: float          # GB/s
+    peak_tflops: float       # TFLOP/s at the working precision
+
+    @property
+    def ridge(self) -> float:
+        """Flops/byte where the machine turns compute-bound."""
+        return self.peak_tflops * 1e12 / (self.hbm_gbps * 1e9)
+
+
+# The deck's example roofline (p.19) and the chips this repo targets.
+TPU_V4_CLASS = HardwareRoof("TPU v4 class (deck p.19)", 900.0, 275.0)
+TPU_V5E = HardwareRoof("TPU v5e", 819.0, 197.0)       # bf16 peak; f32 ~ half
+TPU_V5P = HardwareRoof("TPU v5p", 2765.0, 459.0)
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
+    """XLA's own cost model for ``jit(fn)(*args)``: flops, bytes accessed.
+
+    Returns ``{"flops": F, "bytes": B, "ai": F/B}`` from the compiled
+    executable — post-fusion, so it reflects real HBM traffic estimates.
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    costs = compiled.cost_analysis()
+    if isinstance(costs, list):  # older jax returns [dict]
+        costs = costs[0]
+    flops = float(costs.get("flops", 0.0))
+    nbytes = float(costs.get("bytes accessed", 0.0))
+    return {
+        "flops": flops,
+        "bytes": nbytes,
+        "ai": flops / nbytes if nbytes else float("inf"),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class Roofline:
+    """One point on the roofline chart, with the roof it's plotted against."""
+    flops: float
+    bytes: float
+    seconds: float
+    roof: HardwareRoof
+
+    @property
+    def ai(self) -> float:
+        return self.flops / self.bytes if self.bytes else float("inf")
+
+    @property
+    def achieved_tflops(self) -> float:
+        return self.flops / self.seconds / 1e12
+
+    @property
+    def achieved_gbps(self) -> float:
+        return self.bytes / self.seconds / 1e9
+
+    @property
+    def bound(self) -> str:
+        return "memory" if self.ai < self.roof.ridge else "compute"
+
+    @property
+    def roof_tflops(self) -> float:
+        """Attainable TFLOP/s at this AI (the roofline itself)."""
+        return min(self.roof.peak_tflops, self.ai * self.roof.hbm_gbps * 1e-3)
+
+    @property
+    def efficiency(self) -> float:
+        """Achieved / attainable at this AI (1.0 = on the roof)."""
+        return self.achieved_tflops / self.roof_tflops if self.roof_tflops else 0.0
+
+    def report(self) -> str:
+        return (
+            f"roofline [{self.roof.name}]: AI={self.ai:.3f} flops/byte "
+            f"(ridge {self.roof.ridge:.1f} -> {self.bound}-bound); "
+            f"achieved {self.achieved_tflops:.2f} TFLOP/s, "
+            f"{self.achieved_gbps:.0f} GB/s; "
+            f"roof at this AI {self.roof_tflops:.2f} TFLOP/s "
+            f"({100 * self.efficiency:.0f}% of attainable)"
+        )
+
+
+def roofline(fn: Callable, *args, seconds: float,
+             roof: HardwareRoof = TPU_V4_CLASS, **kwargs) -> Roofline:
+    """Roofline point for one measured execution of ``fn(*args)``."""
+    c = cost_analysis(fn, *args, **kwargs)
+    return Roofline(c["flops"], c["bytes"], seconds, roof)
+
+
+class StepTimer:
+    """Steady-state step timing: call ``t = timer(step_fn, state)``.
+
+    Blocks on the result each rep, so each sample is one full device
+    round-trip; the first ``discard`` samples (compile + warmup) are
+    dropped from the stats.
+    """
+
+    def __init__(self, discard: int = 1):
+        self.discard = discard
+        self.samples: list = []
+
+    def time(self, fn: Callable, *args, reps: int = 10, **kwargs):
+        out = None
+        for _ in range(self.discard + reps):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            self.samples.append(time.perf_counter() - t0)
+        return out
+
+    @property
+    def kept(self) -> Sequence[float]:
+        return self.samples[self.discard:]
+
+    def stats(self) -> Dict[str, float]:
+        k = sorted(self.kept)
+        if not k:
+            return {}
+        return {
+            "n": len(k),
+            "mean_s": statistics.fmean(k),
+            "min_s": k[0],
+            "p50_s": k[len(k) // 2],
+            "p90_s": k[int(len(k) * 0.9) - 1 if len(k) > 1 else 0],
+        }
+
+    def sim_days_per_sec(self, dt: float, steps_per_call: int = 1) -> float:
+        s = self.stats()
+        if not s:
+            return 0.0
+        return steps_per_call * dt / 86400.0 / s["p50_s"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """``with trace('/tmp/tb'):`` — jax.profiler trace for TensorBoard/xprof."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,63 @@
+"""Multi-host layer tests (single-process degradation + shard assembly).
+
+Real DCN needs a pod; what is testable here is the single-process
+contract: initialize() no-ops, pod_mesh builds the right (panel, y, x)
+topology from virtual devices, and process_local_state assembles a
+sharded global array from per-block evaluation without a global
+materialization.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from jaxstream.parallel import multihost
+
+
+def test_initialize_single_process_noop():
+    multihost.initialize()  # no coordinator configured -> no-op
+    assert jax.process_count() == 1
+    assert not multihost.is_distributed()
+
+
+def test_pod_mesh_shape_and_order():
+    devs = jax.devices("cpu")[:6]
+    mesh = multihost.pod_mesh(devices=devs)
+    assert mesh.axis_names == ("panel", "y", "x")
+    assert mesh.devices.shape == (6, 1, 1)
+    # Row-major: panel axis follows jax.devices() order.
+    assert list(mesh.devices.ravel()) == devs
+
+
+def test_pod_mesh_subpanel_split():
+    devs = jax.devices("cpu")[:8]  # does not divide by 6
+    with pytest.raises(ValueError, match="not divisible"):
+        multihost.pod_mesh(devices=devs)
+    mesh = multihost.pod_mesh(devices=devs[:6] + devs[:6], panel=6)
+    assert mesh.devices.shape == (6, 1, 2)
+
+
+def test_process_local_state_assembles_global():
+    devs = jax.devices("cpu")[:6]
+    mesh = multihost.pod_mesh(devices=devs)
+    shape = (6, 8, 8)
+    calls = []
+
+    def make_local(idx, global_shape):
+        calls.append(idx)
+        # Evaluate "analytically" on the block: value = face index.
+        face = idx[0].start if idx[0].start is not None else 0
+        block_shape = [
+            len(range(*s.indices(n))) for s, n in zip(idx, global_shape)
+        ]
+        return np.full(block_shape, float(face), dtype=np.float32)
+
+    build = multihost.process_local_state(mesh, P("panel", "y", "x"), make_local)
+    arr = build(shape)
+    assert arr.shape == shape
+    assert len(calls) == 6  # one evaluation per device shard, no global
+    got = np.asarray(arr)
+    for f in range(6):
+        np.testing.assert_array_equal(got[f], np.full((8, 8), float(f)))

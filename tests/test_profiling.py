@@ -1,0 +1,67 @@
+"""Profiling/roofline subsystem tests (deck p.19 analysis frame as code)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jaxstream.utils.profiling import (
+    TPU_V4_CLASS,
+    Roofline,
+    StepTimer,
+    cost_analysis,
+    roofline,
+)
+
+
+def test_ridge_matches_deck():
+    # Deck p.19: 275 TFLOP/s / 900 GB/s = 305.6 flops/byte.
+    assert TPU_V4_CLASS.ridge == pytest.approx(305.6, abs=0.1)
+
+
+def test_cost_analysis_counts_matmul_flops():
+    a = jnp.ones((256, 256), jnp.float32)
+
+    def f(x):
+        return x @ x
+
+    c = cost_analysis(f, a)
+    # 2*N^3 flops for a square matmul, modulo small compiler accounting.
+    assert c["flops"] == pytest.approx(2 * 256**3, rel=0.5)
+    assert c["bytes"] > 0
+    assert c["ai"] == c["flops"] / c["bytes"]
+
+
+def test_roofline_bound_classification():
+    memory_pt = Roofline(flops=1e9, bytes=1e9, seconds=1.0, roof=TPU_V4_CLASS)
+    assert memory_pt.bound == "memory"
+    assert memory_pt.ai == 1.0
+    # At AI=1, the roof is BW-limited: 900 GB/s * 1 flops/byte = 0.9 TFLOP/s.
+    assert memory_pt.roof_tflops == pytest.approx(0.9)
+
+    compute_pt = Roofline(flops=1e15, bytes=1e9, seconds=1.0, roof=TPU_V4_CLASS)
+    assert compute_pt.bound == "compute"
+    assert compute_pt.roof_tflops == pytest.approx(275.0)
+
+
+def test_roofline_from_measurement():
+    x = jnp.ones((64, 64), jnp.float32)
+    r = roofline(lambda v: (v * 2.0).sum(), x, seconds=1e-3)
+    assert r.bound == "memory"  # elementwise+reduce is far below the ridge
+    assert 0.0 <= r.efficiency
+
+
+def test_step_timer_discards_compile():
+    timer = StepTimer(discard=1)
+
+    @jax.jit
+    def step(x):
+        return x * 1.0001
+
+    x = jnp.ones((32, 32))
+    out = timer.time(step, x, reps=5)
+    assert np.all(np.isfinite(np.asarray(out)))
+    s = timer.stats()
+    assert s["n"] == 5
+    assert s["min_s"] <= s["p50_s"] <= s["p90_s"]
+    assert timer.sim_days_per_sec(dt=86400.0) > 0  # 1 sim-day/step
