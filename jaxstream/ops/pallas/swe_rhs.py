@@ -255,6 +255,13 @@ def make_swe_rhs_pallas(
             jax.ShapeDtypeStruct((6, n, n), jnp.float32),
             jax.ShapeDtypeStruct((3, 6, n, n), jnp.float32),
         ],
+        # Whole-face blocks at C384 need ~26 MB of scoped VMEM for the
+        # stencil intermediates — above the compiler's 16 MB default but
+        # well inside the chip's 128 MB VMEM.  (C768+ would need row-band
+        # tiling instead.)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
         interpret=interpret,
     )
 

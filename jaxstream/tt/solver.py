@@ -1,0 +1,142 @@
+"""TT-format numerics: time stepping directly on compressed cores.
+
+The deck's research thesis (p.3/5/19; SURVEY.md §5 "Tensor-Train
+subsystem"): keep the field in TT form and apply the PDE operators to
+the *cores*, never decompressing — N x N work becomes O(N r^2) core
+contractions (small matmuls, the MXU's native shape), and rank
+re-truncation (``tt_round``) after each linear combination keeps r
+bounded.  LANL demonstrated 124x on Cartesian-2D SWE this way (Danis et
+al. 2024, arXiv:2408.03483, deck p.14).
+
+This module implements that machinery for *separable linear* operators
+(sums of Kronecker terms ``I x..x A_k x..x I``), which covers diffusion
+and constant-coefficient advection on a 2-D panel exactly:
+
+  * :func:`tt_apply_mode` — matrix acting on one TT mode: a single
+    einsum on one core, O(n r^2) flops.
+  * :class:`KroneckerOperator` — sum of mode-matrices; ``apply`` maps a
+    TT to a TT (ranks add across terms; round after).
+  * :func:`tt_rk_step` — SSPRK3/Euler in TT arithmetic with rounding
+    after every accumulation (the standard "step-and-truncate" scheme).
+  * :func:`diff2_periodic` / :func:`diff1_periodic` — 1-D FV stencil
+    matrices to assemble 2-D operators from.
+
+The nonlinear SWE terms need TT cross-approximation to stay compressed
+(roadmap, SURVEY.md §2.2); the cubed-sphere production path remains the
+dense solver in :mod:`jaxstream.models` — this is the compressed-numerics
+subsystem the reference describes, validated against the dense oracle in
+tests/test_tt_solver.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor_train import TTTensor, tt_add, tt_round, tt_scale
+
+__all__ = [
+    "tt_apply_mode",
+    "KroneckerOperator",
+    "diff2_periodic",
+    "diff1_periodic",
+    "tt_rk_step",
+    "make_tt_stepper",
+]
+
+
+def tt_apply_mode(tt: TTTensor, mode: int, mat) -> TTTensor:
+    """Apply ``mat`` (m_out, m_in) to TT mode ``mode``: one core einsum."""
+    cores = list(tt.cores)
+    cores[mode] = jnp.einsum("ij,ajb->aib", mat, cores[mode])
+    return TTTensor(cores=cores, qtt_meta=tt.qtt_meta)
+
+
+@dataclasses.dataclass
+class KroneckerOperator:
+    """L = sum_k (I x ... x mat_k at mode_k x ... x I).
+
+    ``terms``: list of (mode, matrix).  Applying to a TT of rank r gives
+    rank ``len(terms) * r`` (each Kronecker term keeps the input's ranks;
+    the sum concatenates them) — call ``tt_round`` after.
+    """
+
+    terms: List[Tuple[int, jnp.ndarray]]
+
+    def apply(self, tt: TTTensor) -> TTTensor:
+        out = None
+        for mode, mat in self.terms:
+            term = tt_apply_mode(tt, mode, mat)
+            out = term if out is None else tt_add(out, term)
+        return out
+
+
+def diff2_periodic(n: int, dx: float, dtype=jnp.float64) -> jnp.ndarray:
+    """1-D periodic second-difference matrix (FV diffusion stencil)."""
+    m = np.zeros((n, n))
+    i = np.arange(n)
+    m[i, i] = -2.0
+    m[i, (i + 1) % n] = 1.0
+    m[i, (i - 1) % n] = 1.0
+    return jnp.asarray(m / (dx * dx), dtype=dtype)
+
+
+def diff1_periodic(n: int, dx: float, dtype=jnp.float64) -> jnp.ndarray:
+    """1-D periodic centered first-difference matrix (advection stencil)."""
+    m = np.zeros((n, n))
+    i = np.arange(n)
+    m[i, (i + 1) % n] = 1.0
+    m[i, (i - 1) % n] = -1.0
+    return jnp.asarray(m / (2.0 * dx), dtype=dtype)
+
+
+def tt_rk_step(
+    rhs: Callable[[TTTensor], TTTensor],
+    q: TTTensor,
+    dt: float,
+    max_rank: int,
+    scheme: str = "ssprk3",
+) -> TTTensor:
+    """One time step in TT arithmetic, rounding after each combination.
+
+    Rounding IS the compression: every axpy would otherwise grow ranks
+    multiplicatively over steps.  Mirrors jaxstream.stepping's schemes.
+    """
+
+    def axpy(y: TTTensor, a: float, k: TTTensor) -> TTTensor:
+        return tt_round(tt_add(y, tt_scale(k, a)), max_rank=max_rank)
+
+    if scheme == "euler":
+        return axpy(q, dt, rhs(q))
+    if scheme == "ssprk3":
+        y1 = axpy(q, dt, rhs(q))
+        y2_ = axpy(y1, dt, rhs(y1))
+        y2 = tt_round(
+            tt_add(tt_scale(q, 0.75), tt_scale(y2_, 0.25)), max_rank=max_rank
+        )
+        y3 = axpy(y2, 0.5 * dt, rhs(y2))
+        return tt_round(
+            tt_add(tt_scale(q, 1.0 / 3.0), tt_scale(y3, 2.0 / 3.0)),
+            max_rank=max_rank,
+        )
+    raise ValueError(f"unknown scheme {scheme!r}")
+
+
+def make_tt_stepper(
+    op: KroneckerOperator,
+    dt: float,
+    max_rank: int,
+    scheme: str = "ssprk3",
+) -> Callable[[TTTensor], TTTensor]:
+    """``step(q_tt) -> q_tt`` for dq/dt = L q, all in TT format."""
+
+    def rhs(q: TTTensor) -> TTTensor:
+        return tt_round(op.apply(q), max_rank=max_rank)
+
+    def step(q: TTTensor) -> TTTensor:
+        return tt_rk_step(rhs, q, dt, max_rank, scheme)
+
+    return step
