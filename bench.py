@@ -73,7 +73,25 @@ def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=200):
                              b_ext=b_ext)
     state = model.initial_state(h_ext, v_ext)
 
-    step = model.make_step(dt, "ssprk3")
+    # Fused extended-state stepper (RHS + RK stage combo in one kernel per
+    # face) when its stage kernels compile on this chip; classic path
+    # otherwise.  The probe runs one real fused step so a Mosaic compile
+    # failure (VMEM limits, shape limits) falls back instead of crashing.
+    fused = model.backend == "pallas"
+    if fused:
+        try:
+            step = model.make_fused_step(dt, in_kernel_exchange=True)
+            y_probe = model.extend_state(state, with_strips=True)
+            jax.block_until_ready(jax.jit(step)(y_probe, jnp.float32(0.0)))
+            state = y_probe
+            log("bench: using fused extended-state SSPRK3 stepper "
+                "(in-kernel exchange)")
+        except Exception as e:
+            fused = False
+            log(f"bench: fused stepper unavailable "
+                f"({type(e).__name__}: {e}); using classic stepper")
+    if not fused:
+        step = model.make_step(dt, "ssprk3")
 
     # One compiled executable for any step count: nsteps rides the carry as
     # a traced bound (fori_loop lowers to a while), so the timed region is

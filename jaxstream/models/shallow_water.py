@@ -111,6 +111,72 @@ class ShallowWater(Model):
             "v": self.grid.interior(v_ext),
         }
 
+    # -- fused extended-state fast path (TPU) -------------------------------
+    def extend_state(self, state: State, with_strips: bool = False) -> State:
+        """Interior state -> extended state (ghosts zeroed; filled on use).
+
+        ``with_strips=True`` adds the canonical edge-strip carry
+        (``sh``/``sv``) used by the in-kernel-exchange stepper.
+        """
+        from ..ops.fv import embed_interior
+
+        g = self.grid
+        y = {k: embed_interior(g, v) for k, v in state.items()}
+        if with_strips:
+            from ..ops.pallas.swe_step import raw_strips
+
+            y["sh_sn"], y["sh_we"] = raw_strips(y["h"], g.n, g.halo)
+            y["sv_sn"], y["sv_we"] = raw_strips(y["v"], g.n, g.halo)
+        return y
+
+    def restrict_state(self, y_ext: State) -> State:
+        """Extended state -> interior state (strip carries dropped)."""
+        return {k: self.grid.interior(v) for k, v in y_ext.items()
+                if k in ("h", "v")}
+
+    def make_fused_step(self, dt: float, in_kernel_exchange: bool = True):
+        """SSPRK3 step over *extended* state, one fused kernel per stage.
+
+        Each stage reads the ghost-filled state once from HBM and writes
+        the combined next-stage state once (RHS + stage axpy fused in
+        VMEM; :mod:`jaxstream.ops.pallas.swe_step`) — the minimum-traffic
+        formulation of the step for the memory-bound FV numerics (deck
+        p.19).  With ``in_kernel_exchange`` (default) the halo fill also
+        happens inside the kernel via the strip carry (state pytree
+        ``{"h","v","sh_sn","sh_we","sv_sn","sv_we"}``; build with
+        ``extend_state(state, with_strips=True)``); otherwise a
+        concat-layout jnp exchange runs between kernels.  Requires
+        ``backend='pallas'`` and ``nu4 == 0`` (the hyperdiffusion refill
+        pattern is a different dataflow); use :meth:`make_step` otherwise.
+        """
+        if self._pallas_rhs is None:
+            raise ValueError("make_fused_step requires backend='pallas'")
+        if self.nu4 != 0.0:
+            raise ValueError("make_fused_step does not support nu4 > 0")
+        g = self.grid
+        interpret = self.backend == "pallas_interpret"
+        if in_kernel_exchange:
+            from ..ops.pallas.swe_step import make_fused_ssprk3_step_inkernel
+
+            return make_fused_ssprk3_step_inkernel(
+                g.n, g.halo, g.dalpha, g.radius, self.gravity, self.omega,
+                dt, self.b_ext, scheme=self.scheme, limiter=self.limiter,
+                interpret=interpret,
+            )
+        from ..ops.pallas.swe_step import make_fused_ssprk3_step
+        from ..parallel.halo import make_concat_exchanger
+
+        # Concat-layout exchange: one read + one write per field instead
+        # of a 48-update scatter chain (the dominant cost once the RHS and
+        # stage combination are fused).
+        exchange = make_concat_exchanger(g.n, g.halo)
+        return make_fused_ssprk3_step(
+            g.n, g.halo, g.dalpha, g.radius, self.gravity, self.omega,
+            dt, exchange, self.b_ext,
+            scheme=self.scheme, limiter=self.limiter,
+            interpret=interpret,
+        )
+
     def _hyperdiffuse(self, q_ext):
         """-nu4 del^4 q (interior), with a ghost refill between Laplacians."""
         l1 = laplacian(self.grid, q_ext)

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ...geometry.cubed_sphere import FACE_AXES, extended_coords
 from ..reconstruct import plr_face_states, ppm_face_states
 
-__all__ = ["make_swe_rhs_pallas"]
+__all__ = ["make_swe_rhs_pallas", "rhs_core", "coord_rows", "pick_recon"]
 
 
 def _frame_scalars(ref, k):
@@ -59,8 +59,12 @@ def _basis(xr, yc, c0, cx, cy, radius, need):
     x2 = xr * xr
     y2 = yc * yc
     rho2 = one + x2 + y2
-    rho = jnp.sqrt(rho2)
-    inv_rho = one / rho
+    # rsqrt + reciprocal-multiply forms throughout: TPU VPU divides and
+    # sqrts are multi-cycle, and this basis is recomputed per RK stage
+    # (cheaper than streaming 20+ precomputed metric fields from HBM, but
+    # only if the transcendental count stays minimal).
+    inv_rho = jax.lax.rsqrt(rho2)
+    inv_rho2 = inv_rho * inv_rho
     dxda = one + x2
     dydb = one + y2
 
@@ -70,7 +74,7 @@ def _basis(xr, yc, c0, cx, cy, radius, need):
     if "rhat" in need:
         out["rhat"] = rhat
     if "sqrtg" in need:
-        out["sqrtg"] = R * R * dxda * dydb * inv_rho / rho2
+        out["sqrtg"] = R * R * dxda * dydb * inv_rho * inv_rho2
     if "e" in need or "a" in need:
         pcx = rhat[0] * cx[0] + rhat[1] * cx[1] + rhat[2] * cx[2]
         pcy = rhat[0] * cy[0] + rhat[1] * cy[1] + rhat[2] * cy[2]
@@ -84,18 +88,128 @@ def _basis(xr, yc, c0, cx, cy, radius, need):
         if "a" in need:
             # Closed-form 2x2 inverse metric of the equiangular map.
             R2 = R * R
-            rho4 = rho2 * rho2
-            gcom = R2 * dxda * dydb / rho4
+            inv_rho4 = inv_rho2 * inv_rho2
+            gcom = R2 * dxda * dydb * inv_rho4
             gaa = gcom * dxda
             gbb = gcom * dydb
             gab = -gcom * xr * yc
-            det = gaa * gbb - gab * gab
-            inv_aa = gbb / det
-            inv_ab = -gab / det
-            inv_bb = gaa / det
+            inv_det = one / (gaa * gbb - gab * gab)
+            inv_aa = gbb * inv_det
+            inv_ab = -gab * inv_det
+            inv_bb = gaa * inv_det
             out["a_a"] = [inv_aa * e_a[i] + inv_ab * e_b[i] for i in range(3)]
             out["a_b"] = [inv_ab * e_a[i] + inv_bb * e_b[i] for i in range(3)]
     return out
+
+
+def pick_recon(scheme: str, halo: int, n: int, limiter: str):
+    """Face-state reconstruction for the kernels (PLR default, PPM option)."""
+    if scheme == "ppm":
+        return functools.partial(ppm_face_states, h=halo, n=n)
+    return functools.partial(plr_face_states, h=halo, n=n, limiter=limiter)
+
+
+def coord_rows(n: int, halo: int):
+    """Gnomonic coordinate rows/cols for kernel broadcast, plus face frames.
+
+    Returns ``(x_row, xf_row, x_col, xf_col, frames)`` — the (1, M)/(M, 1)
+    tan-coordinate arrays and the (6, 3, 3) face-frame table (same source
+    of truth as the grid builders).
+    """
+    ac, af, _ = extended_coords(n, halo)
+    x_row = jnp.asarray(np.tan(ac), jnp.float32)[None, :]     # (1, M)
+    xf_row = jnp.asarray(np.tan(af), jnp.float32)[None, :]    # (1, M)
+    x_col = jnp.asarray(np.tan(ac), jnp.float32)[:, None]     # (M, 1)
+    xf_col = jnp.asarray(np.tan(af), jnp.float32)[:, None]    # (M, 1)
+    frames = jnp.asarray(FACE_AXES, jnp.float32)              # (6, 3, 3)
+    return x_row, xf_row, x_col, xf_col, frames
+
+
+def rhs_core(frame_ref, xr, xfr, yc, yfc, hf, v, bf, *,
+             n, halo, d, radius, gravity, omega, recon):
+    """One face's complete SWE right-hand side, as traceable kernel math.
+
+    ``hf``/``bf`` are (M, M) values, ``v`` a list of 3 (M, M) components
+    (ghosts filled); returns ``(dh, [dv0, dv1, dv2])`` interior (n, n)
+    tendencies.  Shared by the plain-RHS kernel and the fused SSPRK3 stage
+    kernel (:mod:`jaxstream.ops.pallas.swe_step`).
+    """
+    h0, h1 = halo, halo + n
+    inv2d = 1.0 / (2.0 * d)
+    c0 = _frame_scalars(frame_ref, 0)
+    cx = _frame_scalars(frame_ref, 1)
+    cy = _frame_scalars(frame_ref, 2)
+    g = jnp.float32(gravity)
+    two_omega = jnp.float32(2.0 * omega)
+
+    # ---- continuity: dh = -div(h v), PLR-upwind flux form ------------
+    # x-faces i = h0..h1 on interior rows: coords (xf cols, center rows).
+    bx = _basis(xfr[:, h0:h1 + 1], yc[h0:h1], c0, cx, cy, radius,
+                need=("a", "sqrtg"))
+    vxf = [0.5 * (v[i][h0:h1, h0 - 1:h1] + v[i][h0:h1, h0:h1 + 1])
+           for i in range(3)]
+    ux = (vxf[0] * bx["a_a"][0] + vxf[1] * bx["a_a"][1]
+          + vxf[2] * bx["a_a"][2])                       # (n, n+1)
+    qx = hf[h0:h1, :]                                    # (n, M)
+    qL, qR = recon(qx, -1)
+    fx = bx["sqrtg"] * (jnp.maximum(ux, 0.0) * qL
+                        + jnp.minimum(ux, 0.0) * qR)     # (n, n+1)
+
+    # y-faces.
+    by = _basis(xr[:, h0:h1], yfc[h0:h1 + 1], c0, cx, cy, radius,
+                need=("a", "sqrtg"))
+    vyf = [0.5 * (v[i][h0 - 1:h1, h0:h1] + v[i][h0:h1 + 1, h0:h1])
+           for i in range(3)]
+    uy = (vyf[0] * by["a_b"][0] + vyf[1] * by["a_b"][1]
+          + vyf[2] * by["a_b"][2])                       # (n+1, n)
+    qy = hf[:, h0:h1]                                    # (M, n)
+    qL, qR = recon(qy, -2)
+    fy = by["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
+                        + jnp.minimum(uy, 0.0) * qR)     # (n+1, n)
+
+    bc = _basis(xr[:, h0:h1], yc[h0:h1], c0, cx, cy, radius,
+                need=("rhat", "sqrtg", "a"))
+    inv_sg = 1.0 / bc["sqrtg"]
+    inv_sg_d = inv_sg * jnp.float32(1.0 / d)
+    dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
+
+    # ---- momentum: vector-invariant with Cartesian velocity ----------
+    # Band = interior +- 1 ring, for the centered first derivatives.
+    b0, b1 = h0 - 1, h1 + 1
+    bb = _basis(xr[:, b0:b1], yc[b0:b1], c0, cx, cy, radius, need=("e",))
+    vb_band = [v[i][b0:b1, b0:b1] for i in range(3)]     # (n+2, n+2)
+    va = (vb_band[0] * bb["e_a"][0] + vb_band[1] * bb["e_a"][1]
+          + vb_band[2] * bb["e_a"][2])
+    vbeta = (vb_band[0] * bb["e_b"][0] + vb_band[1] * bb["e_b"][1]
+             + vb_band[2] * bb["e_b"][2])
+    # zeta = (d vbeta/d alpha - d va/d beta) / sqrtg, interior cells.
+    dvb_da = (vbeta[1:-1, 2:] - vbeta[1:-1, :-2]) * jnp.float32(inv2d)
+    dva_db = (va[2:, 1:-1] - va[:-2, 1:-1]) * jnp.float32(inv2d)
+    zeta = (dvb_da - dva_db) * inv_sg
+
+    # Bernoulli function on the band: g (h + b) + |v|^2 / 2.
+    ke = 0.5 * (vb_band[0] * vb_band[0] + vb_band[1] * vb_band[1]
+                + vb_band[2] * vb_band[2])
+    bern = g * (hf[b0:b1, b0:b1] + bf[b0:b1, b0:b1]) + ke
+    dpa = (bern[1:-1, 2:] - bern[1:-1, :-2]) * jnp.float32(inv2d)
+    dpb = (bern[2:, 1:-1] - bern[:-2, 1:-1]) * jnp.float32(inv2d)
+
+    k = bc["rhat"]                                       # interior khat
+    fcor = two_omega * k[2]
+    absv = zeta + fcor
+
+    vi = [v[i][h0:h1, h0:h1] for i in range(3)]
+    # Tangentialize, then k x v, then assemble and re-project.
+    vdotk = vi[0] * k[0] + vi[1] * k[1] + vi[2] * k[2]
+    vt = [vi[i] - k[i] * vdotk for i in range(3)]
+    kxv = [k[1] * vt[2] - k[2] * vt[1],
+           k[2] * vt[0] - k[0] * vt[2],
+           k[0] * vt[1] - k[1] * vt[0]]
+    a_a, a_b = bc["a_a"], bc["a_b"]
+    dv = [-absv * kxv[i] - (a_a[i] * dpa + a_b[i] * dpb)
+          for i in range(3)]
+    dvdotk = dv[0] * k[0] + dv[1] * k[1] + dv[2] * k[2]
+    return dh, [dv[i] - k[i] * dvdotk for i in range(3)]
 
 
 def make_swe_rhs_pallas(
@@ -117,112 +231,23 @@ def make_swe_rhs_pallas(
     :meth:`jaxstream.models.shallow_water.ShallowWater.rhs`.
     """
     m = n + 2 * halo
-    h0, h1 = halo, halo + n
     d = float(dalpha)
-    inv2d = 1.0 / (2.0 * d)
-
-    if scheme == "ppm":
-        recon = functools.partial(ppm_face_states, h=halo, n=n)
-    else:
-        recon = functools.partial(
-            plr_face_states, h=halo, n=n, limiter=limiter
-        )
-
-    # 1-D gnomonic coordinates, shaped for broadcast inside the kernel
-    # (same source of truth as the grid builders).
-    ac, af, _ = extended_coords(n, halo)
-    x_row = jnp.asarray(np.tan(ac), jnp.float32)[None, :]     # (1, M)
-    xf_row = jnp.asarray(np.tan(af), jnp.float32)[None, :]    # (1, M)
-    x_col = jnp.asarray(np.tan(ac), jnp.float32)[:, None]     # (M, 1)
-    xf_col = jnp.asarray(np.tan(af), jnp.float32)[:, None]    # (M, 1)
-    frames = jnp.asarray(FACE_AXES, jnp.float32)              # (6, 3, 3)
+    recon = pick_recon(scheme, halo, n, limiter)
+    x_row, xf_row, x_col, xf_col, frames = coord_rows(n, halo)
 
     def kernel(frame_ref, xr_ref, xfr_ref, yc_ref, yfc_ref, h_ref, v_ref,
                b_ref, dh_ref, dv_ref):
-        c0 = _frame_scalars(frame_ref, 0)
-        cx = _frame_scalars(frame_ref, 1)
-        cy = _frame_scalars(frame_ref, 2)
-        g = jnp.float32(gravity)
-        two_omega = jnp.float32(2.0 * omega)
-
-        xr = xr_ref[:]                       # (1, M)
-        xfr = xfr_ref[:]                     # (1, M)
-        yc = yc_ref[:]                       # (M, 1) — same coords, beta axis
-        yfc = yfc_ref[:]
-
         hf = h_ref[0]                        # (M, M)
         v = [v_ref[0, 0], v_ref[1, 0], v_ref[2, 0]]
         bf = b_ref[0]
-
-        # ---- continuity: dh = -div(h v), PLR-upwind flux form ------------
-        # x-faces i = h0..h1 on interior rows: coords (xf cols, center rows).
-        bx = _basis(xfr[:, h0:h1 + 1], yc[h0:h1], c0, cx, cy, radius,
-                    need=("a", "sqrtg"))
-        vxf = [0.5 * (v[i][h0:h1, h0 - 1:h1] + v[i][h0:h1, h0:h1 + 1])
-               for i in range(3)]
-        ux = (vxf[0] * bx["a_a"][0] + vxf[1] * bx["a_a"][1]
-              + vxf[2] * bx["a_a"][2])                       # (n, n+1)
-        qx = hf[h0:h1, :]                                    # (n, M)
-        qL, qR = recon(qx, -1)
-        fx = bx["sqrtg"] * (jnp.maximum(ux, 0.0) * qL
-                            + jnp.minimum(ux, 0.0) * qR)     # (n, n+1)
-
-        # y-faces.
-        by = _basis(xr[:, h0:h1], yfc[h0:h1 + 1], c0, cx, cy, radius,
-                    need=("a", "sqrtg"))
-        vyf = [0.5 * (v[i][h0 - 1:h1, h0:h1] + v[i][h0:h1 + 1, h0:h1])
-               for i in range(3)]
-        uy = (vyf[0] * by["a_b"][0] + vyf[1] * by["a_b"][1]
-              + vyf[2] * by["a_b"][2])                       # (n+1, n)
-        qy = hf[:, h0:h1]                                    # (M, n)
-        qL, qR = recon(qy, -2)
-        fy = by["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
-                            + jnp.minimum(uy, 0.0) * qR)     # (n+1, n)
-
-        bc = _basis(xr[:, h0:h1], yc[h0:h1], c0, cx, cy, radius,
-                    need=("rhat", "sqrtg", "a"))
-        inv_sg_d = 1.0 / (bc["sqrtg"] * jnp.float32(d))
-        dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
+        dh, dv = rhs_core(
+            frame_ref, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            hf, v, bf, n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
         dh_ref[0] = dh
-
-        # ---- momentum: vector-invariant with Cartesian velocity ----------
-        # Band = interior +- 1 ring, for the centered first derivatives.
-        b0, b1 = h0 - 1, h1 + 1
-        bb = _basis(xr[:, b0:b1], yc[b0:b1], c0, cx, cy, radius, need=("e",))
-        vb_band = [v[i][b0:b1, b0:b1] for i in range(3)]     # (n+2, n+2)
-        va = (vb_band[0] * bb["e_a"][0] + vb_band[1] * bb["e_a"][1]
-              + vb_band[2] * bb["e_a"][2])
-        vbeta = (vb_band[0] * bb["e_b"][0] + vb_band[1] * bb["e_b"][1]
-                 + vb_band[2] * bb["e_b"][2])
-        # zeta = (d vbeta/d alpha - d va/d beta) / sqrtg, interior cells.
-        dvb_da = (vbeta[1:-1, 2:] - vbeta[1:-1, :-2]) * jnp.float32(inv2d)
-        dva_db = (va[2:, 1:-1] - va[:-2, 1:-1]) * jnp.float32(inv2d)
-        zeta = (dvb_da - dva_db) / bc["sqrtg"]
-
-        # Bernoulli function on the band: g (h + b) + |v|^2 / 2.
-        ke = 0.5 * (vb_band[0] * vb_band[0] + vb_band[1] * vb_band[1]
-                    + vb_band[2] * vb_band[2])
-        bern = g * (hf[b0:b1, b0:b1] + bf[b0:b1, b0:b1]) + ke
-        dpa = (bern[1:-1, 2:] - bern[1:-1, :-2]) * jnp.float32(inv2d)
-        dpb = (bern[2:, 1:-1] - bern[:-2, 1:-1]) * jnp.float32(inv2d)
-
-        k = bc["rhat"]                                       # interior khat
-        fcor = two_omega * k[2]
-        absv = zeta + fcor
-
-        vi = [v[i][h0:h1, h0:h1] for i in range(3)]
-        # Tangentialize, then k x v, then assemble and re-project.
-        vdotk = vi[0] * k[0] + vi[1] * k[1] + vi[2] * k[2]
-        vt = [vi[i] - k[i] * vdotk for i in range(3)]
-        kxv = [k[1] * vt[2] - k[2] * vt[1],
-               k[2] * vt[0] - k[0] * vt[2],
-               k[0] * vt[1] - k[1] * vt[0]]
-        a_a, a_b = bc["a_a"], bc["a_b"]
-        dv = [-absv * kxv[i] - (a_a[i] * dpa + a_b[i] * dpb)
-              for i in range(3)]
-        dvdotk = dv[0] * k[0] + dv[1] * k[1] + dv[2] * k[2]
         for i in range(3):
-            dv_ref[i, 0] = dv[i] - k[i] * dvdotk
+            dv_ref[i, 0] = dv[i]
 
     grid_spec = pl.GridSpec(
         grid=(6,),
