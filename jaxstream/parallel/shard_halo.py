@@ -20,10 +20,20 @@ an SPMD program, so it is carried as *data*: small ``(6, 4)`` parameter
 arrays sharded ``P('panel')``, selected with ``jnp.take``/``lax.switch``
 on the local scalar.  The program stays uniform; the data differs.
 
-Scope: one face per device along the panel axis (``panel=6``).  Sub-panel
-tiling (``tiles_per_edge > 1``) runs through the GSPMD path in
-:mod:`jaxstream.parallel.halo`; extending this explicit path to block
-meshes is roadmap work.
+Two tiers:
+
+* :func:`make_shard_halo_program` — one face per device (``panel=6``
+  mesh axis), the flagship 6-chip configuration.
+* :func:`make_block_halo_program` — sub-panel tiling, the reference's
+  planned ``tiles_per_edge > 1`` scaling
+  (``/root/reference/JAX-DevLab-Examples.py:31-37``, left unimplemented
+  there) made real: each face block-decomposes over the ``('y', 'x')``
+  mesh axes (``s x s`` blocks, ``6 s^2`` devices).  Intra-panel halos are
+  4 neighbor ``ppermute``s over the 'x'/'y' axes; the 12 cube edges
+  remain 4 race-free stages, each ONE joint ``ppermute`` over the full
+  ``('panel', 'y', 'x')`` product axis whose pairs connect only the
+  face-boundary blocks (block index mirrored when the edge pair reverses
+  orientation).
 """
 
 from __future__ import annotations
@@ -37,10 +47,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..geometry.connectivity import build_connectivity, build_schedule
+from ..geometry.connectivity import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    build_connectivity,
+    build_schedule,
+)
 from .halo import _fill_corners, read_strip, write_strip
 
-__all__ = ["ShardHaloProgram", "make_shard_halo_program"]
+__all__ = [
+    "BlockHaloProgram",
+    "ShardHaloProgram",
+    "make_block_halo_program",
+    "make_shard_halo_program",
+]
 
 
 class ShardHaloProgram:
@@ -96,7 +118,6 @@ def make_shard_halo_program(
     halo fill in 4 ``ppermute`` stages.
     """
     program = ShardHaloProgram(axis_name)
-    perms = program.perms
 
     def local_exchange(block, edge_sel, rev_sel):
         if block.shape[-3] != 1:
@@ -105,25 +126,190 @@ def make_shard_halo_program(
                 f"panel extent {block.shape[-3]} (use the GSPMD path in "
                 f"jaxstream.parallel.halo for other tilings)"
             )
-        writers = [
-            functools.partial(write_strip, face=0, edge=e) for e in range(4)
-        ]
-        for s, perm in enumerate(perms):
-            e_s = edge_sel[0, s]
-            r_s = rev_sel[0, s]
-            # All 4 canonical strips; select mine for this stage by data.
-            strips = jnp.stack(
-                [read_strip(block, 0, e, halo, n) for e in range(4)]
-            )
-            strip = jnp.take(strips, e_s, axis=0)
-            strip = jnp.where(r_s, jnp.flip(strip, axis=-1), strip)
-            strip = lax.ppermute(strip, axis_name, perm)
-            block = lax.switch(
-                e_s, [lambda b, st, w=w: w(b, strip=st) for w in writers],
-                block, strip,
+        for s, perm in enumerate(program.perms):
+            block = _cube_stage(
+                block, halo, n, axis_name, perm,
+                edge_sel[0, s], rev_sel[0, s], active=None,
             )
         if fill_corners:
             block = _fill_corners(block, halo, n)
+        return block
+
+    return program, local_exchange
+
+
+def _cube_stage(block, halo, n_loc, axis, perm, e_s, r_s, active):
+    """One race-free cube-edge stage on a local 1-face block.
+
+    Shared by the one-face-per-device and block-mesh programs: select my
+    canonical strip for this stage by data, flip if the pair reverses,
+    ``ppermute``, and write the received strip into my ghost ring.
+    ``active=None`` means every device participates (perfect matching on
+    faces); otherwise a scalar bool guards the write (boundary blocks
+    only).
+    """
+    writers = [
+        functools.partial(write_strip, face=0, edge=e) for e in range(4)
+    ] + [lambda b, strip: b]  # branch 4: inactive, keep block
+    # All 4 canonical strips; select mine for this stage by data.
+    strips = jnp.stack(
+        [read_strip(block, 0, e, halo, n_loc) for e in range(4)]
+    )
+    strip = jnp.take(strips, e_s, axis=0)
+    strip = jnp.where(r_s, jnp.flip(strip, axis=-1), strip)
+    strip = lax.ppermute(strip, axis, perm)
+    idx = e_s if active is None else jnp.where(active, e_s, 4)
+    return lax.switch(
+        idx, [lambda b, st, w=w: w(b, strip=st) for w in writers],
+        block, strip,
+    )
+
+
+def _block_coords(edge: int, k: int, s: int):
+    """(iy, ix) mesh coordinates of block ``k`` along a face edge."""
+    if edge == EDGE_S:
+        return 0, k
+    if edge == EDGE_N:
+        return s - 1, k
+    if edge == EDGE_W:
+        return k, 0
+    if edge == EDGE_E:
+        return k, s - 1
+    raise ValueError(edge)
+
+
+class BlockHaloProgram:
+    """Static schedule + per-device parameters for the block-mesh exchange.
+
+    Devices form a ``(6, s, s)`` mesh ``('panel', 'y', 'x')``; each holds
+    one extended ``(n_loc + 2*halo)^2`` block of a face (``n_loc = n/s``).
+
+    Attributes:
+      intra_perms: 4 ``(axis_name, [(src, dst), ...])`` neighbor shifts
+        (E->W, W->E, N->S, S->N ghost fills within a face).
+      cube_perms: 4 permutation lists over the joint ``(panel, y, x)``
+        linear index (row-major), one per race-free schedule stage.
+      edge_sel / rev_sel / active: ``(6, s, s, 4)`` per-device tables —
+        which local edge exchanges in stage t, whether its along-edge
+        index reverses, and whether this block participates (only
+        face-boundary blocks touch cube edges).
+    """
+
+    def __init__(self, s: int, axis_names=("panel", "y", "x")):
+        adj = build_connectivity()
+        schedule = build_schedule(adj)
+        self.s = s
+        self.axis_names = tuple(axis_names)
+        ax_panel, ax_y, ax_x = self.axis_names
+
+        # Intra-panel neighbor shifts (empty perms when s == 1).
+        fwd = [(i, i + 1) for i in range(s - 1)]
+        bwd = [(i + 1, i) for i in range(s - 1)]
+        self.intra_perms = [
+            (ax_x, fwd, EDGE_E, EDGE_W),   # east strip -> eastern nbr's W ghost
+            (ax_x, bwd, EDGE_W, EDGE_E),
+            (ax_y, fwd, EDGE_N, EDGE_S),
+            (ax_y, bwd, EDGE_S, EDGE_N),
+        ]
+
+        def lin(f, iy, ix):
+            return (f * s + iy) * s + ix
+
+        nstages = len(schedule)
+        edge_sel = np.zeros((6, s, s, nstages), dtype=np.int32)
+        rev_sel = np.zeros((6, s, s, nstages), dtype=bool)
+        active = np.zeros((6, s, s, nstages), dtype=bool)
+        self.cube_perms = []
+        for t, stage in enumerate(schedule):
+            perm = []
+            for pair in stage:
+                for link in pair:
+                    # link: my face/edge -> neighbor face/edge (directed).
+                    for k in range(s):
+                        kk = s - 1 - k if link.reversed_ else k
+                        src = lin(link.face, *_block_coords(link.edge, k, s))
+                        dst = lin(link.nbr_face,
+                                  *_block_coords(link.nbr_edge, kk, s))
+                        perm.append((src, dst))
+                    for k in range(s):
+                        iy, ix = _block_coords(link.edge, k, s)
+                        edge_sel[link.face, iy, ix, t] = link.edge
+                        rev_sel[link.face, iy, ix, t] = link.reversed_
+                        active[link.face, iy, ix, t] = True
+            # Participants pair off exactly: every boundary block of the
+            # stage's 6 edges sends one strip and receives one strip.
+            assert len(set(d for _, d in perm)) == len(perm)
+            self.cube_perms.append(perm)
+        self.edge_sel = jnp.asarray(edge_sel)
+        self.rev_sel = jnp.asarray(rev_sel)
+        self.active = jnp.asarray(active)
+
+    @property
+    def params(self):
+        """(6, s, s, 4) per-device tables; shard P('panel', 'y', 'x')."""
+        return {"edge_sel": self.edge_sel, "rev_sel": self.rev_sel,
+                "active": self.active}
+
+
+def make_block_halo_program(
+    n: int,
+    halo: int,
+    s: int,
+    axis_names=("panel", "y", "x"),
+    fill_corners: bool = True,
+):
+    """Build ``(program, local_exchange)`` for an ``s x s``-blocked mesh.
+
+    ``local_exchange(block, edge_sel, rev_sel, active)`` operates on a
+    local ``(..., 1, m_loc, m_loc)`` extended block with this device's
+    ``(1, 1, 1, 4)`` parameter rows; it fills intra-panel ghosts with 4
+    neighbor ``ppermute``s, then runs the 4 cube-edge stages as joint
+    ``ppermute``s over the whole device product axis.  ``s == 1`` reduces
+    to the one-face-per-device program.
+
+    Ghost corners are averaged per block (diagnostics); the dimension-
+    split stencils never read them, so block-seam corners carrying
+    averaged rather than true diagonal data does not affect the numerics.
+    """
+    if n % s:
+        raise ValueError(f"n={n} not divisible by blocks-per-edge s={s}")
+    n_loc = n // s
+    if n_loc < halo:
+        raise ValueError(f"local block {n_loc} smaller than halo {halo}")
+    program = BlockHaloProgram(s, axis_names)
+    joint = program.axis_names
+
+    def local_exchange(block, edge_sel, rev_sel, active):
+        if block.shape[-3] != 1:
+            raise ValueError(
+                f"block-halo path expects one block per device; got local "
+                f"panel extent {block.shape[-3]}"
+            )
+        # -- intra-panel ghosts (neighbor blocks of the same face) --------
+        # All 4 reads before any write: reads are interior-only and
+        # writes ghost-only, so expressing the independence lets XLA
+        # overlap the neighbor ppermutes on ICI (same pattern as
+        # halo.make_halo_exchanger's read-all-then-write-all).
+        moved = []
+        for axis, perm, e_send, e_recv in program.intra_perms:
+            if not perm:
+                continue
+            strip = read_strip(block, 0, e_send, halo, n_loc)
+            moved.append((e_recv, lax.ppermute(strip, axis, perm)))
+        for e_recv, strip in moved:
+            # Boundary blocks receive zeros here; the cube stages below
+            # overwrite those face-edge ghosts with the real data.
+            block = write_strip(block, 0, e_recv, strip)
+
+        # -- cube-edge stages ---------------------------------------------
+        for t, perm in enumerate(program.cube_perms):
+            block = _cube_stage(
+                block, halo, n_loc, joint, perm,
+                edge_sel[0, 0, 0, t], rev_sel[0, 0, 0, t],
+                active[0, 0, 0, t],
+            )
+        if fill_corners:
+            block = _fill_corners(block, halo, n_loc)
         return block
 
     return program, local_exchange

@@ -27,7 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..geometry.cubed_sphere import CubedSphereGrid
 from ..stepping import SCHEMES
 from .mesh import ShardingSetup
-from .shard_halo import make_shard_halo_program
+from .shard_halo import make_block_halo_program, make_shard_halo_program
 
 __all__ = ["make_sharded_stepper", "make_stepper_for", "shard_params"]
 
@@ -86,44 +86,131 @@ def shard_params(setup: ShardingSetup, tree):
     )
 
 
+def _to_blocks(a, n_loc: int, halo: int, s: int):
+    """Extended ``(..., 6, M, M)`` -> per-device ``(..., 6, s, s, m_loc,
+    m_loc)`` blocks (overlapping halo slices — NamedSharding cannot
+    express the overlap, so the blocks are materialized host-side once at
+    setup; they are static geometry, not per-step state)."""
+    m_loc = n_loc + 2 * halo
+    return jnp.stack([
+        jnp.stack([
+            a[..., by * n_loc : by * n_loc + m_loc,
+              bx * n_loc : bx * n_loc + m_loc]
+            for bx in range(s)
+        ], axis=-3)
+        for by in range(s)
+    ], axis=-4)
+
+
+def _is_extended(a, m: int) -> bool:
+    return a.ndim >= 2 and a.shape[-2:] == (m, m)
+
+
 def make_sharded_stepper(model, setup: ShardingSetup, example_state,
                          dt: float, scheme: str = "ssprk3"):
     """Build ``step(state, t) -> state`` running fully inside shard_map.
 
-    Requires the explicit-path mesh shape: panel axis of size 6, one face
-    per device (``sy = sx = 1``); state arrays are the usual interior
-    ``(6, n, n)`` / ``(3, 6, n, n)`` pytrees sharded over 'panel'.
+    Mesh shapes supported: panel axis of size 6 with a square ``s x s``
+    sub-panel block grid (``sy == sx == s``, ``n % s == 0``) — ``s = 1``
+    is the flagship one-face-per-device layout, ``s > 1`` the reference's
+    planned ``tiles_per_edge`` scaling run through the explicit
+    block-halo program.  State arrays are the usual interior ``(6, n, n)``
+    / ``(3, 6, n, n)`` pytrees sharded over (panel, y, x).
     ``example_state`` is only read for its tree structure/ranks.
     """
-    if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
+    grid = model.grid
+    if (setup.mesh is None or setup.panel != 6 or setup.sy != setup.sx
+            or grid.n % setup.sy):
         raise ValueError(
-            f"explicit shard_map path needs mesh (panel=6, y=1, x=1); got "
-            f"panel={setup.panel}, y={setup.sy}, x={setup.sx}. Use the "
-            f"GSPMD path (jax.jit over NamedSharding) for other layouts."
+            f"explicit shard_map path needs mesh (panel=6, y=s, x=s) with "
+            f"s dividing n={grid.n}; got panel={setup.panel}, y={setup.sy}, "
+            f"x={setup.sx}. Use the GSPMD path (jax.jit over NamedSharding) "
+            f"for other layouts."
         )
     mesh = setup.mesh
-    grid = model.grid
-    program, local_exchange = make_shard_halo_program(grid.n, grid.halo)
+    s = setup.sy
+    blocked = s > 1
+    if blocked:
+        if not dataclasses.is_dataclass(grid):
+            raise ValueError(
+                "block-mesh explicit path needs an eager CubedSphereGrid "
+                "(metrics='eager'); lazy grids are only wired for s=1."
+            )
+        n_loc = grid.n // s
+        program, local_exchange = make_block_halo_program(
+            grid.n, grid.halo, s
+        )
+    else:
+        n_loc = grid.n
+        program, local_exchange = make_shard_halo_program(grid.n, grid.halo)
+    m_ext = grid.m
+
+    def pack(a):
+        """Array + its PartitionSpec, block-slicing extended arrays."""
+        if blocked and _is_extended(a, m_ext):
+            blocks = _to_blocks(a, n_loc, grid.halo, s)
+            spec = P(*((None,) * (blocks.ndim - 5)
+                       + ("panel", "y", "x", None, None)))
+            return blocks, spec
+        return a, _face_spec(a)
 
     garrs = _grid_arrays(grid)
     aux = {k: v for k, v in vars(model).items()
            if isinstance(v, jax.Array) and v.ndim >= 3}
-    params = {"grid": garrs, "aux": aux, "halo": dict(program.params)}
-    params = shard_params(setup, params)
+    packed = {
+        "grid": {k: pack(v) for k, v in garrs.items()},
+        "aux": {k: pack(v) for k, v in aux.items()},
+    }
+    params = {g: {k: v[0] for k, v in d.items()} for g, d in packed.items()}
+    specs = {g: {k: v[1] for k, v in d.items()} for g, d in packed.items()}
+    params["halo"] = dict(program.params)
+    specs["halo"] = {
+        k: (P("panel", "y", "x", None) if blocked else P("panel", None))
+        for k in params["halo"]
+    }
+    params = {
+        g: {k: jax.device_put(v, NamedSharding(mesh, specs[g][k]))
+            for k, v in d.items()}
+        for g, d in params.items()
+    }
     stepper = SCHEMES[scheme]
 
+    def unblock(a):
+        # (..., 1, 1, 1, m_loc, m_loc) -> (..., 1, m_loc, m_loc)
+        return a.reshape(a.shape[:-5] + (1,) + a.shape[-2:])
+
     def local_step(p, state, t):
-        grid_l = _rebind(grid, p["grid"])
+        updates = {}
+        for k, v in p["grid"].items():
+            updates[k] = unblock(v) if (blocked and v.ndim >= 5
+                                        and v.shape[-2] == n_loc + 2 * grid.halo
+                                        and v.shape[-4] == 1) else v
+        if blocked:
+            grid_l = _rebind(grid, dict(updates, n=n_loc))
+        else:
+            grid_l = _rebind(grid, updates)
         m = copy.copy(model)
         m.grid = grid_l
+        # Inside shard_map the RHS runs on (1, m_loc, m_loc) local blocks;
+        # the 6-face Pallas kernel doesn't apply — use the jnp path (the
+        # parity oracle, numerics-identical).
+        m._pallas_rhs = None
+        m_loc = n_loc + 2 * grid.halo
         for k, v in p["aux"].items():
-            setattr(m, k, v)
-        es, rs = p["halo"]["edge_sel"], p["halo"]["rev_sel"]
-        m.exchange = lambda f: local_exchange(f, es, rs)
+            setattr(m, k, unblock(v) if (blocked and v.ndim >= 5
+                                         and v.shape[-2] == m_loc
+                                         and v.shape[-4] == 1) else v)
+        if blocked:
+            es, rs, ac = (p["halo"]["edge_sel"], p["halo"]["rev_sel"],
+                          p["halo"]["active"])
+            m.exchange = lambda f: local_exchange(f, es, rs, ac)
+        else:
+            es, rs = p["halo"]["edge_sel"], p["halo"]["rev_sel"]
+            m.exchange = lambda f: local_exchange(f, es, rs)
         return stepper(m.rhs, state, t, dt)
 
     state_specs = jax.tree_util.tree_map(_face_spec, example_state)
-    in_specs = (jax.tree_util.tree_map(_face_spec, params), state_specs, P())
+    in_specs = (specs, state_specs, P())
 
     smapped = jax.shard_map(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=state_specs,
