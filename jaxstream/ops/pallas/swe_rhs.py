@@ -37,7 +37,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ...geometry.cubed_sphere import FACE_AXES, extended_coords
 from ..reconstruct import plr_face_states, ppm_face_states
 
-__all__ = ["make_swe_rhs_pallas", "rhs_core", "coord_rows", "pick_recon"]
+__all__ = ["make_swe_rhs_pallas", "rhs_core", "rhs_core_fast", "coord_rows",
+           "pick_recon"]
 
 
 def _frame_scalars(ref, k):
@@ -208,6 +209,153 @@ def rhs_core(frame_ref, xr, xfr, yc, yfc, hf, v, bf, *,
     a_a, a_b = bc["a_a"], bc["a_b"]
     dv = [-absv * kxv[i] - (a_a[i] * dpa + a_b[i] * dpb)
           for i in range(3)]
+    dvdotk = dv[0] * k[0] + dv[1] * k[1] + dv[2] * k[2]
+    return dh, [dv[i] - k[i] * dvdotk for i in range(3)]
+
+
+def _fast_frame(xr, yc, radius):
+    """Scalar metric fields from orthonormal-frame closed forms.
+
+    The face frames (c0, cx, cy) are orthonormal, which collapses the
+    general basis algebra: ``rhat.cx = X/rho``, ``rhat.cy = Y/rho``,
+    ``rhat.c0 = 1/rho``, and the inverse metric is closed-form
+    (``g^aa = rho^2/(R^2 (1+X^2))``, ``g^bb = rho^2/(R^2 (1+Y^2))``,
+    ``g^ab = X Y rho^2/(R^2 (1+X^2)(1+Y^2))``; derived from
+    ``det g = (sqrtg)^2`` with ``(1+X^2)(1+Y^2) = rho^2 + X^2 Y^2``).
+    Everything divides only on the 1-D coordinate rows/cols (negligible),
+    so the per-cell cost is ~a dozen mul/adds plus one rsqrt — ~5x fewer
+    VPU flops than the general :func:`_basis` path, which matters because
+    the fused kernels recompute the metric every RK stage.
+
+    ``xr``: (1, mx) row of X = tan(alpha); ``yc``: (my, 1) col of Y.
+    """
+    one = jnp.float32(1.0)
+    R = jnp.float32(radius)
+    R2 = R * R
+    x2r = xr * xr
+    y2c = yc * yc
+    dxda_r = one + x2r                       # (1, mx) rows
+    dydb_c = one + y2c                       # (my, 1) cols
+    rho2 = dxda_r + y2c                      # 1 + X^2 + Y^2
+    inv_rho = jax.lax.rsqrt(rho2)
+    inv_rho2 = inv_rho * inv_rho
+    inv_R2dxda_r = one / (R2 * dxda_r)       # 1-D divides only
+    inv_dydb_c = one / dydb_c
+    sg_row = R2 * dxda_r
+    return {
+        "x": xr, "y": yc,
+        "inv_rho": inv_rho, "inv_rho2": inv_rho2,
+        "fa": (R * dxda_r) * inv_rho,
+        "fb": (R * dydb_c) * inv_rho,
+        "inv_aa": rho2 * inv_R2dxda_r,
+        "inv_bb": (rho2 * inv_R2dxda_r) * (dxda_r * inv_dydb_c),
+        "inv_ab": rho2 * ((xr * inv_R2dxda_r) * (yc * inv_dydb_c)),
+        "sqrtg": (sg_row * dydb_c) * (inv_rho2 * inv_rho),
+        "inv_sqrtg": ((one / sg_row) * inv_dydb_c) * (rho2 * rho2 * inv_rho),
+    }
+
+
+def rhs_core_fast(frame_ref, xr, xfr, yc, yfc, hf, v, bf, *,
+                  n, halo, d, radius, gravity, omega, recon):
+    """Flop-lean twin of :func:`rhs_core` (same discretization).
+
+    Identical stencils and upwinding; the metric algebra runs through
+    :func:`_fast_frame` scalar forms (v.e_a, v.a_a etc. as scalar
+    combinations of the three constant-frame dot products) instead of
+    materializing 3-vector bases.  Agreement with :func:`rhs_core` is
+    f32 op-reordering roundoff (tests/test_fused_step.py::test_fast_core_parity
+    compares the two cores directly; the oracle-path parity tests cover it
+    end to end).
+    """
+    h0, h1 = halo, halo + n
+    inv2d = jnp.float32(1.0 / (2.0 * d))
+    c0 = _frame_scalars(frame_ref, 0)
+    cx = _frame_scalars(frame_ref, 1)
+    cy = _frame_scalars(frame_ref, 2)
+    g = jnp.float32(gravity)
+    two_omega = jnp.float32(2.0 * omega)
+
+    def dots(vl):
+        """(v.c0, v.cx, v.cy) — the only 3-vector contractions needed."""
+        return (
+            vl[0] * c0[0] + vl[1] * c0[1] + vl[2] * c0[2],
+            vl[0] * cx[0] + vl[1] * cx[1] + vl[2] * cx[2],
+            vl[0] * cy[0] + vl[1] * cy[1] + vl[2] * cy[2],
+        )
+
+    def covariant(F, d0, dxx, dyy):
+        """(v.e_a, v.e_b, v.P) from the frame dots."""
+        vp = d0 + F["x"] * dxx + F["y"] * dyy
+        u = vp * F["inv_rho2"]
+        vea = F["fa"] * (dxx - F["x"] * u)
+        veb = F["fb"] * (dyy - F["y"] * u)
+        return vea, veb, vp
+
+    # ---- continuity ------------------------------------------------------
+    Fx = _fast_frame(xfr[:, h0:h1 + 1], yc[h0:h1], radius)
+    vxf = [0.5 * (v[i][h0:h1, h0 - 1:h1] + v[i][h0:h1, h0:h1 + 1])
+           for i in range(3)]
+    d0, dxx, dyy = dots(vxf)
+    vea, veb, _ = covariant(Fx, d0, dxx, dyy)
+    ux = Fx["inv_aa"] * vea + Fx["inv_ab"] * veb       # v . a_a
+    qL, qR = recon(hf[h0:h1, :], -1)
+    fx = Fx["sqrtg"] * (jnp.maximum(ux, 0.0) * qL
+                        + jnp.minimum(ux, 0.0) * qR)
+
+    Fy = _fast_frame(xr[:, h0:h1], yfc[h0:h1 + 1], radius)
+    vyf = [0.5 * (v[i][h0 - 1:h1, h0:h1] + v[i][h0:h1 + 1, h0:h1])
+           for i in range(3)]
+    d0, dxx, dyy = dots(vyf)
+    vea, veb, _ = covariant(Fy, d0, dxx, dyy)
+    uy = Fy["inv_ab"] * vea + Fy["inv_bb"] * veb       # v . a_b
+    qL, qR = recon(hf[:, h0:h1], -2)
+    fy = Fy["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
+                        + jnp.minimum(uy, 0.0) * qR)
+
+    Fc = _fast_frame(xr[:, h0:h1], yc[h0:h1], radius)
+    inv_sg_d = Fc["inv_sqrtg"] * jnp.float32(1.0 / d)
+    dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
+
+    # ---- momentum --------------------------------------------------------
+    b0, b1 = h0 - 1, h1 + 1
+    Fb = _fast_frame(xr[:, b0:b1], yc[b0:b1], radius)
+    vb = [v[i][b0:b1, b0:b1] for i in range(3)]
+    d0, dxx, dyy = dots(vb)
+    va, vbeta, _ = covariant(Fb, d0, dxx, dyy)
+    dvb_da = (vbeta[1:-1, 2:] - vbeta[1:-1, :-2]) * inv2d
+    dva_db = (va[2:, 1:-1] - va[:-2, 1:-1]) * inv2d
+    zeta = (dvb_da - dva_db) * Fc["inv_sqrtg"]
+
+    ke = 0.5 * (vb[0] * vb[0] + vb[1] * vb[1] + vb[2] * vb[2])
+    bern = g * (hf[b0:b1, b0:b1] + bf[b0:b1, b0:b1]) + ke
+    dpa = (bern[1:-1, 2:] - bern[1:-1, :-2]) * inv2d
+    dpb = (bern[2:, 1:-1] - bern[:-2, 1:-1]) * inv2d
+
+    # grad = (a_a dpa + a_b dpb) expressed in the constant frame:
+    # A cx + B cy + C c0 with scalar coefficient fields.
+    ca = Fc["inv_aa"] * dpa + Fc["inv_ab"] * dpb
+    cb = Fc["inv_ab"] * dpa + Fc["inv_bb"] * dpb
+    uu = ca * Fc["fa"]
+    ww = cb * Fc["fb"]
+    tt = (uu * Fc["x"] + ww * Fc["y"]) * Fc["inv_rho2"]
+    A = uu - tt * Fc["x"]
+    B = ww - tt * Fc["y"]
+    C = -tt
+    grad = [A * cx[i] + B * cy[i] + C * c0[i] for i in range(3)]
+
+    # rhat at centers, componentwise from the frame.
+    ir = Fc["inv_rho"]
+    k = [ir * (c0[i] + Fc["x"] * cx[i] + Fc["y"] * cy[i]) for i in range(3)]
+    fcor = two_omega * k[2]
+    absv = zeta + fcor
+
+    vi = [v[i][h0:h1, h0:h1] for i in range(3)]
+    vdotk = vi[0] * k[0] + vi[1] * k[1] + vi[2] * k[2]
+    vt = [vi[i] - k[i] * vdotk for i in range(3)]
+    kxv = [k[1] * vt[2] - k[2] * vt[1],
+           k[2] * vt[0] - k[0] * vt[2],
+           k[0] * vt[1] - k[1] * vt[0]]
+    dv = [-absv * kxv[i] - grad[i] for i in range(3)]
     dvdotk = dv[0] * k[0] + dv[1] * k[1] + dv[2] * k[2]
     return dh, [dv[i] - k[i] * dvdotk for i in range(3)]
 

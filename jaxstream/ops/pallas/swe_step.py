@@ -45,7 +45,7 @@ from ...geometry.connectivity import (
     EDGE_W,
     build_connectivity,
 )
-from .swe_rhs import coord_rows, pick_recon, rhs_core
+from .swe_rhs import coord_rows, pick_recon, rhs_core, rhs_core_fast
 
 __all__ = [
     "make_swe_stage_pallas",
@@ -72,6 +72,7 @@ def make_swe_stage_pallas(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    fast: bool = True,
 ):
     """Build one fused RK-stage call with static coefficients ``(a, b)``.
 
@@ -100,7 +101,7 @@ def make_swe_stage_pallas(
         v = [vc_ref[0, 0], vc_ref[1, 0], vc_ref[2, 0]]
         bf = b_ref[0]
 
-        dh, dv = rhs_core(
+        dh, dv = (rhs_core_fast if fast else rhs_core)(
             frame_ref, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
             hf, v, bf, n=n, halo=halo, d=d, radius=radius,
             gravity=gravity, omega=omega, recon=recon,
@@ -181,6 +182,7 @@ def make_fused_ssprk3_step(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    fast: bool = True,
 ):
     """Build ``step(y_ext, t) -> y_ext`` over extended-state pytrees.
 
@@ -191,7 +193,7 @@ def make_fused_ssprk3_step(
     """
     mk = lambda a, b: make_swe_stage_pallas(
         n, halo, dalpha, radius, gravity, omega, dt, a, b,
-        scheme=scheme, limiter=limiter, interpret=interpret,
+        scheme=scheme, limiter=limiter, interpret=interpret, fast=fast,
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)
@@ -303,6 +305,7 @@ def make_swe_stage_inkernel(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    fast: bool = True,
 ):
     """One fused RK stage with the halo fill inside the kernel.
 
@@ -358,7 +361,7 @@ def make_swe_stage_inkernel(
              for i in range(3)]
         bf = b_ref[0]
 
-        dh, dv = rhs_core(
+        dh, dv = (rhs_core_fast if fast else rhs_core)(
             frame_ref, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
             hf, v, bf, n=n, halo=halo, d=d, radius=radius,
             gravity=gravity, omega=omega, recon=recon,
@@ -462,6 +465,7 @@ def make_fused_ssprk3_step_inkernel(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    fast: bool = True,
 ):
     """``step(y, t) -> y``, ``y = {h, v, sh_sn, sh_we, sv_sn, sv_we}``.
 
@@ -473,7 +477,7 @@ def make_fused_ssprk3_step_inkernel(
     """
     mk = lambda a, b: make_swe_stage_inkernel(
         n, halo, dalpha, radius, gravity, omega, dt, a, b,
-        scheme=scheme, limiter=limiter, interpret=interpret,
+        scheme=scheme, limiter=limiter, interpret=interpret, fast=fast,
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)

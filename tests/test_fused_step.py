@@ -60,3 +60,28 @@ def test_fused_step_requires_pallas_and_no_nu4():
                          backend="pallas_interpret", nu4=1e12)
     with pytest.raises(ValueError, match="nu4"):
         hyper.make_fused_step(60.0)
+
+
+def test_fast_core_parity():
+    """rhs_core_fast (closed-form orthonormal-frame metric) vs rhs_core.
+
+    Same discretization, different metric algebra — directly compares the
+    two cores through one fused stage, far tighter than the oracle-path
+    tolerance above.
+    """
+    from jaxstream.ops.pallas.swe_step import make_swe_stage_pallas
+
+    n = 12
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    mk = lambda fast: make_swe_stage_pallas(
+        grid.n, grid.halo, grid.dalpha, grid.radius, EARTH_GRAVITY,
+        EARTH_OMEGA, 600.0, 0.75, 0.25, interpret=True, fast=fast)
+    h0, v0 = h_ext, v_ext
+    hs, vs = mk(False)(h0, v0, h0, v0, b_ext)
+    hf, vf = mk(True)(h0, v0, h0, v0, b_ext)
+    for a, b, k in ((hs, hf, "h"), (vs, vf, "v")):
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        scale = np.max(np.abs(a)) + 1e-300
+        np.testing.assert_allclose(b, a, atol=2e-6 * scale, err_msg=k)
