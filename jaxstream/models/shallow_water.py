@@ -44,7 +44,7 @@ from ..ops.fv import (
 )
 from .base import Model, State
 
-__all__ = ["ShallowWater"]
+__all__ = ["SWEBase", "ShallowWater"]
 
 
 def _cross(a, b):
@@ -55,7 +55,15 @@ def _cross(a, b):
     ])
 
 
-class ShallowWater(Model):
+class SWEBase(Model):
+    """Shared SWE setup: scheme/backend validation, Coriolis, topography.
+
+    Subclasses (Cartesian :class:`ShallowWater`, covariant
+    :class:`jaxstream.models.shallow_water_cov.CovariantShallowWater`)
+    provide ``_make_pallas_rhs(interpret)`` returning their fused RHS
+    callable, or raise if no kernel exists for the formulation.
+    """
+
     def __init__(
         self,
         grid: CubedSphereGrid,
@@ -76,8 +84,8 @@ class ShallowWater(Model):
         self.limiter = limiter
         self.nu4 = nu4
         # backend='pallas' fuses the whole stencil section of the RHS into
-        # one TPU kernel per face (jaxstream.ops.pallas.swe_rhs); 'jnp' is
-        # the reference implementation and parity oracle.
+        # one TPU kernel per face; 'jnp' is the reference implementation
+        # and parity oracle.
         if backend not in ("jnp", "pallas", "pallas_interpret"):
             raise ValueError(f"unknown backend {backend!r}")
         self._pallas_rhs = None
@@ -88,22 +96,36 @@ class ShallowWater(Model):
                     f"kernel is f32); got grid dtype {grid.sqrtg.dtype}. Use "
                     f"backend='jnp' or build the grid with dtype=float32."
                 )
-            from ..ops.pallas.swe_rhs import make_swe_rhs_pallas
-
-            self._pallas_rhs = make_swe_rhs_pallas(
-                grid.n, grid.halo, grid.dalpha, grid.radius,
-                gravity, omega, scheme=scheme, limiter=limiter,
-                interpret=(backend == "pallas_interpret"),
+            self._pallas_rhs = self._make_pallas_rhs(
+                interpret=(backend == "pallas_interpret")
             )
         self.backend = backend
         # Coriolis parameter f = 2 Omega sin(lat) at interior centers.
         self.fcor = 2.0 * omega * jnp.sin(grid.interior(grid.lat))
-        self.khat_int = grid.interior(grid.khat)
         # Bottom topography, extended; ghosts must be valid (analytic ICs
         # evaluate there; otherwise we fill them once here).
         if b_ext is None:
             b_ext = jnp.zeros_like(grid.sqrtg)
         self.b_ext = self.exchange(b_ext)
+
+    def _make_pallas_rhs(self, interpret: bool):  # pragma: no cover
+        raise NotImplementedError
+
+
+class ShallowWater(SWEBase):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.khat_int = self.grid.interior(self.grid.khat)
+
+    def _make_pallas_rhs(self, interpret: bool):
+        from ..ops.pallas.swe_rhs import make_swe_rhs_pallas
+
+        grid = self.grid
+        return make_swe_rhs_pallas(
+            grid.n, grid.halo, grid.dalpha, grid.radius,
+            self.gravity, self.omega, scheme=self.scheme,
+            limiter=self.limiter, interpret=interpret,
+        )
 
     def initial_state(self, h_ext, v_ext) -> State:
         return {

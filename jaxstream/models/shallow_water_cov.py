@@ -1,0 +1,142 @@
+"""Covariant-component shallow water — the flop-lean TPU formulation.
+
+Same PDE and FV discretization family as :class:`ShallowWater` (the
+reference's end goal, ``/root/reference/README.md:4``, deck p.4-7), with
+velocity carried as *panel-local covariant components* ``(u_a, u_b) =
+(v.e_a, v.e_b)`` instead of a Cartesian 3-vector:
+
+    dh/dt  = -(1/sqrtg) [ d_a(sqrtg u^a h*) + d_b(sqrtg u^b h*) ]
+    du_a/dt =  (zeta + f) sqrtg u^b - d_a(g (h + b) + K)
+    du_b/dt = -(zeta + f) sqrtg u^a - d_b(g (h + b) + K)
+
+with ``u^i = g^ij u_j``, ``K = (u^a u_a + u^b u_b)/2`` and
+``zeta = (d_a u_b - d_b u_a)/sqrtg``.  The vector-invariant form needs no
+Christoffel symbols, and two prognostic velocity fields replace three:
+25% less state HBM traffic and none of the 3-vector basis dot products,
+cross products, or tangent-plane projections of the Cartesian path — the
+trade is a 2x2 rotation at panel edges, applied only to halo strips
+(:func:`jaxstream.parallel.vector_halo.make_vector_halo_exchanger` with
+``components='covariant'``; the north-star "rotation form" exchange,
+SURVEY.md §2.2).
+
+Both formulations solve the same equations with the same reconstruction
+and differ only in velocity representation; agreement is to truncation
+error, verified in tests/test_cov_swe.py (TC2 L2-error parity with the
+Cartesian model).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..geometry.cubed_sphere import CubedSphereGrid
+from ..ops.fv import (
+    covariant_components,
+    covariant_face_normal_velocity,
+    embed_interior,
+    flux_divergence_faces,
+    laplacian,
+    vorticity_cov,
+)
+from ..parallel.vector_halo import make_vector_halo_exchanger
+from .base import State
+from .shallow_water import SWEBase
+
+__all__ = ["CovariantShallowWater"]
+
+
+class CovariantShallowWater(SWEBase):
+    """State ``{"h": (6, n, n), "u": (2, 6, n, n)}``, u covariant."""
+
+    def __init__(
+        self,
+        grid: CubedSphereGrid,
+        gravity: float,
+        omega: float,
+        b_ext: Optional[jnp.ndarray] = None,
+        scheme: str = "plr",
+        limiter: str = "mc",
+        nu4: float = 0.0,
+        backend: str = "jnp",
+    ):
+        super().__init__(
+            grid, gravity, omega, b_ext=b_ext, scheme=scheme,
+            limiter=limiter, nu4=nu4, backend=backend,
+        )
+        self.exchange_u = make_vector_halo_exchanger(
+            grid, components="covariant"
+        )
+        # Cell-center inverse metric on the extended grid, from the exact
+        # dual-basis identity g^ij = a^i . a^j (works for eager and lazy
+        # grids; three (6, M, M) scalars).
+        self.ginv_aa = jnp.sum(grid.a_a * grid.a_a, axis=0)
+        self.ginv_ab = jnp.sum(grid.a_a * grid.a_b, axis=0)
+        self.ginv_bb = jnp.sum(grid.a_b * grid.a_b, axis=0)
+
+    def _make_pallas_rhs(self, interpret: bool):
+        raise NotImplementedError(
+            "backend='pallas' is not yet implemented for the covariant "
+            "formulation; use backend='jnp' (the Cartesian ShallowWater "
+            "has the fused TPU kernels)."
+        )
+
+    def initial_state(self, h_ext, v_ext) -> State:
+        """From extended Cartesian fields (the IC functions' output)."""
+        return {
+            "h": self.grid.interior(h_ext),
+            "u": self.grid.interior(covariant_components(self.grid, v_ext)),
+        }
+
+    def to_cartesian(self, state: State):
+        """Interior covariant velocity -> Cartesian (3, 6, n, n)."""
+        g = self.grid
+        iaa, iab, ibb = (g.interior(self.ginv_aa), g.interior(self.ginv_ab),
+                         g.interior(self.ginv_bb))
+        ua = iaa * state["u"][0] + iab * state["u"][1]
+        ub = iab * state["u"][0] + ibb * state["u"][1]
+        return (ua[None] * g.interior(g.e_a)
+                + ub[None] * g.interior(g.e_b))
+
+    def _fill_u(self, u_int):
+        return self.exchange_u(embed_interior(self.grid, u_int))
+
+    def rhs(self, state: State, t) -> State:
+        grid = self.grid
+        h_ext = self.fill(state["h"])
+        u_ext = self._fill_u(state["u"])
+
+        if self._pallas_rhs is not None:
+            dh, du = self._pallas_rhs(h_ext, u_ext, self.b_ext)
+        else:
+            # Contravariant components and kinetic energy on the extended
+            # grid (B's centered gradient reads one ghost deep).
+            uc_a = self.ginv_aa * u_ext[0] + self.ginv_ab * u_ext[1]
+            uc_b = self.ginv_ab * u_ext[0] + self.ginv_bb * u_ext[1]
+            ke = 0.5 * (uc_a * u_ext[0] + uc_b * u_ext[1])
+
+            ux, uy = covariant_face_normal_velocity(grid, u_ext)
+            dh = -flux_divergence_faces(
+                grid, h_ext, ux, uy, scheme=self.scheme, limiter=self.limiter
+            )
+
+            zeta = vorticity_cov(grid, u_ext)
+            bern = self.gravity * (h_ext + self.b_ext) + ke
+            h_, n, d = grid.halo, grid.n, grid.dalpha
+            dba = (bern[..., h_:h_ + n, h_ + 1:h_ + n + 1]
+                   - bern[..., h_:h_ + n, h_ - 1:h_ + n - 1]) / (2 * d)
+            dbb = (bern[..., h_ + 1:h_ + n + 1, h_:h_ + n]
+                   - bern[..., h_ - 1:h_ + n - 1, h_:h_ + n]) / (2 * d)
+
+            absv = (zeta + self.fcor) * grid.interior(grid.sqrtg)
+            dua = absv * grid.interior(uc_b) - dba
+            dub = -absv * grid.interior(uc_a) - dbb
+            du = jnp.stack([dua, dub])
+
+        if self.nu4 > 0.0:
+            l1h = laplacian(grid, h_ext)
+            dh = dh - self.nu4 * laplacian(grid, self.fill(l1h))
+            l1u = laplacian(grid, u_ext)
+            du = du - self.nu4 * laplacian(grid, self._fill_u(l1u))
+        return {"h": dh, "u": du}

@@ -38,9 +38,13 @@ from .reconstruct import _sl, plr_face_states, ppm_face_states
 __all__ = [
     "embed_interior",
     "contravariant",
+    "covariant_components",
+    "covariant_face_normal_velocity",
     "flux_divergence",
+    "flux_divergence_faces",
     "gradient",
     "vorticity",
+    "vorticity_cov",
     "laplacian",
     "kinetic_energy",
 ]
@@ -61,6 +65,71 @@ def contravariant(grid: CubedSphereGrid, v):
     ua = jnp.sum(v * grid.a_a, axis=0)
     ub = jnp.sum(v * grid.a_b, axis=0)
     return ua, ub
+
+
+def covariant_components(grid: CubedSphereGrid, v):
+    """Covariant components ``(v.e_a, v.e_b)`` of a Cartesian vector.
+
+    ``v``: (3, 6, M, M) -> (2, 6, M, M).  The prognostic representation of
+    :class:`jaxstream.models.CovariantShallowWater`.
+    """
+    return jnp.stack([
+        jnp.sum(v * grid.e_a, axis=0),
+        jnp.sum(v * grid.e_b, axis=0),
+    ])
+
+
+def covariant_face_normal_velocity(grid: CubedSphereGrid, u,
+                                   symmetrize: bool = True):
+    """Face-normal contravariant velocity from covariant components.
+
+    ``u``: (2, 6, M, M) covariant ``(u_a, u_b)`` at centers.  Averages the
+    covariant components to the face, then raises the index with the
+    *face* inverse metric (metric-exact at the face — the covariant twin
+    of :func:`_face_normal_velocity`).  Returns ``(ux, uy)`` shaped
+    (6, n, n+1) / (6, n+1, n).
+
+    Unlike the Cartesian route (where ghost copies make both panels'
+    panel-edge normal velocities bitwise equal), the two panels sharing an
+    edge raise the index through *different* covariant components and face
+    metrics, so their edge values differ at truncation level and mass
+    would leak at seams.  ``symmetrize`` (default) replaces both sides'
+    edge-face normal velocity with the averaged outward value — the
+    Putman & Lin (2007) edge-matching idea applied one level earlier than
+    :func:`flux_divergence`'s ``conservative_edges`` — restoring exact
+    conservation while keeping the flux upwinding self-consistent.
+    """
+    h, n = grid.halo, grid.n
+    ubar = 0.5 * (_sl(u, h - 1, h + n, -1) + _sl(u, h, h + n + 1, -1))
+    ubar = _sl(ubar, h, h + n, -2)
+    iaa = _sl(_sl(grid.ginv_aa_xf, h, h + n + 1, -1), h, h + n, -2)
+    iab = _sl(_sl(grid.ginv_ab_xf, h, h + n + 1, -1), h, h + n, -2)
+    ux = iaa * ubar[0] + iab * ubar[1]
+    vbar = 0.5 * (_sl(u, h - 1, h + n, -2) + _sl(u, h, h + n + 1, -2))
+    vbar = _sl(vbar, h, h + n, -1)
+    iab2 = _sl(_sl(grid.ginv_ab_yf, h, h + n + 1, -2), h, h + n, -1)
+    ibb = _sl(_sl(grid.ginv_bb_yf, h, h + n + 1, -2), h, h + n, -1)
+    uy = iab2 * vbar[0] + ibb * vbar[1]
+    if symmetrize:
+        # _symmetrize_edge_fluxes is shape-generic over (6,n,n+1)/(6,n+1,n)
+        # boundary strips; the outward-sign algebra is identical.
+        ux, uy = _symmetrize_edge_fluxes(ux, uy, n)
+    return ux, uy
+
+
+def vorticity_cov(grid: CubedSphereGrid, u):
+    """Relative vorticity directly from covariant components.
+
+    zeta = (d u_b/d alpha - d u_a/d beta) / sqrt(g); no basis dot products
+    needed — the covariant-formulation advantage.  ``u``: (2, 6, M, M) ->
+    (6, n, n).
+    """
+    h, n, d = grid.halo, grid.n, grid.dalpha
+    dub_da = (_sl(_sl(u[1], h + 1, h + n + 1, -1), h, h + n, -2)
+              - _sl(_sl(u[1], h - 1, h + n - 1, -1), h, h + n, -2)) / (2 * d)
+    dua_db = (_sl(_sl(u[0], h + 1, h + n + 1, -2), h, h + n, -1)
+              - _sl(_sl(u[0], h - 1, h + n - 1, -2), h, h + n, -1)) / (2 * d)
+    return (dub_da - dua_db) / grid.interior(grid.sqrtg)
 
 
 def _face_normal_velocity(grid: CubedSphereGrid, v):
@@ -167,9 +236,30 @@ def flux_divergence(
     averages the two sides' edge fluxes — a no-op today, insurance for
     future interpolated (non-copy) ghost fills.
     """
-    h, n, d = grid.halo, grid.n, grid.dalpha
     ux, uy = _face_normal_velocity(grid, v)
+    return flux_divergence_faces(
+        grid, q, ux, uy, scheme=scheme, limiter=limiter,
+        conservative_edges=conservative_edges,
+    )
 
+
+def flux_divergence_faces(
+    grid: CubedSphereGrid,
+    q,
+    ux,
+    uy,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    conservative_edges: bool = False,
+):
+    """:func:`flux_divergence` from precomputed face-normal velocities.
+
+    ``ux``: u^alpha at the interior-bounding x-faces, (6, n, n+1); ``uy``:
+    u^beta at y-faces, (6, n+1, n) — any velocity representation that can
+    produce these (Cartesian dot products, covariant components through
+    the face inverse metric, prescribed winds) shares this flux path.
+    """
+    h, n, d = grid.halo, grid.n, grid.dalpha
     recon = ppm_face_states if scheme == "ppm" else plr_face_states
     kw = {} if scheme == "ppm" else {"limiter": limiter}
 

@@ -119,6 +119,12 @@ def make_sharded_stepper(model, setup: ShardingSetup, example_state,
     ``example_state`` is only read for its tree structure/ranks.
     """
     grid = model.grid
+    if hasattr(model, "exchange_u"):
+        raise ValueError(
+            "the explicit shard_map path only rebinds the scalar/Cartesian "
+            "exchanger; covariant-component models (exchange_u) run sharded "
+            "via the GSPMD path — set parallelization.use_shard_map: false."
+        )
     if (setup.mesh is None or setup.panel != 6 or setup.sy != setup.sx
             or grid.n % setup.sy):
         raise ValueError(

@@ -81,13 +81,21 @@ def _strip_indices(n: int, halo: int):
 def make_vector_halo_exchanger(
     grid: CubedSphereGrid,
     fill_corners: bool = True,
+    components: str = "contravariant",
 ) -> Callable:
-    """Build ``exchange(uv) -> uv`` for contravariant ``(2, 6, M, M)``.
+    """Build ``exchange(uv) -> uv`` for panel-local ``(2, 6, M, M)``.
 
     Ghost values are the neighbor's components rotated into the local
-    panel's extended dual basis (see module docstring).  Pure function;
-    trace it inside the step ``jit``.
+    panel's extended basis (see module docstring).  For contravariant
+    components ``(u^a, u^b)`` the rotation is
+    ``T[i][j] = a_i^local(ghost) . e_j^nbr(src)``; for covariant
+    components ``(u_a, u_b) = (v.e_a, v.e_b)`` the roles of the two bases
+    swap: ``T[i][j] = e_i^local(ghost) . a_j^nbr(src)`` (both follow from
+    re-expressing the same Cartesian vector in the local basis).  Pure
+    function; trace it inside the step ``jit``.
     """
+    if components not in ("contravariant", "covariant"):
+        raise ValueError(f"unknown components {components!r}")
     n, halo = grid.n, grid.halo
     m = grid.m
     adj = build_connectivity()
@@ -109,11 +117,14 @@ def make_vector_halo_exchanger(
                     src_flat = src_flat[:, ::-1]
                 src_flat = src_flat.reshape(-1)
                 dst_flat = dst_idx[link.edge]
-                # T[k, i, j] = a_i^local(ghost k) . e_j^nbr(src k).
-                al = np.stack([a_a[link.face, dst_flat],
-                               a_b[link.face, dst_flat]], axis=1)  # (hn,2,3)
-                en = np.stack([e_a[link.nbr_face, src_flat],
-                               e_b[link.nbr_face, src_flat]], axis=2)  # (hn,3,2)
+                # Contravariant: T[k,i,j] = a_i^local(ghost k).e_j^nbr(src k);
+                # covariant: e_i^local . a_j^nbr.
+                loc = (a_a, a_b) if components == "contravariant" else (e_a, e_b)
+                nbr = (e_a, e_b) if components == "contravariant" else (a_a, a_b)
+                al = np.stack([loc[0][link.face, dst_flat],
+                               loc[1][link.face, dst_flat]], axis=1)  # (hn,2,3)
+                en = np.stack([nbr[0][link.nbr_face, src_flat],
+                               nbr[1][link.nbr_face, src_flat]], axis=2)  # (hn,3,2)
                 T = al @ en  # (hn, 2, 2)
                 copies.append((
                     link.face,

@@ -115,7 +115,10 @@ class Simulation:
             raise ValueError(
                 f"unknown initial_condition {name!r}; valid: {sorted(IC_FAMILY)}"
             )
-        if m.name not in ("auto", family):
+        allowed = {"auto", family}
+        if family == "shallow_water":
+            allowed.add("shallow_water_cov")
+        if m.name not in allowed:
             raise ValueError(
                 f"model.name={m.name!r} is incompatible with "
                 f"initial_condition={name!r} (which drives {family!r})"
@@ -138,7 +141,12 @@ class Simulation:
             h, v = ics.williamson_tc6(g, p.gravity, p.omega)
         else:
             h, v = ics.galewsky(g, p.gravity, p.omega)
-        model = ShallowWater(
+        cls = ShallowWater
+        if m.name == "shallow_water_cov":
+            from .models.shallow_water_cov import CovariantShallowWater
+
+            cls = CovariantShallowWater
+        model = cls(
             g, gravity=p.gravity, omega=p.omega, b_ext=b_ext,
             scheme=m.scheme, limiter=m.limiter, nu4=p.hyperdiffusion,
             backend=m.backend,
@@ -183,8 +191,10 @@ class Simulation:
             out["mass"] = float(diag.total_mass(g, s["h"]))
             b = self.model.b_ext
             b_int = g.interior(b) if b is not None else 0.0
+            # Covariant models carry "u"; energy wants the Cartesian vector.
+            v = s["v"] if "v" in s else self.model.to_cartesian(s)
             out["energy"] = float(
-                diag.total_energy(g, s["h"], s["v"], p.gravity, b_int)
+                diag.total_energy(g, s["h"], v, p.gravity, b_int)
             )
         elif "q" in s:
             out["tracer_mass"] = float(diag.total_mass(g, s["q"]))
