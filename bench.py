@@ -25,16 +25,23 @@ def log(*a):
 
 
 def tc2_parity(n=48, hours=24.0):
-    """Short TC2 run; returns normalized L2 height error (steady state)."""
+    """Short TC2 run; returns normalized L2 height error (steady state).
+
+    Uses the covariant formulation — the throughput section's first-choice
+    stepper — so the gate and the benchmark test the same discretization
+    (fallback rungs use the Cartesian formulation, whose TC2 error is the
+    same to within 3%; tests/test_cov_swe.py).
+    """
     import jax.numpy as jnp
 
     from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
     from jaxstream.geometry.cubed_sphere import build_grid
-    from jaxstream.models.shallow_water import ShallowWater
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
     from jaxstream.physics.initial_conditions import williamson_tc2
 
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
-    model = ShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA)
+    model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                  omega=EARTH_OMEGA)
     h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
     state = model.initial_state(h_ext, v_ext)
     dt = 300.0
@@ -47,50 +54,72 @@ def tc2_parity(n=48, hours=24.0):
     return float(err)
 
 
-def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=200):
+def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=2000):
     import jax
     import jax.numpy as jnp
 
     from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
     from jaxstream.geometry.cubed_sphere import build_grid
     from jaxstream.models.shallow_water import ShallowWater
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
     from jaxstream.physics.initial_conditions import williamson_tc5
     from jaxstream.stepping import integrate
 
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
     h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
-    # Fused Pallas RHS is the fast path on TPU (+8% at C384, and it cuts
-    # HBM traffic ~4x); fall back to the jnp oracle path anywhere the
-    # kernel can't compile (CPU bench runs, future shapes).
-    try:
-        model = ShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA,
-                             b_ext=b_ext, backend="pallas")
-        model.rhs(model.initial_state(h_ext, v_ext), 0.0)
-        log("bench: using pallas RHS backend")
-    except Exception as e:
-        log(f"bench: pallas backend unavailable ({type(e).__name__}); using jnp")
-        model = ShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA,
-                             b_ext=b_ext)
-    state = model.initial_state(h_ext, v_ext)
 
-    # Fused extended-state stepper (RHS + RK stage combo in one kernel per
-    # face) when its stage kernels compile on this chip; classic path
-    # otherwise.  The probe runs one real fused step so a Mosaic compile
-    # failure (VMEM limits, shape limits) falls back instead of crashing.
-    fused = model.backend == "pallas"
-    if fused:
+    # Fastest-first ladder, probing one real step of each candidate so a
+    # Mosaic compile failure (VMEM/shape limits, CPU bench runs) falls
+    # through instead of crashing:
+    #   1. covariant fused stepper (3 fields, rotation strips; ~1.4x the
+    #      Cartesian fused stepper at C384),
+    #   2. Cartesian fused stepper (in-kernel exchange),
+    #   3. classic jnp SSPRK3.
+    state = step = None
+    try:
+        model = CovariantShallowWater(
+            grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext,
+            backend="pallas")
+        step = model.make_fused_step(dt)
+        y = model.extend_state(model.initial_state(h_ext, v_ext),
+                               with_strips=True)
+        jax.block_until_ready(jax.jit(step)(y, jnp.float32(0.0)))
+        state = y
+        log("bench: using covariant fused SSPRK3 stepper (rotation strips)")
+    except Exception as e:
+        log(f"bench: covariant fused stepper unavailable "
+            f"({type(e).__name__}: {e})")
+    if state is None:
         try:
+            model = ShallowWater(grid, gravity=EARTH_GRAVITY,
+                                 omega=EARTH_OMEGA, b_ext=b_ext,
+                                 backend="pallas")
             step = model.make_fused_step(dt, in_kernel_exchange=True)
-            y_probe = model.extend_state(state, with_strips=True)
-            jax.block_until_ready(jax.jit(step)(y_probe, jnp.float32(0.0)))
-            state = y_probe
-            log("bench: using fused extended-state SSPRK3 stepper "
+            y = model.extend_state(model.initial_state(h_ext, v_ext),
+                                   with_strips=True)
+            jax.block_until_ready(jax.jit(step)(y, jnp.float32(0.0)))
+            state = y
+            log("bench: using Cartesian fused SSPRK3 stepper "
                 "(in-kernel exchange)")
         except Exception as e:
-            fused = False
-            log(f"bench: fused stepper unavailable "
-                f"({type(e).__name__}: {e}); using classic stepper")
-    if not fused:
+            log(f"bench: Cartesian fused stepper unavailable "
+                f"({type(e).__name__}: {e})")
+    if state is None:
+        # Classic stepper; plain Pallas RHS kernel if it compiles (the
+        # fused stage kernels have stricter VMEM/shape needs), jnp last.
+        try:
+            model = ShallowWater(grid, gravity=EARTH_GRAVITY,
+                                 omega=EARTH_OMEGA, b_ext=b_ext,
+                                 backend="pallas")
+            state = model.initial_state(h_ext, v_ext)
+            jax.block_until_ready(model.rhs(state, 0.0)["h"])
+            log("bench: using classic stepper with pallas RHS kernel")
+        except Exception as e:
+            log(f"bench: pallas RHS unavailable ({type(e).__name__}); "
+                f"using jnp")
+            model = ShallowWater(grid, gravity=EARTH_GRAVITY,
+                                 omega=EARTH_OMEGA, b_ext=b_ext)
+            state = model.initial_state(h_ext, v_ext)
         step = model.make_step(dt, "ssprk3")
 
     # One compiled executable for any step count: nsteps rides the carry as

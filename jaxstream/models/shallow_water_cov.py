@@ -76,10 +76,44 @@ class CovariantShallowWater(SWEBase):
         self.ginv_bb = jnp.sum(grid.a_b * grid.a_b, axis=0)
 
     def _make_pallas_rhs(self, interpret: bool):
-        raise NotImplementedError(
-            "backend='pallas' is not yet implemented for the covariant "
-            "formulation; use backend='jnp' (the Cartesian ShallowWater "
-            "has the fused TPU kernels)."
+        from ..ops.pallas.swe_cov import make_cov_rhs_pallas
+
+        return make_cov_rhs_pallas(
+            self.grid, self.gravity, self.omega, scheme=self.scheme,
+            limiter=self.limiter, interpret=interpret,
+        )
+
+    # -- fused extended-state fast path (TPU) -------------------------------
+    def extend_state(self, state: State, with_strips: bool = False) -> State:
+        """Interior state -> extended state for the fused stepper."""
+        g = self.grid
+        y = {k: embed_interior(g, v) for k, v in state.items()}
+        if with_strips:
+            from ..ops.pallas.swe_cov import raw_strips_cov
+
+            y["sh_sn"], y["sh_we"] = raw_strips_cov(y["h"], g.n, g.halo)
+            y["su_sn"], y["su_we"] = raw_strips_cov(y["u"], g.n, g.halo)
+        return y
+
+    def restrict_state(self, y_ext: State) -> State:
+        return {k: self.grid.interior(v) for k, v in y_ext.items()
+                if k in ("h", "u")}
+
+    def make_fused_step(self, dt: float):
+        """SSPRK3 over extended state: one fused kernel per stage, halo
+        fill and edge-normal symmetrization via the strip carry
+        (:mod:`jaxstream.ops.pallas.swe_cov`).  Requires
+        ``backend='pallas'`` and ``nu4 == 0``."""
+        if self._pallas_rhs is None:
+            raise ValueError("make_fused_step requires backend='pallas'")
+        if self.nu4 != 0.0:
+            raise ValueError("make_fused_step does not support nu4 > 0")
+        from ..ops.pallas.swe_cov import make_fused_ssprk3_cov_inkernel
+
+        return make_fused_ssprk3_cov_inkernel(
+            self.grid, self.gravity, self.omega, dt, self.b_ext,
+            scheme=self.scheme, limiter=self.limiter,
+            interpret=(self.backend == "pallas_interpret"),
         )
 
     def initial_state(self, h_ext, v_ext) -> State:

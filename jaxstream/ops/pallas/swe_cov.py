@@ -1,0 +1,665 @@
+"""Fused TPU kernels for the covariant-component SWE formulation.
+
+The covariant twin of :mod:`swe_rhs`/:mod:`swe_step`: one kernel per face
+computes the complete vector-invariant RHS from the prognostic
+``(h, u_a, u_b)`` — three (M, M) fields instead of the Cartesian path's
+four, and the metric work collapses to the closed-form scalar fields of
+:func:`jaxstream.ops.pallas.swe_rhs._fast_frame` (no 3-vector bases, dot
+or cross products at all; the only frame data left is the three z-
+components needed for the Coriolis parameter).
+
+Panel-seam conservation: the two panels sharing an edge raise the index
+through different covariant components/metrics, so their edge-face normal
+velocities differ at truncation level (see
+:func:`jaxstream.ops.fv.covariant_face_normal_velocity`).  The kernels
+therefore take per-face *symmetrized edge-normal strips* — computed once
+per physical edge outside the kernel (:func:`sym_edge_normals`) and
+written over the boundary face values with iota-mask selects — so both
+panels use bitwise-identical edge velocities and mass is conserved to
+roundoff, matching the jnp oracle's ``symmetrize=True`` arithmetic
+exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...geometry.connectivity import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    build_connectivity,
+    edge_pairs,
+)
+from ...geometry.cubed_sphere import FACE_AXES
+from .swe_rhs import _fast_frame, coord_rows, pick_recon
+
+__all__ = [
+    "sym_edge_normals",
+    "rhs_core_cov",
+    "make_cov_rhs_pallas",
+    "make_cov_strip_router",
+    "raw_strips_cov",
+    "make_cov_stage_inkernel",
+    "make_fused_ssprk3_cov_inkernel",
+]
+
+_OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
+
+
+def _local_edge_normal(grid, u_ext, face: int, edge: int):
+    """This panel's own normal velocity at one edge's boundary faces.
+
+    Returns the stored +alpha (W/E) or +beta (S/N) face value as a
+    canonical along-edge ``(n,)`` strip — the same arithmetic (same
+    operand order) as :func:`jaxstream.ops.fv.covariant_face_normal_velocity`
+    restricted to that edge, so replacing the kernel's values with the
+    paired averages reproduces the oracle bitwise.
+    """
+    h, n = grid.halo, grid.n
+    i0, i1 = h, h + n
+    if edge in (EDGE_W, EDGE_E):
+        fi = i0 if edge == EDGE_W else i1
+        ub_a = 0.5 * (u_ext[0, face, i0:i1, fi - 1] + u_ext[0, face, i0:i1, fi])
+        ub_b = 0.5 * (u_ext[1, face, i0:i1, fi - 1] + u_ext[1, face, i0:i1, fi])
+        iaa = grid.ginv_aa_xf[face, i0:i1, fi]
+        iab = grid.ginv_ab_xf[face, i0:i1, fi]
+        return iaa * ub_a + iab * ub_b
+    fi = i0 if edge == EDGE_S else i1
+    ub_a = 0.5 * (u_ext[0, face, fi - 1, i0:i1] + u_ext[0, face, fi, i0:i1])
+    ub_b = 0.5 * (u_ext[1, face, fi - 1, i0:i1] + u_ext[1, face, fi, i0:i1])
+    iab = grid.ginv_ab_yf[face, fi, i0:i1]
+    ibb = grid.ginv_bb_yf[face, fi, i0:i1]
+    return iab * ub_a + ibb * ub_b
+
+
+def _symmetrized_strips(local_normal):
+    """Average the two panels' edge normals and distribute to both sides.
+
+    ``local_normal(face, edge) -> (n,)`` is each panel's own stored
+    +alpha/+beta edge-face value in canonical along-edge order.  Applies
+    the ``_symmetrize_edge_fluxes`` outward-sign/reversal algebra once per
+    physical edge, so both faces receive bitwise-identical values; the
+    single implementation keeps the non-fused RHS path and the fused
+    stepper's router seam-consistent by construction.  Returns
+    ``(sym_sn (6, 2, n), sym_we (6, n, 2))`` — W/E strips stored with the
+    pair axis last so kernels can slice lane-cheap (n, 1) columns.
+    """
+    sn = [[None, None] for _ in range(6)]
+    we = [[None, None] for _ in range(6)]
+
+    def put(face, edge, strip):
+        if edge == EDGE_S:
+            sn[face][0] = strip
+        elif edge == EDGE_N:
+            sn[face][1] = strip
+        elif edge == EDGE_W:
+            we[face][0] = strip
+        else:
+            we[face][1] = strip
+
+    for link, back in edge_pairs(build_connectivity()):
+        s_a = local_normal(link.face, link.edge)
+        s_b = local_normal(back.face, back.edge)
+        if link.reversed_:
+            s_b = jnp.flip(s_b, axis=-1)
+        out_a = _OUT_SIGN[link.edge] * s_a
+        out_b = _OUT_SIGN[back.edge] * s_b
+        avg = 0.5 * (out_a - out_b)
+        new_a = _OUT_SIGN[link.edge] * avg
+        new_b = _OUT_SIGN[back.edge] * (-avg)
+        if link.reversed_:
+            new_b = jnp.flip(new_b, axis=-1)
+        put(link.face, link.edge, new_a)
+        put(back.face, back.edge, new_b)
+
+    sym_sn = jnp.stack([jnp.stack(rows) for rows in sn])        # (6, 2, n)
+    sym_we = jnp.stack([jnp.stack(cols, axis=-1) for cols in we])  # (6, n, 2)
+    return sym_sn, sym_we
+
+
+def sym_edge_normals(grid, u_ext):
+    """Symmetrized panel-edge normal velocities for the covariant kernels.
+
+    ``u_ext``: (2, 6, M, M) covariant components with ghosts filled.
+    Returns ``(sym_sn, sym_we)`` per :func:`_symmetrized_strips`, with
+    each panel's local values from the grid's stored face metric
+    (bitwise-equal to the jnp oracle's symmetrize path).
+    """
+    return _symmetrized_strips(
+        lambda f, e: _local_edge_normal(grid, u_ext, f, e)
+    )
+
+
+def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
+                 n, halo, d, radius, gravity, omega, recon):
+    """One face's covariant-SWE right-hand side as traceable kernel math.
+
+    ``fz = (c0z, cxz, cyz)`` are the face frame's z-components (scalars,
+    for the Coriolis parameter 2 Omega rhat_z); ``hf``/``bf`` (M, M),
+    ``ua``/``ub`` (M, M) covariant components, ghosts filled.
+    ``sym_sn`` (2, n) / ``sym_we`` (n, 2) are the symmetrized edge
+    normals imposed on the panel-boundary faces (pass ``None`` for both
+    to keep the local values — single-panel tests).  Returns
+    ``(dh, dua, dub)`` interior (n, n) tendencies.
+    """
+    h0, h1 = halo, halo + n
+    inv2d = jnp.float32(1.0 / (2.0 * d))
+    g = jnp.float32(gravity)
+    two_omega = jnp.float32(2.0 * omega)
+
+    # ---- continuity ------------------------------------------------------
+    Fx = _fast_frame(xfr[:, h0:h1 + 1], yc[h0:h1], radius)
+    uba = 0.5 * (ua[h0:h1, h0 - 1:h1] + ua[h0:h1, h0:h1 + 1])
+    ubb = 0.5 * (ub[h0:h1, h0 - 1:h1] + ub[h0:h1, h0:h1 + 1])
+    ux = Fx["inv_aa"] * uba + Fx["inv_ab"] * ubb          # (n, n+1)
+    if sym_we is not None:
+        colx = jax.lax.broadcasted_iota(jnp.int32, (n, n + 1), 1)
+        ux = jnp.where(colx == 0, sym_we[:, 0:1], ux)
+        ux = jnp.where(colx == n, sym_we[:, 1:2], ux)
+    qL, qR = recon(hf[h0:h1, :], -1)
+    fx = Fx["sqrtg"] * (jnp.maximum(ux, 0.0) * qL
+                        + jnp.minimum(ux, 0.0) * qR)
+
+    Fy = _fast_frame(xr[:, h0:h1], yfc[h0:h1 + 1], radius)
+    vba = 0.5 * (ua[h0 - 1:h1, h0:h1] + ua[h0:h1 + 1, h0:h1])
+    vbb = 0.5 * (ub[h0 - 1:h1, h0:h1] + ub[h0:h1 + 1, h0:h1])
+    uy = Fy["inv_ab"] * vba + Fy["inv_bb"] * vbb          # (n+1, n)
+    if sym_sn is not None:
+        rowy = jax.lax.broadcasted_iota(jnp.int32, (n + 1, n), 0)
+        uy = jnp.where(rowy == 0, sym_sn[0:1, :], uy)
+        uy = jnp.where(rowy == n, sym_sn[1:2, :], uy)
+    qL, qR = recon(hf[:, h0:h1], -2)
+    fy = Fy["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
+                        + jnp.minimum(uy, 0.0) * qR)
+
+    Fc = _fast_frame(xr[:, h0:h1], yc[h0:h1], radius)
+    inv_sg_d = Fc["inv_sqrtg"] * jnp.float32(1.0 / d)
+    dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
+
+    # ---- momentum (vector-invariant, covariant components) ---------------
+    b0, b1 = h0 - 1, h1 + 1
+    Fb = _fast_frame(xr[:, b0:b1], yc[b0:b1], radius)
+    uab = ua[b0:b1, b0:b1]
+    ubb_ = ub[b0:b1, b0:b1]
+    uca = Fb["inv_aa"] * uab + Fb["inv_ab"] * ubb_        # u^alpha, band
+    ucb = Fb["inv_ab"] * uab + Fb["inv_bb"] * ubb_        # u^beta, band
+    ke = 0.5 * (uca * uab + ucb * ubb_)
+    bern = g * (hf[b0:b1, b0:b1] + bf[b0:b1, b0:b1]) + ke
+    dba = (bern[1:-1, 2:] - bern[1:-1, :-2]) * inv2d
+    dbb = (bern[2:, 1:-1] - bern[:-2, 1:-1]) * inv2d
+
+    dub_da = (ub[h0:h1, h0 + 1:h1 + 1] - ub[h0:h1, h0 - 1:h1 - 1]) * inv2d
+    dua_db = (ua[h0 + 1:h1 + 1, h0:h1] - ua[h0 - 1:h1 - 1, h0:h1]) * inv2d
+    zeta = (dub_da - dua_db) * Fc["inv_sqrtg"]
+
+    # Coriolis: f = 2 Omega rhat_z, rhat_z = (c0z + X cxz + Y cyz)/rho.
+    rz = (fz[0] + Fc["x"] * fz[1] + Fc["y"] * fz[2]) * Fc["inv_rho"]
+    absv = (zeta + two_omega * rz) * Fc["sqrtg"]
+
+    dua = absv * ucb[1:-1, 1:-1] - dba
+    dub = -absv * uca[1:-1, 1:-1] - dbb
+    return dh, dua, dub
+
+
+def make_cov_rhs_pallas(
+    grid,
+    gravity: float,
+    omega: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """Build ``rhs(h_ext, u_ext, b_ext) -> (dh, du)`` as one fused kernel.
+
+    Drop-in for the stencil section of
+    :meth:`jaxstream.models.shallow_water_cov.CovariantShallowWater.rhs`:
+    extended inputs with ghosts filled, interior tendencies out
+    (``du`` stacked (2, 6, n, n)).  The symmetrized edge normals are
+    computed outside the kernel from the same ``u_ext`` (they read the
+    grid's stored face metric, keeping them bitwise-equal to the oracle).
+    """
+    n, halo = grid.n, grid.halo
+    m = n + 2 * halo
+    d = float(grid.dalpha)
+    radius = float(grid.radius)
+    recon = pick_recon(scheme, halo, n, limiter)
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    import numpy as np
+
+    # (6, 1, 3): Mosaic requires the block's last two dims to equal the
+    # array's, so keep a unit middle axis rather than a (6, 3) table.
+    frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+
+    def kernel(fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref, h_ref, u_ref,
+               b_ref, ssn_ref, swe_ref, dh_ref, du_ref):
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            h_ref[0], u_ref[0, 0], u_ref[1, 0], b_ref[0],
+            ssn_ref[0], swe_ref[0], n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
+        dh_ref[0] = dh
+        du_ref[0, 0] = dua
+        du_ref[1, 0] = dub
+
+    grid_spec = pl.GridSpec(
+        grid=(6,),
+        in_specs=[
+            pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 1, m, m), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 2), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    def rhs(h_ext, u_ext, b_ext) -> Tuple[jax.Array, jax.Array]:
+        sym_sn, sym_we = sym_edge_normals(grid, u_ext)
+        dh, du = call(frames_z, x_row, xf_row, x_col, xf_col,
+                      h_ext, u_ext, b_ext, sym_sn, sym_we)
+        return dh, du
+
+    return rhs
+
+
+# ---------------------------------------------------------------------------
+# Fused SSPRK3 with in-kernel exchange — the covariant TPU fast path.
+#
+# Mirrors jaxstream.ops.pallas.swe_step's strip-carry design with two
+# covariant-specific twists: (1) velocity strips carry raw covariant
+# components in the SOURCE panel's basis; the inter-stage router applies
+# precomputed per-ghost-slot 2x2 rotations (the strip-sized form of the
+# vector_halo exchange) while routing; (2) the router also produces the
+# symmetrized panel-edge normal-velocity strips from the same carry, so
+# each stage kernel's edge fluxes agree bitwise across seams (exact mass
+# conservation without any cross-face traffic beyond the strips).
+# ---------------------------------------------------------------------------
+
+
+def raw_strips_cov(field, n: int, halo: int):
+    """Raw boundary strips of an extended field (leading axes carried).
+
+    Same layout as :func:`jaxstream.ops.pallas.swe_step.raw_strips`:
+    ``sn = (..., 6, 2, halo, n)`` S/N interior row blocks, ``we = (..., 6,
+    2, n, halo)`` W/E column blocks.
+    """
+    from .swe_step import raw_strips
+
+    return raw_strips(field, n, halo)
+
+
+def _rotation_tables(grid):
+    """Per-ghost-slot covariant rotation tensors in routed-strip layout.
+
+    For every ghost slot the router fills, ``T[..., i, j] =
+    e_i^local(ghost cell) . a_j^src(source cell)`` — the same rotation as
+    ``make_vector_halo_exchanger(components='covariant')``, reindexed to
+    the placed ghost layout.  The ghost->source correspondence is read off
+    by routing a marker field of global flat indices through the *scalar*
+    strip router, so this stays correct against any routing convention.
+
+    Returns ``(T_sn, T_we)``: nested ``[i][j]`` lists of four float32
+    arrays each, shaped (6, 2, halo, n) / (6, 2, n, halo) — see
+    ``table()`` for why they are not packed into one ``(..., 2, 2)``
+    tensor.
+    """
+    import numpy as np
+
+    from .swe_step import raw_strips, route_strips
+
+    n, halo, m = grid.n, grid.halo, grid.m
+    i0, i1 = halo, halo + n
+    # int32 marker: route_strips is pure gather/flip/transpose, so integer
+    # indices survive exactly (a float marker would corrupt flat indices
+    # above 2^24 once 6*m*m outgrows the f32 mantissa).
+    marker = jnp.asarray(
+        np.arange(6 * m * m, dtype=np.int32).reshape(6, m, m))
+    gsn, gwe = route_strips(*raw_strips(marker, n, halo))
+    src_sn = np.asarray(gsn).astype(np.int64)          # (6, 2, halo, n)
+    src_we = np.asarray(gwe).astype(np.int64)          # (6, 2, n, halo)
+
+    pos = np.arange(6 * m * m).reshape(6, m, m)
+    dst_sn = np.stack([
+        np.stack([pos[f, 0:halo, i0:i1], pos[f, i1:i1 + halo, i0:i1]])
+        for f in range(6)
+    ])
+    dst_we = np.stack([
+        np.stack([pos[f, i0:i1, 0:halo], pos[f, i0:i1, i1:i1 + halo]])
+        for f in range(6)
+    ])
+
+    e = np.stack([np.moveaxis(np.asarray(grid.e_a, np.float64), 0, -1),
+                  np.moveaxis(np.asarray(grid.e_b, np.float64), 0, -1)])
+    a = np.stack([np.moveaxis(np.asarray(grid.a_a, np.float64), 0, -1),
+                  np.moveaxis(np.asarray(grid.a_b, np.float64), 0, -1)])
+    ef = e.reshape(2, 6 * m * m, 3)
+    af = a.reshape(2, 6 * m * m, 3)
+
+    def table(dst, src):
+        """Nested [i][j] list of arrays shaped like ``dst``.
+
+        Kept as 4 separate well-tiled arrays rather than one ``(..., 2,
+        2)`` tensor: trailing unit-2 dims force (8, 128) tile padding on
+        TPU (~512x memory blowup) and made the router dominate the step.
+        """
+        e_loc = ef[:, dst, :]                 # (2,) + dst.shape + (3,)
+        a_src = af[:, src, :]
+        return [[jnp.asarray(np.einsum("...k,...k->...",
+                                       e_loc[i], a_src[j]), jnp.float32)
+                 for j in range(2)] for i in range(2)]
+
+    return table(dst_sn, src_sn), table(dst_we, src_we)
+
+
+def make_cov_strip_router(grid):
+    """Build ``route(h_sn, h_we, u_sn, u_we) -> (ghosts, sym)`` for stages.
+
+    ``u_sn``/``u_we`` carry raw covariant components (source basis) with a
+    leading component axis.  Returns the placed ghost tensors for h and u
+    (u rotated into each destination panel's basis) plus the symmetrized
+    edge-normal strips ``(sym_sn (6, 2, n), sym_we (6, n, 2))`` computed
+    once per physical edge — both faces receive bitwise-identical values.
+    """
+    import numpy as np
+
+    from .swe_step import route_strips
+
+    n, halo = grid.n, grid.halo
+    i0, i1 = halo, halo + n
+    T_sn, T_we = _rotation_tables(grid)
+
+    # Edge-face metric rows (the equiangular metric is face-independent).
+    met = {
+        EDGE_W: (jnp.asarray(grid.ginv_aa_xf[0, i0:i1, i0]),
+                 jnp.asarray(grid.ginv_ab_xf[0, i0:i1, i0])),
+        EDGE_E: (jnp.asarray(grid.ginv_aa_xf[0, i0:i1, i1]),
+                 jnp.asarray(grid.ginv_ab_xf[0, i0:i1, i1])),
+        EDGE_S: (jnp.asarray(grid.ginv_ab_yf[0, i0, i0:i1]),
+                 jnp.asarray(grid.ginv_bb_yf[0, i0, i0:i1])),
+        EDGE_N: (jnp.asarray(grid.ginv_ab_yf[0, i1, i0:i1]),
+                 jnp.asarray(grid.ginv_bb_yf[0, i1, i0:i1])),
+    }
+
+    def edge_avg_u(usn, uwe, gusn, guwe, f, e):
+        """0.5 * (edge-adjacent interior + ghost) covariant pair, (2, n)."""
+        h = halo
+        if e == EDGE_S:
+            ui, ug = usn[:, f, 0, 0, :], gusn[:, f, 0, h - 1, :]
+            return 0.5 * (ug + ui)          # lower coordinate cell first
+        if e == EDGE_N:
+            ui, ug = usn[:, f, 1, h - 1, :], gusn[:, f, 1, 0, :]
+            return 0.5 * (ui + ug)
+        if e == EDGE_W:
+            ui, ug = uwe[:, f, 0, :, 0], guwe[:, f, 0, :, h - 1]
+            return 0.5 * (ug + ui)
+        ui, ug = uwe[:, f, 1, :, h - 1], guwe[:, f, 1, :, 0]
+        return 0.5 * (ui + ug)
+
+    def local_normal(usn, uwe, gusn, guwe, f, e):
+        ubar = edge_avg_u(usn, uwe, gusn, guwe, f, e)
+        m0, m1 = met[e]
+        return m0 * ubar[0] + m1 * ubar[1]
+
+    def route(h_sn, h_we, u_sn, u_we):
+        gsn, gwe = route_strips(h_sn, h_we)
+        g0 = route_strips(u_sn[0], u_we[0])
+        g1 = route_strips(u_sn[1], u_we[1])
+        gusn = jnp.stack([
+            T_sn[i][0] * g0[0] + T_sn[i][1] * g1[0]
+            for i in range(2)
+        ])
+        guwe = jnp.stack([
+            T_we[i][0] * g0[1] + T_we[i][1] * g1[1]
+            for i in range(2)
+        ])
+        sym = _symmetrized_strips(
+            lambda f, e: local_normal(u_sn, u_we, gusn, guwe, f, e)
+        )
+        return (gsn, gwe, gusn, guwe), sym
+
+    return route
+
+
+def make_cov_stage_inkernel(
+    n: int,
+    halo: int,
+    dalpha: float,
+    radius: float,
+    gravity: float,
+    omega: float,
+    dt: float,
+    a: float,
+    b: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """One fused covariant RK stage with the halo fill inside the kernel.
+
+    ``a == 0``: ``stage(hc, uc, ghosts, sym, b_ext)``; else
+    ``stage(h0, u0, hc, uc, ghosts, sym, b_ext)``.  ``ghosts`` is the
+    routed 4-tuple ``(gsn, gwe, gusn, guwe)``, ``sym`` the pair
+    ``(sym_sn, sym_we)`` from :func:`make_cov_strip_router`.  Returns
+    ``(h, u, sn, we, usn, uwe)`` — combined state plus its raw boundary
+    strips.  Ghost corners stay stale (never read by the dimension-split
+    stencils).
+    """
+    import numpy as np
+
+    m = n + 2 * halo
+    i0, i1 = halo, halo + n
+    d = float(dalpha)
+    g_dt = b * dt
+    recon = pick_recon(scheme, halo, n, limiter)
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+    with_y0 = a != 0.0
+    h = halo
+
+    def fill_ghosts(scratch, face_val, gsn, gwe):
+        scratch[:] = face_val
+        scratch[0:h, i0:i1] = gsn[0]
+        scratch[i1 : i1 + h, i0:i1] = gsn[1]
+        scratch[i0:i1, 0:h] = gwe[0]
+        scratch[i0:i1, i1 : i1 + h] = gwe[1]
+        return scratch[:]
+
+    def kernel(*refs):
+        if with_y0:
+            (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+             h0_ref, u0_ref, hc_ref, uc_ref,
+             gsn_ref, gwe_ref, gusn_ref, guwe_ref, ssn_ref, swe_ref, b_ref,
+             ho_ref, uo_ref, sno_ref, weo_ref, usno_ref, uweo_ref,
+             *scratch) = refs
+        else:
+            (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+             hc_ref, uc_ref,
+             gsn_ref, gwe_ref, gusn_ref, guwe_ref, ssn_ref, swe_ref, b_ref,
+             ho_ref, uo_ref, sno_ref, weo_ref, usno_ref, uweo_ref,
+             *scratch) = refs
+
+        hf = fill_ghosts(scratch[0], hc_ref[0], gsn_ref[0], gwe_ref[0])
+        ua = fill_ghosts(scratch[1], uc_ref[0, 0],
+                         gusn_ref[0, 0], guwe_ref[0, 0])
+        ub = fill_ghosts(scratch[2], uc_ref[1, 0],
+                         gusn_ref[1, 0], guwe_ref[1, 0])
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            hf, ua, ub, b_ref[0], ssn_ref[0], swe_ref[0],
+            n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
+
+        fa = jnp.float32(a)
+        fb = jnp.float32(b)
+        fg = jnp.float32(g_dt)
+        if with_y0:
+            out_h = fa * h0_ref[0] + fb * hf
+            out_u = [fa * u0_ref[i, 0] + fb * (ua if i == 0 else ub)
+                     for i in range(2)]
+        else:
+            out_h = hf if b == 1.0 else fb * hf
+            out_u = ([ua, ub] if b == 1.0
+                     else [fb * ua, fb * ub])
+
+        def emit(val, tend, out_ref, sn_ref, we_ref, lead=()):
+            int_new = val[i0:i1, i0:i1] + fg * tend
+            out_ref[lead + (0,)] = val
+            out_ref[lead + (0, slice(i0, i1), slice(i0, i1))] = int_new
+            sn_ref[lead + (0, 0)] = int_new[0:h, :]
+            sn_ref[lead + (0, 1)] = int_new[n - h : n, :]
+            we_ref[lead + (0, 0)] = int_new[:, 0:h]
+            we_ref[lead + (0, 1)] = int_new[:, n - h : n]
+
+        emit(out_h, dh, ho_ref, sno_ref, weo_ref)
+        emit(out_u[0], dua, uo_ref, usno_ref, uweo_ref, lead=(0,))
+        emit(out_u[1], dub, uo_ref, usno_ref, uweo_ref, lead=(1,))
+
+    fz_spec = pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+                           memory_space=pltpu.SMEM)
+    coord_specs = [
+        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    h_blk = pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM)
+    u_blk = pl.BlockSpec((2, 1, m, m), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM)
+    sn_blk = pl.BlockSpec((1, 2, h, n), lambda f: (f, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    we_blk = pl.BlockSpec((1, 2, n, h), lambda f: (f, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    usn_blk = pl.BlockSpec((2, 1, 2, h, n), lambda f: (0, f, 0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    uwe_blk = pl.BlockSpec((2, 1, 2, n, h), lambda f: (0, f, 0, 0, 0),
+                           memory_space=pltpu.VMEM)
+    ssn_blk = pl.BlockSpec((1, 2, n), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    swe_blk = pl.BlockSpec((1, n, 2), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+
+    in_specs = [fz_spec] + coord_specs
+    if with_y0:
+        in_specs += [h_blk, u_blk]
+    in_specs += [h_blk, u_blk, sn_blk, we_blk, usn_blk, uwe_blk,
+                 ssn_blk, swe_blk, h_blk]
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pl.GridSpec(
+            grid=(6,),
+            in_specs=in_specs,
+            out_specs=[h_blk, u_blk, sn_blk, we_blk, usn_blk, uwe_blk],
+            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                            for _ in range(3)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((6, m, m), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, m, m), jnp.float32),
+            jax.ShapeDtypeStruct((6, 2, h, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, 2, n, h), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, 2, h, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, 2, n, h), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    if with_y0:
+        def stage(h0, u0, hc, uc, ghosts, sym, b_ext):
+            return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
+                              h0, u0, hc, uc, *ghosts, *sym, b_ext))
+    else:
+        def stage(hc, uc, ghosts, sym, b_ext):
+            return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
+                              hc, uc, *ghosts, *sym, b_ext))
+    return stage
+
+
+def make_fused_ssprk3_cov_inkernel(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    b_ext,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """``step(y, t) -> y`` over ``y = {h, u, sh_sn, sh_we, su_sn, su_we}``.
+
+    The covariant minimum-HBM-traffic step: three fused stage kernels plus
+    three strip-routing shuffles (rotations + symmetrized edge normals on
+    ~strip-sized tensors).  Initialise the carry with
+    :meth:`CovariantShallowWater.extend_state(state, with_strips=True)`.
+    """
+    from .swe_step import SSPRK3_COEFFS
+
+    n, halo = grid.n, grid.halo
+    route = make_cov_strip_router(grid)
+    mk = lambda a, b: make_cov_stage_inkernel(
+        n, halo, float(grid.dalpha), float(grid.radius), gravity, omega,
+        dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
+    )
+    (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+    stage1 = mk(a1, b1)
+    stage2 = mk(a2, b2)
+    stage3 = mk(a3, b3)
+
+    def step(y, t):
+        del t
+        h0, u0 = y["h"], y["u"]
+        g0, s0 = route(y["sh_sn"], y["sh_we"], y["su_sn"], y["su_we"])
+        h1, u1, *s1 = stage1(h0, u0, g0, s0, b_ext)
+        g1, sy1 = route(*s1)
+        h2, u2, *s2 = stage2(h0, u0, h1, u1, g1, sy1, b_ext)
+        g2, sy2 = route(*s2)
+        h3, u3, *s3 = stage3(h0, u0, h2, u2, g2, sy2, b_ext)
+        return {"h": h3, "u": u3, "sh_sn": s3[0], "sh_we": s3[1],
+                "su_sn": s3[2], "su_we": s3[3]}
+
+    return step
