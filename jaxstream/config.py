@@ -109,13 +109,30 @@ _SECTIONS = {
 
 
 def _build_section(cls, data: dict):
-    fields = {f.name for f in dataclasses.fields(cls)}
-    unknown = set(data) - fields
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
     if unknown:
         raise ValueError(
             f"unknown {cls.__name__} keys {sorted(unknown)}; valid: {sorted(fields)}"
         )
-    return cls(**data)
+    # Coerce to the declared field types: YAML 1.1 parses exponent
+    # literals without a sign ("1.0e14") as *strings*, and users write
+    # "6" where an int is declared — both must land as numbers.
+    coerced = {}
+    for k, v in data.items():
+        ftype = fields[k].type
+        ftype = getattr(ftype, "__name__", ftype)  # str or type object
+        try:
+            if ftype == "float" and not isinstance(v, float):
+                v = float(v)
+            elif ftype == "int" and not isinstance(v, (int, bool)):
+                v = int(v)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{cls.__name__}.{k} expects a {ftype}, got {v!r}"
+            ) from None
+        coerced[k] = v
+    return cls(**coerced)
 
 
 def load_config(source: Any = None) -> Config:

@@ -141,3 +141,22 @@ def test_cli_run_and_info(tmp_path, capsys):
     main(["schedule"])
     text = capsys.readouterr().out
     assert text.count("stage") == 4
+
+
+def test_yaml_exponent_literals_coerce_to_float():
+    """YAML 1.1 parses '1.0e14' (no sign) as a string; the loader must
+    coerce to the declared field type — the form every example config
+    uses for physics.hyperdiffusion."""
+    from jaxstream.config import load_config
+
+    cfg = load_config(
+        "physics:\n  hyperdiffusion: 1.0e14\ntime:\n  dt: '300'\n"
+    )
+    assert cfg.physics.hyperdiffusion == 1.0e14
+    assert isinstance(cfg.physics.hyperdiffusion, float)
+    assert cfg.time.dt == 300.0
+
+    import pytest
+
+    with pytest.raises(ValueError, match="expects a float"):
+        load_config("physics:\n  hyperdiffusion: banana\n")
