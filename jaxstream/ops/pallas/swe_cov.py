@@ -217,6 +217,8 @@ def make_cov_rhs_pallas(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    n_faces: int = 6,
+    external_sym: bool = False,
 ):
     """Build ``rhs(h_ext, u_ext, b_ext) -> (dh, du)`` as one fused kernel.
 
@@ -226,6 +228,12 @@ def make_cov_rhs_pallas(
     (``du`` stacked (2, 6, n, n)).  The symmetrized edge normals are
     computed outside the kernel from the same ``u_ext`` (they read the
     grid's stored face metric, keeping them bitwise-equal to the oracle).
+
+    ``n_faces=1`` + ``external_sym=True`` is the shard_map-local variant
+    (one face per device): the returned function has signature
+    ``rhs(fz, h_ext, u_ext, b_ext, sym_sn, sym_we)`` with the per-face
+    frame z-components ``fz (1, 1, 3)`` and symmetrized edge normals
+    supplied by the caller (the explicit ppermute exchange computes them).
     """
     n, halo = grid.n, grid.halo
     m = n + 2 * halo
@@ -252,8 +260,9 @@ def make_cov_rhs_pallas(
         du_ref[0, 0] = dua
         du_ref[1, 0] = dub
 
+    nf = n_faces
     grid_spec = pl.GridSpec(
-        grid=(6,),
+        grid=(nf,),
         in_specs=[
             pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
                          memory_space=pltpu.SMEM),
@@ -284,14 +293,21 @@ def make_cov_rhs_pallas(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
-            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((nf, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, nf, n, n), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
     )
+
+    if external_sym:
+        def rhs_ext(fz, h_ext, u_ext, b_ext, sym_sn, sym_we):
+            return tuple(call(fz, x_row, xf_row, x_col, xf_col,
+                              h_ext, u_ext, b_ext, sym_sn, sym_we))
+
+        return rhs_ext
 
     def rhs(h_ext, u_ext, b_ext) -> Tuple[jax.Array, jax.Array]:
         sym_sn, sym_we = sym_edge_normals(grid, u_ext)

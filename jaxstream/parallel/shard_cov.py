@@ -1,0 +1,335 @@
+"""Explicit shard_map stepper for the covariant SWE formulation.
+
+The multi-chip form of the flagship path: one cube face per device on the
+``'panel'`` mesh axis, the fused covariant Pallas RHS kernel running
+per-device, and the halo exchange hand-scheduled as the reference's four
+race-free stages (deck p.9), each ONE bijective ``lax.ppermute`` over ICI
+carrying a single ``(3, halo, n)`` payload — the h strip and both
+covariant velocity components together.
+
+Covariant components transform between panel bases, so the receiver
+rotates the incoming velocity strips through precomputed per-ghost-slot
+2x2 entries (``T[i][j] = e_i^local . a_j^nbr`` — the strip form of
+``jaxstream.parallel.vector_halo``'s rotation, built from the same grid
+bases, hence bitwise-equal ghosts).  Per-device variation (which edge
+exchanges in which stage, reversal flags, rotation entries, edge metric
+rows) is carried as *data* sharded ``P('panel')``; the SPMD program is
+uniform (same technique as :mod:`jaxstream.parallel.shard_halo`).
+
+Panel-seam conservation: each device also reconstructs, from the same
+exchanged strips, BOTH panels' edge-normal velocities and applies the
+canonical (link, back) symmetrization algebra of
+:func:`jaxstream.ops.pallas.swe_cov._symmetrized_strips` — both sides of
+an edge evaluate identical expressions on identical operands, so their
+edge fluxes agree bitwise and mass is conserved to roundoff across
+devices, matching the single-device fused stepper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..geometry.connectivity import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    build_connectivity,
+    build_schedule,
+    edge_pairs,
+)
+from ..geometry.cubed_sphere import FACE_AXES
+from .halo import read_strip, write_strip
+from .vector_halo import _strip_indices
+
+__all__ = ["CovShardProgram", "make_sharded_cov_stepper"]
+
+_OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
+
+
+class CovShardProgram:
+    """Static ppermute schedule + per-device parameter tables.
+
+    All ``(6, ...)`` tables shard ``P('panel')`` so each device reads its
+    own face's rows; everything else about the program is uniform.
+
+    Tables (nstages = 4; leading axis = face):
+      edge_sel (6, 4) i32      — my edge exchanging in stage s
+      rev_sel  (6, 4) f32 0/1  — pair reverses along-edge order
+      is_link  (6, 4) f32 0/1  — am I the pair's canonical 'link' side
+      s_link / s_back (6, 4)   — OUT_SIGN of the link / back edge
+      T_mine   (6, 4, 4, halo, n) — ghost rotation entries (i*2+j),
+                 canonical layout, input = received (my-order) raw comps
+      T_oadj   (6, 4, 4, n)    — the OTHER face's adjacent-slot entries
+      met_mine / met_oth (6, 4, 2, n) — (m0, m1) edge-face inverse-metric
+                 rows of my / the other edge, from the grid's stored
+                 metric (oracle-bitwise normals)
+    """
+
+    def __init__(self, grid, axis_name: str = "panel"):
+        n, halo, m = grid.n, grid.halo, grid.m
+        i0, i1 = halo, halo + n
+        adj = build_connectivity()
+        schedule = build_schedule(adj)
+        self.axis_name = axis_name
+        self.n, self.halo = n, halo
+
+        self.perms = []
+        stage_of = {}
+        for s, stage in enumerate(schedule):
+            perm = []
+            for link, back in stage:
+                perm.append((link.face, link.nbr_face))
+                perm.append((back.face, back.nbr_face))
+                stage_of[(link.face, link.edge)] = (s, link, back, True)
+                stage_of[(back.face, back.edge)] = (s, link, back, False)
+            self.perms.append(perm)
+
+        src_idx, dst_idx = _strip_indices(n, halo)
+        e_b = np.stack([np.moveaxis(np.asarray(grid.e_a, np.float64), 0, -1),
+                        np.moveaxis(np.asarray(grid.e_b, np.float64), 0, -1)])
+        a_b = np.stack([np.moveaxis(np.asarray(grid.a_a, np.float64), 0, -1),
+                        np.moveaxis(np.asarray(grid.a_b, np.float64), 0, -1)])
+        ef = e_b.reshape(2, 6 * m * m, 3)
+        af = a_b.reshape(2, 6 * m * m, 3)
+
+        def T_of(face, edge):
+            """(4, halo, n) canonical rotation entries for one ghost fill."""
+            link = adj[face][edge]
+            src = src_idx[link.nbr_edge].reshape(halo, n)
+            if link.reversed_:
+                src = src[:, ::-1]
+            src = src.reshape(-1) + link.nbr_face * m * m
+            dst = dst_idx[edge] + face * m * m
+            al = np.stack([ef[0][dst], ef[1][dst]], axis=1)   # (hn, 2, 3)
+            en = np.stack([af[0][src], af[1][src]], axis=2)   # (hn, 3, 2)
+            T = al @ en                                       # (hn, 2, 2)
+            return np.stack([T[:, i, j].reshape(halo, n)
+                             for i in range(2) for j in range(2)])
+
+        gaa_xf = np.asarray(grid.ginv_aa_xf)
+        gab_xf = np.asarray(grid.ginv_ab_xf)
+        gab_yf = np.asarray(grid.ginv_ab_yf)
+        gbb_yf = np.asarray(grid.ginv_bb_yf)
+
+        def met_of(face, edge):
+            if edge in (EDGE_W, EDGE_E):
+                fi = i0 if edge == EDGE_W else i1
+                return np.stack([gaa_xf[face, i0:i1, fi],
+                                 gab_xf[face, i0:i1, fi]])
+            fi = i0 if edge == EDGE_S else i1
+            return np.stack([gab_yf[face, fi, i0:i1],
+                             gbb_yf[face, fi, i0:i1]])
+
+        nst = len(schedule)
+        edge_sel = np.zeros((6, nst), np.int32)
+        rev_sel = np.zeros((6, nst), np.float32)
+        is_link = np.zeros((6, nst), np.float32)
+        s_link = np.zeros((6, nst), np.float32)
+        s_back = np.zeros((6, nst), np.float32)
+        T_mine = np.zeros((6, nst, 4, halo, n), np.float32)
+        T_oadj = np.zeros((6, nst, 4, n), np.float32)
+        met_mine = np.zeros((6, nst, 2, n), np.float32)
+        met_oth = np.zeros((6, nst, 2, n), np.float32)
+
+        T_cache = {(f, e): T_of(f, e) for f in range(6) for e in range(4)}
+        for (f, e), (s, link, back, mine_is_link) in stage_of.items():
+            other = back if mine_is_link else link
+            edge_sel[f, s] = e
+            rev_sel[f, s] = float(link.reversed_)
+            is_link[f, s] = float(mine_is_link)
+            s_link[f, s] = _OUT_SIGN[link.edge]
+            s_back[f, s] = _OUT_SIGN[back.edge]
+            T_mine[f, s] = T_cache[(f, e)]
+            T_oadj[f, s] = T_cache[(other.face, other.edge)][:, 0, :]
+            met_mine[f, s] = met_of(f, e)
+            met_oth[f, s] = met_of(other.face, other.edge)
+
+        self.tables = {
+            "edge_sel": jnp.asarray(edge_sel),
+            "rev_sel": jnp.asarray(rev_sel),
+            "is_link": jnp.asarray(is_link),
+            "s_link": jnp.asarray(s_link),
+            "s_back": jnp.asarray(s_back),
+            "T_mine": jnp.asarray(T_mine),
+            "T_oadj": jnp.asarray(T_oadj),
+            "met_mine": jnp.asarray(met_mine),
+            "met_oth": jnp.asarray(met_oth),
+        }
+
+
+def _maybe_flip(row, rev):
+    return jnp.where(rev > 0.5, jnp.flip(row, axis=-1), row)
+
+
+def make_cov_shard_exchange(program: CovShardProgram):
+    """``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)``.
+
+    Local function for use inside ``shard_map`` (one face per device).
+    ``h_blk``: (1, M, M); ``u_blk``: (2, 1, M, M) covariant components in
+    this panel's basis; ``t`` the device's table rows (leading axis 1).
+    Fills cube-edge ghosts in 4 ppermute stages and returns the
+    symmetrized edge-normal strips ``sym_sn (1, 2, n) / sym_we (1, n, 2)``
+    for the RHS kernel.
+    """
+    n, halo = program.n, program.halo
+    axis = program.axis_name
+
+    def exchange(h_blk, u_blk, t):
+        sym = jnp.zeros((4, n), jnp.float32)
+        for s, perm in enumerate(program.perms):
+            e_s = t["edge_sel"][0, s]
+            rev = t["rev_sel"][0, s]
+            # My canonical strips for every edge; select this stage's.
+            hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
+                            for e in range(4)])              # (4, halo, n)
+            us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
+                            for e in range(4)], axis=1)      # (2, 4, halo, n)
+            h_send = jnp.take(hs, e_s, axis=0)
+            u_send = jnp.take(us, e_s, axis=1)
+            payload = jnp.concatenate([h_send[None], u_send])  # (3, halo, n)
+            payload = _maybe_flip(payload, rev)
+            recv = lax.ppermute(payload, axis, perm)
+
+            # Ghost fill: h is a plain copy; u rotates through T_mine.
+            Tm = t["T_mine"][0, s]                           # (4, halo, n)
+            gu0 = Tm[0] * recv[1] + Tm[1] * recv[2]
+            gu1 = Tm[2] * recv[1] + Tm[3] * recv[2]
+            writers = [functools.partial(write_strip, face=0, edge=e)
+                       for e in range(4)]
+            ghost = jnp.stack([recv[0], gu0, gu1])           # (3, halo, n)
+            blk3 = jnp.concatenate([h_blk[None], u_blk], axis=0)
+            blk3 = lax.switch(
+                e_s, [lambda b, st, w=w: w(b, strip=st) for w in writers],
+                blk3, ghost,
+            )
+            h_blk = blk3[0]                  # (1, M, M)
+            u_blk = blk3[1:3]                # (2, 1, M, M)
+
+            # --- symmetrized edge normal (bitwise on both sides) --------
+            int_adj = u_send[:, 0, :]            # my adjacent row, my order
+            ghost_adj = jnp.stack([gu0[0], gu1[0]])
+            ubar = 0.5 * (int_adj + ghost_adj)
+            mm = t["met_mine"][0, s]
+            n_mine = mm[0] * ubar[0] + mm[1] * ubar[1]
+
+            # The other panel's own normal, in ITS canonical order.
+            oth_int = _maybe_flip(recv[1:3, 0, :], rev)      # back to its order
+            my_adj_f = _maybe_flip(int_adj, rev)             # as it received
+            To = t["T_oadj"][0, s]
+            oth_ghost = jnp.stack([
+                To[0] * my_adj_f[0] + To[1] * my_adj_f[1],
+                To[2] * my_adj_f[0] + To[3] * my_adj_f[1],
+            ])
+            obar = 0.5 * (oth_int + oth_ghost)
+            mo = t["met_oth"][0, s]
+            n_oth = mo[0] * obar[0] + mo[1] * obar[1]
+
+            isl = t["is_link"][0, s]
+            sl = t["s_link"][0, s]
+            sb = t["s_back"][0, s]
+            n_link = jnp.where(isl > 0.5, n_mine, n_oth)
+            n_back_lo = jnp.where(isl > 0.5, _maybe_flip(n_oth, rev),
+                                  _maybe_flip(n_mine, rev))
+            avg = 0.5 * (sl * n_link - sb * n_back_lo)
+            mine = jnp.where(isl > 0.5, sl * avg,
+                             _maybe_flip(sb * (-avg), rev))
+            sym = jnp.where(
+                (jnp.arange(4) == e_s)[:, None], mine[None], sym)
+
+        sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]     # (1, 2, n)
+        sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
+        return h_blk, u_blk, sym_sn, sym_we
+
+    return exchange
+
+
+def make_sharded_cov_stepper(model, setup, dt: float):
+    """``step(state, t) -> state`` for the covariant model under shard_map.
+
+    Requires a ``(panel=6, 1, 1)`` mesh (one face per device).  State is
+    the usual interior pytree ``{"h": (6, n, n), "u": (2, 6, n, n)}``
+    sharded over the panel axis.  Each SSPRK3 stage = one explicit
+    4-ppermute exchange + the fused covariant Pallas RHS kernel on the
+    local face (interpret mode off-TPU) + the stage combination.
+    """
+    grid = model.grid
+    if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
+        raise ValueError(
+            f"explicit covariant shard path needs a (panel=6, 1, 1) mesh; "
+            f"got panel={setup.panel}, y={setup.sy}, x={setup.sx}. Use the "
+            f"GSPMD path (use_shard_map: false) for other layouts."
+        )
+    mesh = setup.mesh
+    n, halo = grid.n, grid.halo
+    program = CovShardProgram(grid)
+    exchange = make_cov_shard_exchange(program)
+    platform = getattr(mesh.devices.flat[0], "platform", "cpu")
+    from ..ops.pallas.swe_cov import make_cov_rhs_pallas
+
+    rhs_local = make_cov_rhs_pallas(
+        grid, model.gravity, model.omega, scheme=model.scheme,
+        limiter=model.limiter, interpret=(platform != "tpu"),
+        n_faces=1, external_sym=True,
+    )
+    frames_z = jnp.asarray(
+        np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+
+    axes = mesh.axis_names                      # ('panel', 'y', 'x')
+    pstate = {"h": P(axes[0]), "u": P(None, axes[0])}
+    ptab = {k: P(axes[0]) for k in program.tables}
+    a1, b1 = 0.0, 1.0
+    a2, b2 = 0.75, 0.25
+    a3, b3 = 1.0 / 3.0, 2.0 / 3.0
+
+    def embed(x):
+        pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
+        return jnp.pad(x, pad)
+
+    def body(state, tabs, fz, b_loc):
+        def f(h_int, u_int):
+            h_e = embed(h_int)
+            u_e = embed(u_int)
+            h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
+            dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
+            return dh, du
+
+        h0, u0 = state["h"], state["u"]
+        dh, du = f(h0, u0)
+        h1 = h0 + dt * dh
+        u1 = u0 + dt * du
+        dh, du = f(h1, u1)
+        h2 = a2 * h0 + b2 * (h1 + dt * dh)
+        u2 = a2 * u0 + b2 * (u1 + dt * du)
+        dh, du = f(h2, u2)
+        return {"h": a3 * h0 + b3 * (h2 + dt * dh),
+                "u": a3 * u0 + b3 * (u2 + dt * du)}
+
+    shard_body = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pstate, ptab, P(axes[0]), P(axes[0])),
+        out_specs=pstate,
+        check_vma=False,
+    )
+
+    tables = {
+        k: jax.device_put(v, NamedSharding(mesh, P(axes[0])))
+        for k, v in program.tables.items()
+    }
+    fz_sh = jax.device_put(frames_z, NamedSharding(mesh, P(axes[0])))
+    b_sh = jax.device_put(model.b_ext, NamedSharding(mesh, P(axes[0])))
+
+    @jax.jit
+    def step(state, t):
+        del t
+        return shard_body(state, tables, fz_sh, b_sh)
+
+    return step

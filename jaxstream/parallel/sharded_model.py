@@ -41,6 +41,25 @@ def make_stepper_for(model, setup, example_state, dt: float,
     make XLA infer the collectives (the reference's implicit model).
     """
     if setup is not None and setup.use_shard_map:
+        if hasattr(model, "exchange_u"):
+            # Covariant formulation: its explicit path carries the
+            # rotation exchange + seam symmetrization as ppermute strips
+            # and runs the Pallas RHS kernel per device (SSPRK3 only).
+            from .shard_cov import make_sharded_cov_stepper
+
+            if scheme != "ssprk3":
+                raise ValueError(
+                    "the explicit covariant shard path implements ssprk3 "
+                    f"only; got scheme={scheme!r}"
+                )
+            if getattr(model, "nu4", 0.0) != 0.0:
+                raise ValueError(
+                    "the explicit covariant shard path does not apply "
+                    "hyperdiffusion (nu4 > 0); set "
+                    "parallelization.use_shard_map: false (GSPMD) or "
+                    "physics.hyperdiffusion: 0"
+                )
+            return make_sharded_cov_stepper(model, setup, dt)
         return make_sharded_stepper(model, setup, example_state, dt, scheme)
     return jax.jit(model.make_step(dt, scheme))
 
@@ -121,9 +140,11 @@ def make_sharded_stepper(model, setup: ShardingSetup, example_state,
     grid = model.grid
     if hasattr(model, "exchange_u"):
         raise ValueError(
-            "the explicit shard_map path only rebinds the scalar/Cartesian "
-            "exchanger; covariant-component models (exchange_u) run sharded "
-            "via the GSPMD path — set parallelization.use_shard_map: false."
+            "this explicit shard_map path only rebinds the scalar/Cartesian "
+            "exchanger; covariant-component models (exchange_u) use "
+            "jaxstream.parallel.shard_cov.make_sharded_cov_stepper (the "
+            "make_stepper_for dispatcher picks it automatically), or the "
+            "GSPMD path via parallelization.use_shard_map: false."
         )
     if (setup.mesh is None or setup.panel != 6 or setup.sy != setup.sx
             or grid.n % setup.sy):

@@ -136,7 +136,7 @@ def test_shard_map_path_raises_clearly():
 
     grid = build_grid(8, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
     cov = CovariantShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA)
-    with pytest.raises(ValueError, match="GSPMD"):
+    with pytest.raises(ValueError, match="make_sharded_cov_stepper"):
         make_sharded_stepper(cov, None, None, 60.0)
 
 
