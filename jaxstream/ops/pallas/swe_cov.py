@@ -335,94 +335,93 @@ def make_cov_rhs_pallas(
 def raw_strips_cov(field, n: int, halo: int):
     """Raw boundary strips of an extended field (leading axes carried).
 
-    Same layout as :func:`jaxstream.ops.pallas.swe_step.raw_strips`:
-    ``sn = (..., 6, 2, halo, n)`` S/N interior row blocks, ``we = (..., 6,
-    2, n, halo)`` W/E column blocks.
+    ``sn = (..., 6, 2, halo, n)`` S/N interior row blocks; ``we = (..., 6,
+    2, halo, n)`` W/E interior *column* blocks stored depth-major
+    (transposed).  Unlike the Cartesian stepper's ``(..., n, halo)``
+    layout, every strip tensor here is lane-major (minor dim n): an
+    ``(n, 2)`` tensor stores as 8-byte HBM rows — thousands of tiny DMA
+    transfers per step — while the kernel-side transpose that produces
+    this layout is a supported, cheap Mosaic op.
     """
-    from .swe_step import raw_strips
-
-    return raw_strips(field, n, halo)
+    i0, i1 = halo, halo + n
+    sn = jnp.stack([
+        jnp.stack([field[..., f, i0 : i0 + halo, i0:i1],
+                   field[..., f, i1 - halo : i1, i0:i1]], axis=-3)
+        for f in range(6)
+    ], axis=-4)
+    we = jnp.stack([
+        jnp.stack([jnp.swapaxes(field[..., f, i0:i1, i0 : i0 + halo],
+                                -1, -2),
+                   jnp.swapaxes(field[..., f, i0:i1, i1 - halo : i1],
+                                -1, -2)], axis=-3)
+        for f in range(6)
+    ], axis=-4)
+    return sn, we
 
 
 def _rotation_tables(grid):
-    """Per-ghost-slot covariant rotation tensors in routed-strip layout.
+    """Per-ghost-slot covariant rotation tensors in *canonical* layout.
 
-    For every ghost slot the router fills, ``T[..., i, j] =
-    e_i^local(ghost cell) . a_j^src(source cell)`` — the same rotation as
-    ``make_vector_halo_exchanger(components='covariant')``, reindexed to
-    the placed ghost layout.  The ghost->source correspondence is read off
-    by routing a marker field of global flat indices through the *scalar*
-    strip router, so this stays correct against any routing convention.
-
-    Returns ``(T_sn, T_we)``: nested ``[i][j]`` lists of four float32
-    arrays each, shaped (6, 2, halo, n) / (6, 2, n, halo) — see
-    ``table()`` for why they are not packed into one ``(..., 2, 2)``
-    tensor.
+    For every ghost slot, ``T[i*2+j][f, e] = e_i^local(ghost cell) .
+    a_j^src(source cell)`` — the same rotation as
+    ``make_vector_halo_exchanger(components='covariant')`` — indexed by
+    the receiving face's (face, edge) in canonical (depth, along) strip
+    order with the pair's reversal already folded into the source side,
+    so it multiplies the router's post-reversal canonical strips
+    elementwise.  Returned packed as one float32 ``(4, 6, 4, halo, n)``
+    tensor (i*2+j major): four separate well-tiled slices rather than a
+    trailing ``(..., 2, 2)``, which would cost ~512x in (8, 128) tile
+    padding.
     """
     import numpy as np
 
-    from .swe_step import raw_strips, route_strips
+    from ...parallel.vector_halo import _strip_indices
 
     n, halo, m = grid.n, grid.halo, grid.m
-    i0, i1 = halo, halo + n
-    # int32 marker: route_strips is pure gather/flip/transpose, so integer
-    # indices survive exactly (a float marker would corrupt flat indices
-    # above 2^24 once 6*m*m outgrows the f32 mantissa).
-    marker = jnp.asarray(
-        np.arange(6 * m * m, dtype=np.int32).reshape(6, m, m))
-    gsn, gwe = route_strips(*raw_strips(marker, n, halo))
-    src_sn = np.asarray(gsn).astype(np.int64)          # (6, 2, halo, n)
-    src_we = np.asarray(gwe).astype(np.int64)          # (6, 2, n, halo)
+    adj = build_connectivity()
+    src_idx, dst_idx = _strip_indices(n, halo)
+    e_b = np.stack([np.moveaxis(np.asarray(grid.e_a, np.float64), 0, -1),
+                    np.moveaxis(np.asarray(grid.e_b, np.float64), 0, -1)])
+    a_b = np.stack([np.moveaxis(np.asarray(grid.a_a, np.float64), 0, -1),
+                    np.moveaxis(np.asarray(grid.a_b, np.float64), 0, -1)])
+    ef = e_b.reshape(2, 6 * m * m, 3)
+    af = a_b.reshape(2, 6 * m * m, 3)
 
-    pos = np.arange(6 * m * m).reshape(6, m, m)
-    dst_sn = np.stack([
-        np.stack([pos[f, 0:halo, i0:i1], pos[f, i1:i1 + halo, i0:i1]])
-        for f in range(6)
-    ])
-    dst_we = np.stack([
-        np.stack([pos[f, i0:i1, 0:halo], pos[f, i0:i1, i1:i1 + halo]])
-        for f in range(6)
-    ])
-
-    e = np.stack([np.moveaxis(np.asarray(grid.e_a, np.float64), 0, -1),
-                  np.moveaxis(np.asarray(grid.e_b, np.float64), 0, -1)])
-    a = np.stack([np.moveaxis(np.asarray(grid.a_a, np.float64), 0, -1),
-                  np.moveaxis(np.asarray(grid.a_b, np.float64), 0, -1)])
-    ef = e.reshape(2, 6 * m * m, 3)
-    af = a.reshape(2, 6 * m * m, 3)
-
-    def table(dst, src):
-        """Nested [i][j] list of arrays shaped like ``dst``.
-
-        Kept as 4 separate well-tiled arrays rather than one ``(..., 2,
-        2)`` tensor: trailing unit-2 dims force (8, 128) tile padding on
-        TPU (~512x memory blowup) and made the router dominate the step.
-        """
-        e_loc = ef[:, dst, :]                 # (2,) + dst.shape + (3,)
-        a_src = af[:, src, :]
-        return [[jnp.asarray(np.einsum("...k,...k->...",
-                                       e_loc[i], a_src[j]), jnp.float32)
-                 for j in range(2)] for i in range(2)]
-
-    return table(dst_sn, src_sn), table(dst_we, src_we)
+    out = np.zeros((4, 6, 4, halo, n), np.float32)
+    for f in range(6):
+        for e in range(4):
+            link = adj[f][e]
+            src = src_idx[link.nbr_edge].reshape(halo, n)
+            if link.reversed_:
+                src = src[:, ::-1]
+            src = src.reshape(-1) + link.nbr_face * m * m
+            dst = dst_idx[e] + f * m * m
+            for i in range(2):
+                for j in range(2):
+                    out[i * 2 + j, f, e] = np.einsum(
+                        "...k,...k->...", ef[i][dst], af[j][src]
+                    ).reshape(halo, n)
+    return jnp.asarray(out)
 
 
 def make_cov_strip_router(grid):
     """Build ``route(h_sn, h_we, u_sn, u_we) -> (ghosts, sym)`` for stages.
 
-    ``u_sn``/``u_we`` carry raw covariant components (source basis) with a
-    leading component axis.  Returns the placed ghost tensors for h and u
-    (u rotated into each destination panel's basis) plus the symmetrized
-    edge-normal strips ``(sym_sn (6, 2, n), sym_we (6, n, 2))`` computed
-    once per physical edge — both faces receive bitwise-identical values.
+    Strip tensors use the :func:`raw_strips_cov` layout (W/E transposed,
+    everything lane-major).  ``u_sn``/``u_we`` carry raw covariant
+    components in the source panel's basis with a leading component axis.
+    Returns the placed ghost tensors for h and u — all ``(6, 2, halo,
+    n)``-shaped; W/E transposed, un-transposed by the kernel's ghost
+    store — with u rotated into each destination panel's basis, plus the
+    symmetrized edge-normal strips ``(sym_sn (6, 2, n), sym_we (6, n,
+    2))`` computed once per physical edge so both faces receive
+    bitwise-identical values.
     """
-    import numpy as np
-
-    from .swe_step import route_strips
-
     n, halo = grid.n, grid.halo
     i0, i1 = halo, halo + n
-    T_sn, T_we = _rotation_tables(grid)
+    h = halo
+    Tc = _rotation_tables(grid)                     # (4, 6, 4, halo, n)
+    adj = build_connectivity()
 
     # Edge-face metric rows (the equiangular metric is face-independent).
     met = {
@@ -436,38 +435,65 @@ def make_cov_strip_router(grid):
                  jnp.asarray(grid.ginv_bb_yf[0, i1, i0:i1])),
     }
 
-    def edge_avg_u(usn, uwe, gusn, guwe, f, e):
+    def canonical(sn, we, f, e):
+        """Face f / edge e's canonical interior strip (depth 0 nearest)."""
+        link = adj[f][e]
+        nf, ne = link.nbr_face, link.nbr_edge
+        if ne == EDGE_S:
+            c = sn[..., nf, 0, :, :]
+        elif ne == EDGE_N:
+            c = jnp.flip(sn[..., nf, 1, :, :], axis=-2)
+        elif ne == EDGE_W:
+            c = we[..., nf, 0, :, :]
+        else:
+            c = jnp.flip(we[..., nf, 1, :, :], axis=-2)
+        if link.reversed_:
+            c = jnp.flip(c, axis=-1)
+        return c
+
+    def place(c, e):
+        """Canonical ghost strip -> the slot layout the kernel stores."""
+        return jnp.flip(c, axis=-2) if e in (EDGE_S, EDGE_W) else c
+
+    def edge_avg_u(u_sn, u_we, gusn, guwe, f, e):
         """0.5 * (edge-adjacent interior + ghost) covariant pair, (2, n)."""
-        h = halo
         if e == EDGE_S:
-            ui, ug = usn[:, f, 0, 0, :], gusn[:, f, 0, h - 1, :]
-            return 0.5 * (ug + ui)          # lower coordinate cell first
-        if e == EDGE_N:
-            ui, ug = usn[:, f, 1, h - 1, :], gusn[:, f, 1, 0, :]
-            return 0.5 * (ui + ug)
-        if e == EDGE_W:
-            ui, ug = uwe[:, f, 0, :, 0], guwe[:, f, 0, :, h - 1]
-            return 0.5 * (ug + ui)
-        ui, ug = uwe[:, f, 1, :, h - 1], guwe[:, f, 1, :, 0]
+            ui, ug = u_sn[:, f, 0, 0, :], gusn[:, f, 0, h - 1, :]
+        elif e == EDGE_N:
+            ui, ug = u_sn[:, f, 1, h - 1, :], gusn[:, f, 1, 0, :]
+        elif e == EDGE_W:
+            ui, ug = u_we[:, f, 0, 0, :], guwe[:, f, 0, h - 1, :]
+        else:
+            ui, ug = u_we[:, f, 1, h - 1, :], guwe[:, f, 1, 0, :]
         return 0.5 * (ui + ug)
 
-    def local_normal(usn, uwe, gusn, guwe, f, e):
-        ubar = edge_avg_u(usn, uwe, gusn, guwe, f, e)
+    def local_normal(u_sn, u_we, gusn, guwe, f, e):
+        ubar = edge_avg_u(u_sn, u_we, gusn, guwe, f, e)
         m0, m1 = met[e]
         return m0 * ubar[0] + m1 * ubar[1]
 
     def route(h_sn, h_we, u_sn, u_we):
-        gsn, gwe = route_strips(h_sn, h_we)
-        g0 = route_strips(u_sn[0], u_we[0])
-        g1 = route_strips(u_sn[1], u_we[1])
-        gusn = jnp.stack([
-            T_sn[i][0] * g0[0] + T_sn[i][1] * g1[0]
-            for i in range(2)
-        ])
-        guwe = jnp.stack([
-            T_we[i][0] * g0[1] + T_we[i][1] * g1[1]
-            for i in range(2)
-        ])
+        ghosts_h = [[None, None] for _ in range(6)]
+        ghosts_u = [[None, None] for _ in range(6)]
+        we_h = [[None, None] for _ in range(6)]
+        we_u = [[None, None] for _ in range(6)]
+        for f in range(6):
+            for e in range(4):
+                ch = canonical(h_sn, h_we, f, e)
+                cu = canonical(u_sn, u_we, f, e)
+                ru = jnp.stack([
+                    Tc[0, f, e] * cu[0] + Tc[1, f, e] * cu[1],
+                    Tc[2, f, e] * cu[0] + Tc[3, f, e] * cu[1],
+                ])
+                tgt_h = ghosts_h if e in (EDGE_S, EDGE_N) else we_h
+                tgt_u = ghosts_u if e in (EDGE_S, EDGE_N) else we_u
+                slot = 0 if e in (EDGE_S, EDGE_W) else 1
+                tgt_h[f][slot] = place(ch, e)
+                tgt_u[f][slot] = place(ru, e)
+        gsn = jnp.stack([jnp.stack(r) for r in ghosts_h])
+        gwe = jnp.stack([jnp.stack(r) for r in we_h])
+        gusn = jnp.stack([jnp.stack(r, axis=1) for r in ghosts_u], axis=1)
+        guwe = jnp.stack([jnp.stack(r, axis=1) for r in we_u], axis=1)
         sym = _symmetrized_strips(
             lambda f, e: local_normal(u_sn, u_we, gusn, guwe, f, e)
         )
@@ -513,11 +539,13 @@ def make_cov_stage_inkernel(
     h = halo
 
     def fill_ghosts(scratch, face_val, gsn, gwe):
+        # W/E ghost blocks arrive depth-major (halo, n) — lane-major HBM
+        # layout; the un-transpose is a supported, cheap Mosaic op.
         scratch[:] = face_val
         scratch[0:h, i0:i1] = gsn[0]
         scratch[i1 : i1 + h, i0:i1] = gsn[1]
-        scratch[i0:i1, 0:h] = gwe[0]
-        scratch[i0:i1, i1 : i1 + h] = gwe[1]
+        scratch[i0:i1, 0:h] = jnp.swapaxes(gwe[0], 0, 1)
+        scratch[i0:i1, i1 : i1 + h] = jnp.swapaxes(gwe[1], 0, 1)
         return scratch[:]
 
     def kernel(*refs):
@@ -566,8 +594,10 @@ def make_cov_stage_inkernel(
             out_ref[lead + (0, slice(i0, i1), slice(i0, i1))] = int_new
             sn_ref[lead + (0, 0)] = int_new[0:h, :]
             sn_ref[lead + (0, 1)] = int_new[n - h : n, :]
-            we_ref[lead + (0, 0)] = int_new[:, 0:h]
-            we_ref[lead + (0, 1)] = int_new[:, n - h : n]
+            # W/E strips stored transposed (depth-major): an (n, halo)
+            # tensor is 8-byte HBM rows — thousands of tiny DMAs/step.
+            we_ref[lead + (0, 0)] = jnp.swapaxes(int_new[:, 0:h], 0, 1)
+            we_ref[lead + (0, 1)] = jnp.swapaxes(int_new[:, n - h : n], 0, 1)
 
         emit(out_h, dh, ho_ref, sno_ref, weo_ref)
         emit(out_u[0], dua, uo_ref, usno_ref, uweo_ref, lead=(0,))
@@ -587,12 +617,10 @@ def make_cov_stage_inkernel(
                          memory_space=pltpu.VMEM)
     sn_blk = pl.BlockSpec((1, 2, h, n), lambda f: (f, 0, 0, 0),
                           memory_space=pltpu.VMEM)
-    we_blk = pl.BlockSpec((1, 2, n, h), lambda f: (f, 0, 0, 0),
-                          memory_space=pltpu.VMEM)
+    we_blk = sn_blk                      # W/E transposed: same layout
     usn_blk = pl.BlockSpec((2, 1, 2, h, n), lambda f: (0, f, 0, 0, 0),
                            memory_space=pltpu.VMEM)
-    uwe_blk = pl.BlockSpec((2, 1, 2, n, h), lambda f: (0, f, 0, 0, 0),
-                           memory_space=pltpu.VMEM)
+    uwe_blk = usn_blk
     ssn_blk = pl.BlockSpec((1, 2, n), lambda f: (f, 0, 0),
                            memory_space=pltpu.VMEM)
     swe_blk = pl.BlockSpec((1, n, 2), lambda f: (f, 0, 0),
@@ -617,9 +645,9 @@ def make_cov_stage_inkernel(
             jax.ShapeDtypeStruct((6, m, m), jnp.float32),
             jax.ShapeDtypeStruct((2, 6, m, m), jnp.float32),
             jax.ShapeDtypeStruct((6, 2, h, n), jnp.float32),
-            jax.ShapeDtypeStruct((6, 2, n, h), jnp.float32),
+            jax.ShapeDtypeStruct((6, 2, h, n), jnp.float32),
             jax.ShapeDtypeStruct((2, 6, 2, h, n), jnp.float32),
-            jax.ShapeDtypeStruct((2, 6, 2, n, h), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, 2, h, n), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=110 * 1024 * 1024,
@@ -749,17 +777,22 @@ def _depth_flip(strip, halo):
 def _nbr_tables(grid):
     """(T_sn_full, T_we_full, P_rev) for the neighbor-read kernels.
 
-    The [i][j] rotation tables packed into (4, ...) tensors (row-major
-    i*2+j) plus the (n, n) anti-identity used for exact MXU reversals.
+    Placed-layout rotation tables — (4, 6, 2, halo, n) for S/N ghost
+    blocks and (4, 6, 2, n, halo) for W/E — derived from the canonical
+    :func:`_rotation_tables` by the ``place_strip`` transforms, plus the
+    (n, n) anti-identity used for exact MXU reversals.
     """
     import numpy as np
 
-    T_sn, T_we = _rotation_tables(grid)
-    return (
-        jnp.stack([T_sn[i][j] for i in range(2) for j in range(2)]),
-        jnp.stack([T_we[i][j] for i in range(2) for j in range(2)]),
-        jnp.asarray(np.eye(grid.n, dtype=np.float32)[::-1]),
-    )
+    Tc = _rotation_tables(grid)                     # (4, 6, 4, halo, n)
+    t_sn = jnp.stack([jnp.flip(Tc[:, :, EDGE_S], axis=-2),
+                      Tc[:, :, EDGE_N]], axis=2)    # (4, 6, 2, halo, n)
+    t_we = jnp.stack([
+        jnp.swapaxes(jnp.flip(Tc[:, :, EDGE_W], axis=-2), -1, -2),
+        jnp.swapaxes(Tc[:, :, EDGE_E], -1, -2),
+    ], axis=2)                                      # (4, 6, 2, n, halo)
+    return (t_sn, t_we,
+            jnp.asarray(np.eye(grid.n, dtype=np.float32)[::-1]))
 
 
 def make_cov_stage_nbr(

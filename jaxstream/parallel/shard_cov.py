@@ -28,7 +28,6 @@ devices, matching the single-device fused stepper.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import numpy as np
 
@@ -44,11 +43,9 @@ from ..geometry.connectivity import (
     EDGE_W,
     build_connectivity,
     build_schedule,
-    edge_pairs,
 )
 from ..geometry.cubed_sphere import FACE_AXES
 from .halo import read_strip, write_strip
-from .vector_halo import _strip_indices
 
 __all__ = ["CovShardProgram", "make_sharded_cov_stepper"]
 
@@ -93,27 +90,11 @@ class CovShardProgram:
                 stage_of[(back.face, back.edge)] = (s, link, back, False)
             self.perms.append(perm)
 
-        src_idx, dst_idx = _strip_indices(n, halo)
-        e_b = np.stack([np.moveaxis(np.asarray(grid.e_a, np.float64), 0, -1),
-                        np.moveaxis(np.asarray(grid.e_b, np.float64), 0, -1)])
-        a_b = np.stack([np.moveaxis(np.asarray(grid.a_a, np.float64), 0, -1),
-                        np.moveaxis(np.asarray(grid.a_b, np.float64), 0, -1)])
-        ef = e_b.reshape(2, 6 * m * m, 3)
-        af = a_b.reshape(2, 6 * m * m, 3)
+        # One source of truth for the rotation convention: the fused
+        # stepper's canonical tables, sliced per (face, edge).
+        from ..ops.pallas.swe_cov import _rotation_tables
 
-        def T_of(face, edge):
-            """(4, halo, n) canonical rotation entries for one ghost fill."""
-            link = adj[face][edge]
-            src = src_idx[link.nbr_edge].reshape(halo, n)
-            if link.reversed_:
-                src = src[:, ::-1]
-            src = src.reshape(-1) + link.nbr_face * m * m
-            dst = dst_idx[edge] + face * m * m
-            al = np.stack([ef[0][dst], ef[1][dst]], axis=1)   # (hn, 2, 3)
-            en = np.stack([af[0][src], af[1][src]], axis=2)   # (hn, 3, 2)
-            T = al @ en                                       # (hn, 2, 2)
-            return np.stack([T[:, i, j].reshape(halo, n)
-                             for i in range(2) for j in range(2)])
+        T_all = np.asarray(_rotation_tables(grid))   # (4, 6, 4, halo, n)
 
         gaa_xf = np.asarray(grid.ginv_aa_xf)
         gab_xf = np.asarray(grid.ginv_ab_xf)
@@ -140,7 +121,6 @@ class CovShardProgram:
         met_mine = np.zeros((6, nst, 2, n), np.float32)
         met_oth = np.zeros((6, nst, 2, n), np.float32)
 
-        T_cache = {(f, e): T_of(f, e) for f in range(6) for e in range(4)}
         for (f, e), (s, link, back, mine_is_link) in stage_of.items():
             other = back if mine_is_link else link
             edge_sel[f, s] = e
@@ -148,8 +128,8 @@ class CovShardProgram:
             is_link[f, s] = float(mine_is_link)
             s_link[f, s] = _OUT_SIGN[link.edge]
             s_back[f, s] = _OUT_SIGN[back.edge]
-            T_mine[f, s] = T_cache[(f, e)]
-            T_oadj[f, s] = T_cache[(other.face, other.edge)][:, 0, :]
+            T_mine[f, s] = T_all[:, f, e]
+            T_oadj[f, s] = T_all[:, other.face, other.edge][:, 0, :]
             met_mine[f, s] = met_of(f, e)
             met_oth[f, s] = met_of(other.face, other.edge)
 
@@ -185,14 +165,15 @@ def make_cov_shard_exchange(program: CovShardProgram):
 
     def exchange(h_blk, u_blk, t):
         sym = jnp.zeros((4, n), jnp.float32)
+        # Canonical strips for every edge, read once: the stages write
+        # only the ghost ring, so the interior strips are loop-invariant.
+        hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
+                        for e in range(4)])                  # (4, halo, n)
+        us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
+                        for e in range(4)], axis=1)          # (2, 4, halo, n)
         for s, perm in enumerate(program.perms):
             e_s = t["edge_sel"][0, s]
             rev = t["rev_sel"][0, s]
-            # My canonical strips for every edge; select this stage's.
-            hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
-                            for e in range(4)])              # (4, halo, n)
-            us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
-                            for e in range(4)], axis=1)      # (2, 4, halo, n)
             h_send = jnp.take(hs, e_s, axis=0)
             u_send = jnp.take(us, e_s, axis=1)
             payload = jnp.concatenate([h_send[None], u_send])  # (3, halo, n)
@@ -269,7 +250,7 @@ def make_sharded_cov_stepper(model, setup, dt: float):
             f"GSPMD path (use_shard_map: false) for other layouts."
         )
     mesh = setup.mesh
-    n, halo = grid.n, grid.halo
+    halo = grid.halo
     program = CovShardProgram(grid)
     exchange = make_cov_shard_exchange(program)
     platform = getattr(mesh.devices.flat[0], "platform", "cpu")
@@ -286,9 +267,9 @@ def make_sharded_cov_stepper(model, setup, dt: float):
     axes = mesh.axis_names                      # ('panel', 'y', 'x')
     pstate = {"h": P(axes[0]), "u": P(None, axes[0])}
     ptab = {k: P(axes[0]) for k in program.tables}
-    a1, b1 = 0.0, 1.0
-    a2, b2 = 0.75, 0.25
-    a3, b3 = 1.0 / 3.0, 2.0 / 3.0
+    from ..ops.pallas.swe_step import SSPRK3_COEFFS
+
+    (_, _), (a2, b2), (a3, b3) = SSPRK3_COEFFS  # stage 1 is y0 + dt f
 
     def embed(x):
         pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
