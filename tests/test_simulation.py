@@ -160,3 +160,27 @@ def test_yaml_exponent_literals_coerce_to_float():
 
     with pytest.raises(ValueError, match="expects a float"):
         load_config("physics:\n  hyperdiffusion: banana\n")
+
+
+def test_simulation_uses_fused_stepper_for_pallas_swe():
+    """Single-device pallas SWE sims run the fused extended-state path
+    and match the classic jnp path to f32 roundoff."""
+    base = {
+        "grid": {"n": 16, "halo": 2},
+        "model": {"name": "shallow_water_cov", "initial_condition": "tc5"},
+        "time": {"dt": 600.0, "nsteps": 6},
+        "parallelization": {"num_devices": 1, "device_type": "cpu"},
+        "io": {},
+    }
+    ref = Simulation({**base})
+    ref.run(6)
+
+    cfg = {**base, "model": {**base["model"], "backend": "pallas_interpret"}}
+    sim = Simulation(cfg)
+    assert sim._fused_step is not None
+    sim.run(6)
+
+    a = np.asarray(ref.state["h"], dtype=np.float64)
+    b = np.asarray(sim.state["h"], dtype=np.float64)
+    scale = np.max(np.abs(a))
+    np.testing.assert_allclose(b, a, atol=2e-4 * scale)
