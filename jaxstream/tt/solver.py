@@ -112,12 +112,14 @@ def tt_rk_step(
     if scheme == "euler":
         return axpy(q, dt, rhs(q))
     if scheme == "ssprk3":
+        # Shu-Osher: u1 = u + dt L(u); u2 = 3/4 u + 1/4 (u1 + dt L(u1));
+        # u' = 1/3 u + 2/3 (u2 + dt L(u2)).
         y1 = axpy(q, dt, rhs(q))
         y2_ = axpy(y1, dt, rhs(y1))
         y2 = tt_round(
             tt_add(tt_scale(q, 0.75), tt_scale(y2_, 0.25)), max_rank=max_rank
         )
-        y3 = axpy(y2, 0.5 * dt, rhs(y2))
+        y3 = axpy(y2, dt, rhs(y2))
         return tt_round(
             tt_add(tt_scale(q, 1.0 / 3.0), tt_scale(y3, 2.0 / 3.0)),
             max_rank=max_rank,

@@ -103,7 +103,29 @@ def tt_decompose(
     mat = a.reshape(r_prev * dims[0], -1)
     for k in range(d - 1):
         u, s, vt = jnp.linalg.svd(mat, full_matrices=False)
+        if (not bool(jnp.all(jnp.isfinite(s)))
+                or not bool(jnp.all(jnp.isfinite(u)))):
+            if bool(jnp.all(jnp.isfinite(mat))):
+                # XLA's CPU SVD can fail (NaN) on exactly rank-deficient
+                # unfoldings — which step-and-truncate TT evolution
+                # produces routinely once a field's numerical rank drops
+                # below the rank cap.  LAPACK via numpy handles these;
+                # tt_decompose is eager-only (concrete rank arithmetic
+                # below), so a host round-trip is legal here.
+                u_, s_, vt_ = np.linalg.svd(np.asarray(mat),
+                                            full_matrices=False)
+                u, s, vt = (jnp.asarray(u_, a.dtype),
+                            jnp.asarray(s_, a.dtype),
+                            jnp.asarray(vt_, a.dtype))
+            # else: the *input* is non-finite (blown-up evolution) — keep
+            # the NaN factors so the divergence propagates to the caller
+            # instead of dying in the fallback with a misleading
+            # LinAlgError.
         r = int(s.shape[0])
+        # Always drop numerically-zero directions: carrying noise cores
+        # wastes rank budget and feeds degenerate matrices to later SVDs.
+        floor = float(s[0]) * (32.0 * float(jnp.finfo(a.dtype).eps))
+        r = max(1, min(r, int(jnp.sum(s > floor))))
         if delta is not None:
             # Largest truncation whose dropped tail stays under delta.
             tail = jnp.sqrt(jnp.cumsum(s[::-1] ** 2))[::-1]
