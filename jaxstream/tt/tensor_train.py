@@ -103,8 +103,9 @@ def tt_decompose(
     mat = a.reshape(r_prev * dims[0], -1)
     for k in range(d - 1):
         u, s, vt = jnp.linalg.svd(mat, full_matrices=False)
-        if (not bool(jnp.all(jnp.isfinite(s)))
-                or not bool(jnp.all(jnp.isfinite(u)))):
+        ok = bool((jnp.isfinite(s).all() & jnp.isfinite(u).all()
+                   & jnp.isfinite(vt).all()))
+        if not ok:
             if bool(jnp.all(jnp.isfinite(mat))):
                 # XLA's CPU SVD can fail (NaN) on exactly rank-deficient
                 # unfoldings — which step-and-truncate TT evolution
