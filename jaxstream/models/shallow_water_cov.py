@@ -89,10 +89,9 @@ class CovariantShallowWater(SWEBase):
         g = self.grid
         y = {k: embed_interior(g, v) for k, v in state.items()}
         if with_strips:
-            from ..ops.pallas.swe_cov import raw_strips_cov
+            from ..ops.pallas.swe_cov import pack_strips_cov
 
-            y["sh_sn"], y["sh_we"] = raw_strips_cov(y["h"], g.n, g.halo)
-            y["su_sn"], y["su_we"] = raw_strips_cov(y["u"], g.n, g.halo)
+            y["strips"] = pack_strips_cov(y["h"], y["u"], g.n, g.halo)
         return y
 
     def restrict_state(self, y_ext: State) -> State:

@@ -45,7 +45,7 @@ __all__ = [
     "rhs_core_cov",
     "make_cov_rhs_pallas",
     "make_cov_strip_router",
-    "raw_strips_cov",
+    "pack_strips_cov",
     "make_cov_stage_inkernel",
     "make_fused_ssprk3_cov_inkernel",
     "make_cov_stage_nbr",
@@ -332,31 +332,40 @@ def make_cov_rhs_pallas(
 # ---------------------------------------------------------------------------
 
 
-def raw_strips_cov(field, n: int, halo: int):
-    """Raw boundary strips of an extended field (leading axes carried).
+# Packed strip layout: ONE (6, 12*halo, n) tensor holds every boundary
+# strip of the 3-field state — for each field fi in (h, u_a, u_b), base =
+# fi*4*halo, rows [base, base+halo) = S block, [+halo, +2halo) = N block,
+# [+2halo, +3halo) = W column block transposed depth-major, [+3halo,
+# +4halo) = E ditto.  Rationale: (a) lane-major everywhere (an (n, 2)
+# strip stores as 8-byte HBM rows — thousands of tiny DMAs per step);
+# (b) ONE kernel operand instead of eight — each extra per-face block
+# costs fixed DMA setup per grid step, and those fixed costs, not the
+# RHS math, dominate the fused step (measured: an empty-body stage costs
+# the same as the full RHS).  The routed-ghost input tensor uses the
+# same 12*halo rows (placed layout) plus 4 trailing rows: the
+# symmetrized edge normals for S, N and (transposed) W, E.
 
-    ``sn = (..., 6, 2, halo, n)`` S/N interior row blocks; ``we = (..., 6,
-    2, halo, n)`` W/E interior *column* blocks stored depth-major
-    (transposed).  Unlike the Cartesian stepper's ``(..., n, halo)``
-    layout, every strip tensor here is lane-major (minor dim n): an
-    ``(n, 2)`` tensor stores as 8-byte HBM rows — thousands of tiny DMA
-    transfers per step — while the kernel-side transpose that produces
-    this layout is a supported, cheap Mosaic op.
-    """
+
+def _strip_base(fi: int, halo: int) -> int:
+    return fi * 4 * halo
+
+
+def pack_strips_cov(h_ext, u_ext, n: int, halo: int):
+    """Boundary strips of extended (h, u) as one ``(6, 12*halo, n)``."""
     i0, i1 = halo, halo + n
-    sn = jnp.stack([
-        jnp.stack([field[..., f, i0 : i0 + halo, i0:i1],
-                   field[..., f, i1 - halo : i1, i0:i1]], axis=-3)
-        for f in range(6)
-    ], axis=-4)
-    we = jnp.stack([
-        jnp.stack([jnp.swapaxes(field[..., f, i0:i1, i0 : i0 + halo],
-                                -1, -2),
-                   jnp.swapaxes(field[..., f, i0:i1, i1 - halo : i1],
-                                -1, -2)], axis=-3)
-        for f in range(6)
-    ], axis=-4)
-    return sn, we
+    fields = (h_ext, u_ext[0], u_ext[1])
+    rows = []
+    for f in range(6):
+        per_face = []
+        for q in fields:
+            per_face += [
+                q[f, i0 : i0 + halo, i0:i1],
+                q[f, i1 - halo : i1, i0:i1],
+                jnp.swapaxes(q[f, i0:i1, i0 : i0 + halo], 0, 1),
+                jnp.swapaxes(q[f, i0:i1, i1 - halo : i1], 0, 1),
+            ]
+        rows.append(jnp.concatenate(per_face, axis=0))
+    return jnp.stack(rows)
 
 
 def _rotation_tables(grid):
@@ -405,17 +414,15 @@ def _rotation_tables(grid):
 
 
 def make_cov_strip_router(grid):
-    """Build ``route(h_sn, h_we, u_sn, u_we) -> (ghosts, sym)`` for stages.
+    """Build ``route(strips) -> ghosts`` over the packed strip layout.
 
-    Strip tensors use the :func:`raw_strips_cov` layout (W/E transposed,
-    everything lane-major).  ``u_sn``/``u_we`` carry raw covariant
-    components in the source panel's basis with a leading component axis.
-    Returns the placed ghost tensors for h and u — all ``(6, 2, halo,
-    n)``-shaped; W/E transposed, un-transposed by the kernel's ghost
-    store — with u rotated into each destination panel's basis, plus the
-    symmetrized edge-normal strips ``(sym_sn (6, 2, n), sym_we (6, n,
-    2))`` computed once per physical edge so both faces receive
-    bitwise-identical values.
+    ``strips``: (6, 12*halo, n) per :func:`pack_strips_cov` — raw
+    covariant components in each source panel's basis.  Returns the
+    packed ghost tensor (6, 12*halo + 4, n): the same row layout holding
+    the *placed* ghost blocks (u rotated into each destination panel's
+    basis), followed by the four symmetrized edge-normal rows (S, N,
+    then W, E transposed) — computed once per physical edge so both
+    faces' flux inputs are bitwise-identical.
     """
     n, halo = grid.n, grid.halo
     i0, i1 = halo, halo + n
@@ -434,19 +441,19 @@ def make_cov_strip_router(grid):
         EDGE_N: (jnp.asarray(grid.ginv_ab_yf[0, i1, i0:i1]),
                  jnp.asarray(grid.ginv_bb_yf[0, i1, i0:i1])),
     }
+    # Within-field row offsets: S, N, W(T), E(T) blocks of `halo` rows.
+    off = {EDGE_S: 0, EDGE_N: h, EDGE_W: 2 * h, EDGE_E: 3 * h}
 
-    def canonical(sn, we, f, e):
-        """Face f / edge e's canonical interior strip (depth 0 nearest)."""
+    def raw_block(strips, fi, f, e):
+        b = _strip_base(fi, h) + off[e]
+        return strips[f, b : b + h, :]
+
+    def canonical(strips, fi, f, e):
+        """Face f / edge e's canonical ghost source (depth 0 nearest)."""
         link = adj[f][e]
-        nf, ne = link.nbr_face, link.nbr_edge
-        if ne == EDGE_S:
-            c = sn[..., nf, 0, :, :]
-        elif ne == EDGE_N:
-            c = jnp.flip(sn[..., nf, 1, :, :], axis=-2)
-        elif ne == EDGE_W:
-            c = we[..., nf, 0, :, :]
-        else:
-            c = jnp.flip(we[..., nf, 1, :, :], axis=-2)
+        c = raw_block(strips, fi, link.nbr_face, link.nbr_edge)
+        if link.nbr_edge in (EDGE_N, EDGE_E):
+            c = jnp.flip(c, axis=-2)
         if link.reversed_:
             c = jnp.flip(c, axis=-1)
         return c
@@ -455,49 +462,40 @@ def make_cov_strip_router(grid):
         """Canonical ghost strip -> the slot layout the kernel stores."""
         return jnp.flip(c, axis=-2) if e in (EDGE_S, EDGE_W) else c
 
-    def edge_avg_u(u_sn, u_we, gusn, guwe, f, e):
-        """0.5 * (edge-adjacent interior + ghost) covariant pair, (2, n)."""
-        if e == EDGE_S:
-            ui, ug = u_sn[:, f, 0, 0, :], gusn[:, f, 0, h - 1, :]
-        elif e == EDGE_N:
-            ui, ug = u_sn[:, f, 1, h - 1, :], gusn[:, f, 1, 0, :]
-        elif e == EDGE_W:
-            ui, ug = u_we[:, f, 0, 0, :], guwe[:, f, 0, h - 1, :]
-        else:
-            ui, ug = u_we[:, f, 1, h - 1, :], guwe[:, f, 1, 0, :]
-        return 0.5 * (ui + ug)
-
-    def local_normal(u_sn, u_we, gusn, guwe, f, e):
-        ubar = edge_avg_u(u_sn, u_we, gusn, guwe, f, e)
-        m0, m1 = met[e]
-        return m0 * ubar[0] + m1 * ubar[1]
-
-    def route(h_sn, h_we, u_sn, u_we):
-        ghosts_h = [[None, None] for _ in range(6)]
-        ghosts_u = [[None, None] for _ in range(6)]
-        we_h = [[None, None] for _ in range(6)]
-        we_u = [[None, None] for _ in range(6)]
+    def route(strips):
+        ghost_rows = [[None] * 12 for _ in range(6)]
+        g_adj = {}
         for f in range(6):
             for e in range(4):
-                ch = canonical(h_sn, h_we, f, e)
-                cu = canonical(u_sn, u_we, f, e)
-                ru = jnp.stack([
-                    Tc[0, f, e] * cu[0] + Tc[1, f, e] * cu[1],
-                    Tc[2, f, e] * cu[0] + Tc[3, f, e] * cu[1],
-                ])
-                tgt_h = ghosts_h if e in (EDGE_S, EDGE_N) else we_h
-                tgt_u = ghosts_u if e in (EDGE_S, EDGE_N) else we_u
-                slot = 0 if e in (EDGE_S, EDGE_W) else 1
-                tgt_h[f][slot] = place(ch, e)
-                tgt_u[f][slot] = place(ru, e)
-        gsn = jnp.stack([jnp.stack(r) for r in ghosts_h])
-        gwe = jnp.stack([jnp.stack(r) for r in we_h])
-        gusn = jnp.stack([jnp.stack(r, axis=1) for r in ghosts_u], axis=1)
-        guwe = jnp.stack([jnp.stack(r, axis=1) for r in we_u], axis=1)
-        sym = _symmetrized_strips(
-            lambda f, e: local_normal(u_sn, u_we, gusn, guwe, f, e)
-        )
-        return (gsn, gwe, gusn, guwe), sym
+                ch = place(canonical(strips, 0, f, e), e)
+                cu = [canonical(strips, 1 + c_, f, e) for c_ in range(2)]
+                ru = [Tc[0, f, e] * cu[0] + Tc[1, f, e] * cu[1],
+                      Tc[2, f, e] * cu[0] + Tc[3, f, e] * cu[1]]
+                slot = {EDGE_S: 0, EDGE_N: 1, EDGE_W: 2, EDGE_E: 3}[e]
+                ghost_rows[f][slot] = ch
+                ghost_rows[f][4 + slot] = place(ru[0], e)
+                ghost_rows[f][8 + slot] = place(ru[1], e)
+                # Edge-adjacent ghost row (placed: S/W blocks are depth-
+                # flipped so the adjacent row is h-1; N/E it is 0).
+                k = h - 1 if e in (EDGE_S, EDGE_W) else 0
+                g_adj[(f, e)] = jnp.stack(
+                    [place(ru[0], e)[k], place(ru[1], e)[k]])
+
+        def local_normal(f, e):
+            ui = jnp.stack([raw_block(strips, 1 + c_, f, e)[
+                h - 1 if e in (EDGE_N, EDGE_E) else 0] for c_ in range(2)])
+            ubar = 0.5 * (ui + g_adj[(f, e)])
+            m0, m1 = met[e]
+            return m0 * ubar[0] + m1 * ubar[1]
+
+        sym_sn, sym_we = _symmetrized_strips(local_normal)
+
+        out = []
+        for f in range(6):
+            out.append(jnp.concatenate(
+                ghost_rows[f] + [sym_sn[f], jnp.swapaxes(sym_we[f], 0, 1)],
+                axis=0))
+        return jnp.stack(out)
 
     return route
 
@@ -518,13 +516,13 @@ def make_cov_stage_inkernel(
 ):
     """One fused covariant RK stage with the halo fill inside the kernel.
 
-    ``a == 0``: ``stage(hc, uc, ghosts, sym, b_ext)``; else
-    ``stage(h0, u0, hc, uc, ghosts, sym, b_ext)``.  ``ghosts`` is the
-    routed 4-tuple ``(gsn, gwe, gusn, guwe)``, ``sym`` the pair
-    ``(sym_sn, sym_we)`` from :func:`make_cov_strip_router`.  Returns
-    ``(h, u, sn, we, usn, uwe)`` — combined state plus its raw boundary
-    strips.  Ghost corners stay stale (never read by the dimension-split
-    stencils).
+    ``a == 0``: ``stage(hc, uc, ghosts, b_ext)``; else
+    ``stage(h0, u0, hc, uc, ghosts, b_ext)``.  ``ghosts`` is the packed
+    (6, 12*halo + 4, n) tensor from :func:`make_cov_strip_router` (placed
+    ghost blocks + symmetrized edge-normal rows).  Returns ``(h, u,
+    strips)`` — the combined state plus its packed boundary strips
+    (:func:`pack_strips_cov` layout).  Ghost corners stay stale (never
+    read by the dimension-split stencils).
     """
     import numpy as np
 
@@ -537,41 +535,42 @@ def make_cov_stage_inkernel(
     frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
     with_y0 = a != 0.0
     h = halo
+    R = 12 * halo
 
-    def fill_ghosts(scratch, face_val, gsn, gwe):
-        # W/E ghost blocks arrive depth-major (halo, n) — lane-major HBM
-        # layout; the un-transpose is a supported, cheap Mosaic op.
+    def fill_ghosts(scratch, face_val, gi, fi):
+        # Ghost blocks arrive packed and lane-major; W/E un-transpose is
+        # a supported, cheap Mosaic op.
+        base = _strip_base(fi, h)
         scratch[:] = face_val
-        scratch[0:h, i0:i1] = gsn[0]
-        scratch[i1 : i1 + h, i0:i1] = gsn[1]
-        scratch[i0:i1, 0:h] = jnp.swapaxes(gwe[0], 0, 1)
-        scratch[i0:i1, i1 : i1 + h] = jnp.swapaxes(gwe[1], 0, 1)
+        scratch[0:h, i0:i1] = gi[base : base + h]
+        scratch[i1 : i1 + h, i0:i1] = gi[base + h : base + 2 * h]
+        scratch[i0:i1, 0:h] = jnp.swapaxes(gi[base + 2 * h : base + 3 * h],
+                                           0, 1)
+        scratch[i0:i1, i1 : i1 + h] = jnp.swapaxes(
+            gi[base + 3 * h : base + 4 * h], 0, 1)
         return scratch[:]
 
     def kernel(*refs):
         if with_y0:
             (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
-             h0_ref, u0_ref, hc_ref, uc_ref,
-             gsn_ref, gwe_ref, gusn_ref, guwe_ref, ssn_ref, swe_ref, b_ref,
-             ho_ref, uo_ref, sno_ref, weo_ref, usno_ref, uweo_ref,
-             *scratch) = refs
+             h0_ref, u0_ref, hc_ref, uc_ref, gi_ref, b_ref,
+             ho_ref, uo_ref, so_ref, *scratch) = refs
         else:
             (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
-             hc_ref, uc_ref,
-             gsn_ref, gwe_ref, gusn_ref, guwe_ref, ssn_ref, swe_ref, b_ref,
-             ho_ref, uo_ref, sno_ref, weo_ref, usno_ref, uweo_ref,
-             *scratch) = refs
+             hc_ref, uc_ref, gi_ref, b_ref,
+             ho_ref, uo_ref, so_ref, *scratch) = refs
 
-        hf = fill_ghosts(scratch[0], hc_ref[0], gsn_ref[0], gwe_ref[0])
-        ua = fill_ghosts(scratch[1], uc_ref[0, 0],
-                         gusn_ref[0, 0], guwe_ref[0, 0])
-        ub = fill_ghosts(scratch[2], uc_ref[1, 0],
-                         gusn_ref[1, 0], guwe_ref[1, 0])
+        gi = gi_ref[0]
+        hf = fill_ghosts(scratch[0], hc_ref[0], gi, 0)
+        ua = fill_ghosts(scratch[1], uc_ref[0, 0], gi, 1)
+        ub = fill_ghosts(scratch[2], uc_ref[1, 0], gi, 2)
         fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        ssn = gi[R : R + 2]
+        swe = jnp.swapaxes(gi[R + 2 : R + 4], 0, 1)
 
         dh, dua, dub = rhs_core_cov(
             fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
-            hf, ua, ub, b_ref[0], ssn_ref[0], swe_ref[0],
+            hf, ua, ub, b_ref[0], ssn, swe,
             n=n, halo=halo, d=d, radius=radius,
             gravity=gravity, omega=omega, recon=recon,
         )
@@ -588,20 +587,21 @@ def make_cov_stage_inkernel(
             out_u = ([ua, ub] if b == 1.0
                      else [fb * ua, fb * ub])
 
-        def emit(val, tend, out_ref, sn_ref, we_ref, lead=()):
+        def emit(val, tend, out_ref, fi, lead=()):
             int_new = val[i0:i1, i0:i1] + fg * tend
             out_ref[lead + (0,)] = val
             out_ref[lead + (0, slice(i0, i1), slice(i0, i1))] = int_new
-            sn_ref[lead + (0, 0)] = int_new[0:h, :]
-            sn_ref[lead + (0, 1)] = int_new[n - h : n, :]
-            # W/E strips stored transposed (depth-major): an (n, halo)
-            # tensor is 8-byte HBM rows — thousands of tiny DMAs/step.
-            we_ref[lead + (0, 0)] = jnp.swapaxes(int_new[:, 0:h], 0, 1)
-            we_ref[lead + (0, 1)] = jnp.swapaxes(int_new[:, n - h : n], 0, 1)
+            base = _strip_base(fi, h)
+            so_ref[0, base : base + h] = int_new[0:h, :]
+            so_ref[0, base + h : base + 2 * h] = int_new[n - h : n, :]
+            so_ref[0, base + 2 * h : base + 3 * h] = jnp.swapaxes(
+                int_new[:, 0:h], 0, 1)
+            so_ref[0, base + 3 * h : base + 4 * h] = jnp.swapaxes(
+                int_new[:, n - h : n], 0, 1)
 
-        emit(out_h, dh, ho_ref, sno_ref, weo_ref)
-        emit(out_u[0], dua, uo_ref, usno_ref, uweo_ref, lead=(0,))
-        emit(out_u[1], dub, uo_ref, usno_ref, uweo_ref, lead=(1,))
+        emit(out_h, dh, ho_ref, 0)
+        emit(out_u[0], dua, uo_ref, 1, lead=(0,))
+        emit(out_u[1], dub, uo_ref, 2, lead=(1,))
 
     fz_spec = pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
                            memory_space=pltpu.SMEM)
@@ -615,39 +615,29 @@ def make_cov_stage_inkernel(
                          memory_space=pltpu.VMEM)
     u_blk = pl.BlockSpec((2, 1, m, m), lambda f: (0, f, 0, 0),
                          memory_space=pltpu.VMEM)
-    sn_blk = pl.BlockSpec((1, 2, h, n), lambda f: (f, 0, 0, 0),
+    gi_blk = pl.BlockSpec((1, R + 4, n), lambda f: (f, 0, 0),
                           memory_space=pltpu.VMEM)
-    we_blk = sn_blk                      # W/E transposed: same layout
-    usn_blk = pl.BlockSpec((2, 1, 2, h, n), lambda f: (0, f, 0, 0, 0),
-                           memory_space=pltpu.VMEM)
-    uwe_blk = usn_blk
-    ssn_blk = pl.BlockSpec((1, 2, n), lambda f: (f, 0, 0),
-                           memory_space=pltpu.VMEM)
-    swe_blk = pl.BlockSpec((1, n, 2), lambda f: (f, 0, 0),
-                           memory_space=pltpu.VMEM)
+    so_blk = pl.BlockSpec((1, R, n), lambda f: (f, 0, 0),
+                          memory_space=pltpu.VMEM)
 
     in_specs = [fz_spec] + coord_specs
     if with_y0:
         in_specs += [h_blk, u_blk]
-    in_specs += [h_blk, u_blk, sn_blk, we_blk, usn_blk, uwe_blk,
-                 ssn_blk, swe_blk, h_blk]
+    in_specs += [h_blk, u_blk, gi_blk, h_blk]
 
     call = pl.pallas_call(
         kernel,
         grid_spec=pl.GridSpec(
             grid=(6,),
             in_specs=in_specs,
-            out_specs=[h_blk, u_blk, sn_blk, we_blk, usn_blk, uwe_blk],
+            out_specs=[h_blk, u_blk, so_blk],
             scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
                             for _ in range(3)],
         ),
         out_shape=[
             jax.ShapeDtypeStruct((6, m, m), jnp.float32),
             jax.ShapeDtypeStruct((2, 6, m, m), jnp.float32),
-            jax.ShapeDtypeStruct((6, 2, h, n), jnp.float32),
-            jax.ShapeDtypeStruct((6, 2, h, n), jnp.float32),
-            jax.ShapeDtypeStruct((2, 6, 2, h, n), jnp.float32),
-            jax.ShapeDtypeStruct((2, 6, 2, h, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, R, n), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=110 * 1024 * 1024,
@@ -656,13 +646,13 @@ def make_cov_stage_inkernel(
     )
 
     if with_y0:
-        def stage(h0, u0, hc, uc, ghosts, sym, b_ext):
+        def stage(h0, u0, hc, uc, ghosts, b_ext):
             return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
-                              h0, u0, hc, uc, *ghosts, *sym, b_ext))
+                              h0, u0, hc, uc, ghosts, b_ext))
     else:
-        def stage(hc, uc, ghosts, sym, b_ext):
+        def stage(hc, uc, ghosts, b_ext):
             return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
-                              hc, uc, *ghosts, *sym, b_ext))
+                              hc, uc, ghosts, b_ext))
     return stage
 
 
@@ -676,11 +666,11 @@ def make_fused_ssprk3_cov_inkernel(
     limiter: str = "mc",
     interpret: bool = False,
 ):
-    """``step(y, t) -> y`` over ``y = {h, u, sh_sn, sh_we, su_sn, su_we}``.
+    """``step(y, t) -> y`` over ``y = {h, u, strips}``.
 
     The covariant minimum-HBM-traffic step: three fused stage kernels plus
     three strip-routing shuffles (rotations + symmetrized edge normals on
-    ~strip-sized tensors).  Initialise the carry with
+    one packed strip tensor).  Initialise the carry with
     :meth:`CovariantShallowWater.extend_state(state, with_strips=True)`.
     """
     from .swe_step import SSPRK3_COEFFS
@@ -699,14 +689,10 @@ def make_fused_ssprk3_cov_inkernel(
     def step(y, t):
         del t
         h0, u0 = y["h"], y["u"]
-        g0, s0 = route(y["sh_sn"], y["sh_we"], y["su_sn"], y["su_we"])
-        h1, u1, *s1 = stage1(h0, u0, g0, s0, b_ext)
-        g1, sy1 = route(*s1)
-        h2, u2, *s2 = stage2(h0, u0, h1, u1, g1, sy1, b_ext)
-        g2, sy2 = route(*s2)
-        h3, u3, *s3 = stage3(h0, u0, h2, u2, g2, sy2, b_ext)
-        return {"h": h3, "u": u3, "sh_sn": s3[0], "sh_we": s3[1],
-                "su_sn": s3[2], "su_we": s3[3]}
+        h1, u1, s1 = stage1(h0, u0, route(y["strips"]), b_ext)
+        h2, u2, s2 = stage2(h0, u0, h1, u1, route(s1), b_ext)
+        h3, u3, s3 = stage3(h0, u0, h2, u2, route(s2), b_ext)
+        return {"h": h3, "u": u3, "strips": s3}
 
     return step
 
