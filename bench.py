@@ -81,11 +81,11 @@ def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=2000):
             grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext,
             backend="pallas")
         step = model.make_fused_step(dt)
-        y = model.extend_state(model.initial_state(h_ext, v_ext),
-                               with_strips=True)
+        y = model.compact_state(model.initial_state(h_ext, v_ext))
         jax.block_until_ready(jax.jit(step)(y, jnp.float32(0.0)))
         state = y
-        log("bench: using covariant fused SSPRK3 stepper (rotation strips)")
+        log("bench: using covariant compact fused SSPRK3 stepper "
+            "(interior-only carry, rotation strips)")
     except Exception as e:
         log(f"bench: covariant fused stepper unavailable "
             f"({type(e).__name__}: {e})")

@@ -95,21 +95,41 @@ class CovariantShallowWater(SWEBase):
         return y
 
     def restrict_state(self, y_ext: State) -> State:
-        return {k: self.grid.interior(v) for k, v in y_ext.items()
-                if k in ("h", "u")}
+        g = self.grid
+        out = {}
+        for k, v in y_ext.items():
+            if k not in ("h", "u"):
+                continue
+            out[k] = g.interior(v) if v.shape[-1] == g.m else v
+        return out
 
-    def make_fused_step(self, dt: float):
-        """SSPRK3 over extended state: one fused kernel per stage, halo
-        fill and edge-normal symmetrization via the strip carry
-        (:mod:`jaxstream.ops.pallas.swe_cov`).  Requires
+    def compact_state(self, state: State) -> State:
+        """Interior state -> the compact fused-stepper carry."""
+        from ..ops.pallas.swe_cov import pack_strips_cov_split
+
+        g = self.grid
+        sn, we = pack_strips_cov_split(state["h"], state["u"], g.n, g.halo)
+        return {"h": state["h"], "u": state["u"],
+                "strips_sn": sn, "strips_we": we}
+
+    def make_fused_step(self, dt: float, compact: bool = True):
+        """Fused SSPRK3: one Pallas kernel per stage (halo fill in-kernel,
+        edge rotations/symmetrization on a packed strip carry,
+        :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
+        production path) carries interior-only fields — initialise with
+        :meth:`compact_state`; ``compact=False`` keeps the extended-state
+        carry from :meth:`extend_state` ``(with_strips=True)``.  Requires
         ``backend='pallas'`` and ``nu4 == 0``."""
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
         if self.nu4 != 0.0:
             raise ValueError("make_fused_step does not support nu4 > 0")
-        from ..ops.pallas.swe_cov import make_fused_ssprk3_cov_inkernel
+        from ..ops.pallas.swe_cov import (
+            make_fused_ssprk3_cov_compact, make_fused_ssprk3_cov_inkernel)
 
-        return make_fused_ssprk3_cov_inkernel(
+        mk = (make_fused_ssprk3_cov_compact if compact
+              else make_fused_ssprk3_cov_inkernel)
+        return mk(
             self.grid, self.gravity, self.omega, dt, self.b_ext,
             scheme=self.scheme, limiter=self.limiter,
             interpret=(self.backend == "pallas_interpret"),

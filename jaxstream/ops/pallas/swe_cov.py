@@ -45,9 +45,14 @@ __all__ = [
     "rhs_core_cov",
     "make_cov_rhs_pallas",
     "make_cov_strip_router",
+    "make_cov_strip_router_linear",
+    "make_cov_strip_router_split",
     "pack_strips_cov",
+    "pack_strips_cov_split",
     "make_cov_stage_inkernel",
     "make_fused_ssprk3_cov_inkernel",
+    "make_cov_stage_compact",
+    "make_fused_ssprk3_cov_compact",
     "make_cov_stage_nbr",
     "make_fused_ssprk3_cov_nbr",
 ]
@@ -181,13 +186,18 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     fy = Fy["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
                         + jnp.minimum(uy, 0.0) * qR)
 
-    Fc = _fast_frame(xr[:, h0:h1], yc[h0:h1], radius)
-    inv_sg_d = Fc["inv_sqrtg"] * jnp.float32(1.0 / d)
-    dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
-
     # ---- momentum (vector-invariant, covariant components) ---------------
+    # The cell-center frame Fc is the interior slice of the band frame Fb:
+    # every _fast_frame output is an elementwise function of the same
+    # coordinate-row values, so slicing is bitwise-identical to
+    # recomputing — and saves a full (n, n) metric evaluation per stage.
     b0, b1 = h0 - 1, h1 + 1
     Fb = _fast_frame(xr[:, b0:b1], yc[b0:b1], radius)
+    Fc = {k: v[-1:, 1:-1] if v.shape[-2] == 1 else
+             (v[1:-1, -1:] if v.shape[-1] == 1 else v[1:-1, 1:-1])
+          for k, v in Fb.items()}
+    inv_sg_d = Fc["inv_sqrtg"] * jnp.float32(1.0 / d)
+    dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
     uab = ua[b0:b1, b0:b1]
     ubb_ = ub[b0:b1, b0:b1]
     uca = Fb["inv_aa"] * uab + Fb["inv_ab"] * ubb_        # u^alpha, band
@@ -500,6 +510,137 @@ def make_cov_strip_router(grid):
     return route
 
 
+def make_cov_strip_router_linear(grid):
+    """Vectorized twin of :func:`make_cov_strip_router` — same output.
+
+    The loop router emits hundreds of strip-sized XLA ops per call (per
+    face/edge slices, flips, rotation multiplies, concats); at C384 that
+    op-dispatch overhead is ~36 us x 3 routes/step, a quarter of the whole
+    fused step.  But every router output row is a *linear* function of the
+    packed strip rows, so the whole thing collapses to a handful of
+    tensor-sized ops: one lane flip, one static row-gather (placement +
+    orientation, both row permutations), two elementwise multiply-adds
+    (the per-slot 2x2 covariant rotations), and a short vectorized
+    pair-average for the symmetrized edge normals.  Arithmetic per element
+    is kept in the loop router's operand order, so results are bitwise
+    identical (tested) and seam conservation is preserved by construction
+    (one sym value per physical edge, distributed by exact permutation).
+    """
+    import numpy as np
+
+    n, halo = grid.n, grid.halo
+    h = halo
+    R = 12 * h
+    i0, i1 = h, h + n
+    adj = build_connectivity()
+    EORDER = (EDGE_S, EDGE_N, EDGE_W, EDGE_E)
+    SLOT = {e: s for s, e in enumerate(EORDER)}
+    off = {EDGE_S: 0, EDGE_N: h, EDGE_W: 2 * h, EDGE_E: 3 * h}
+
+    # Rotation tables in *placed* layout, slot-ordered (4, 6, 4, halo, n):
+    # place() depth-flips the S and W ghost blocks, and commutes with the
+    # elementwise rotation, so flipping the canonical tables once here lets
+    # the routed strips be multiplied in placed layout directly.
+    Tc = np.asarray(_rotation_tables(grid))          # (4, 6, 4, h, n) by EDGE_*
+    Tp = np.stack([Tc[:, :, e] for e in EORDER], axis=2)
+    for s, e in enumerate(EORDER):
+        if e in (EDGE_S, EDGE_W):
+            Tp[:, :, s] = Tp[:, :, s, ::-1]
+    Tp = jnp.asarray(Tp)
+
+    # Row-gather index: output C row (fi, f, slot, k) <- packed strip row,
+    # offset by 6*R when the pair is lane-reversed (gathers from the
+    # flipped copy).  Folds place() (depth flip for S/W destinations) and
+    # canonicalization (depth flip for N/E sources) into the permutation.
+    idx = np.empty((3, 6, 4, h), np.int64)
+    for f in range(6):
+        for s, e in enumerate(EORDER):
+            link = adj[f][e]
+            for k in range(h):
+                kc = (h - 1 - k) if e in (EDGE_S, EDGE_W) else k
+                kr = ((h - 1 - kc)
+                      if link.nbr_edge in (EDGE_N, EDGE_E) else kc)
+                row = link.nbr_face * R + off[link.nbr_edge] + kr
+                for fi in range(3):
+                    src = row + fi * 4 * h
+                    idx[fi, f, s, k] = src + (6 * R if link.reversed_ else 0)
+    # 48 more rows: each face/edge's own interior boundary-adjacent row of
+    # (u_a, u_b) — raw canonical order, never reversed — for the edge
+    # normals.  Nearest-to-edge depth is h-1 for N/E blocks, 0 for S/W.
+    idx_int = np.empty((2, 6, 4), np.int64)
+    for f in range(6):
+        for s, e in enumerate(EORDER):
+            k = h - 1 if e in (EDGE_N, EDGE_E) else 0
+            for c in range(2):
+                idx_int[c, f, s] = f * R + (1 + c) * 4 * h + off[e] + k
+    idx_all = jnp.asarray(np.concatenate([idx.reshape(-1),
+                                          idx_int.reshape(-1)]))
+
+    # Edge-face inverse-metric rows per slot (face-independent on the
+    # equiangular grid), stacked (1, 4, n): (iab, ibb) for S/N rows,
+    # (iaa, iab) for W/E columns — covariant_face_normal_velocity's pairs.
+    met = {
+        EDGE_W: (grid.ginv_aa_xf[0, i0:i1, i0], grid.ginv_ab_xf[0, i0:i1, i0]),
+        EDGE_E: (grid.ginv_aa_xf[0, i0:i1, i1], grid.ginv_ab_xf[0, i0:i1, i1]),
+        EDGE_S: (grid.ginv_ab_yf[0, i0, i0:i1], grid.ginv_bb_yf[0, i0, i0:i1]),
+        EDGE_N: (grid.ginv_ab_yf[0, i1, i0:i1], grid.ginv_bb_yf[0, i1, i0:i1]),
+    }
+    M0 = jnp.stack([jnp.asarray(met[e][0]) for e in EORDER])[None]
+    M1 = jnp.stack([jnp.asarray(met[e][1]) for e in EORDER])[None]
+
+    # Pair combine tables over the 12 physical edges (L rows are (f*4+s)).
+    links = [lk for lk, _ in edge_pairs(adj)]
+    backs = [bk for _, bk in edge_pairs(adj)]
+    link_rows = jnp.asarray([lk.face * 4 + SLOT[lk.edge] for lk in links])
+    back_rows = jnp.asarray([bk.face * 4 + SLOT[bk.edge] for bk in backs])
+    rev = jnp.asarray([[lk.reversed_] for lk in links])
+    sga = jnp.asarray([[_OUT_SIGN[lk.edge]] for lk in links], jnp.float32)
+    sgb = jnp.asarray([[_OUT_SIGN[bk.edge]] for bk in backs], jnp.float32)
+    # Scatter (na rows 0..11, nb rows 12..23) back to (f*4+s) order.
+    sym_src = np.empty(24, np.int64)
+    for i, (lk, bk) in enumerate(zip(links, backs)):
+        sym_src[lk.face * 4 + SLOT[lk.edge]] = i
+        sym_src[bk.face * 4 + SLOT[bk.edge]] = 12 + i
+    sym_src = jnp.asarray(sym_src)
+
+    # Adjacent ghost row of each placed (h, n) block: S/W blocks are
+    # depth-flipped so the edge-adjacent row is h-1; N/E it is row 0.
+    adj_k = [h - 1, 0, h - 1, 0]
+
+    def route(strips):
+        s_flat = strips.reshape(6 * R, n)
+        s_all = jnp.concatenate([s_flat, jnp.flip(s_flat, -1)], axis=0)
+        rows = jnp.take(s_all, idx_all, axis=0)
+        C = rows[: 3 * 24 * h].reshape(3, 6, 4, h, n)
+        I_u = rows[3 * 24 * h :].reshape(2, 6, 4, n)
+
+        G_h = C[0]
+        G_ua = Tp[0] * C[1] + Tp[1] * C[2]
+        G_ub = Tp[2] * C[1] + Tp[3] * C[2]
+
+        gadj_a = jnp.stack([G_ua[:, s, adj_k[s]] for s in range(4)], axis=1)
+        gadj_b = jnp.stack([G_ub[:, s, adj_k[s]] for s in range(4)], axis=1)
+        ubar0 = 0.5 * (I_u[0] + gadj_a)
+        ubar1 = 0.5 * (I_u[1] + gadj_b)
+        L = (M0 * ubar0 + M1 * ubar1).reshape(24, n)
+
+        la = jnp.take(L, link_rows, axis=0)
+        lb = jnp.take(L, back_rows, axis=0)
+        lb = jnp.where(rev, jnp.flip(lb, -1), lb)
+        avg = 0.5 * (sga * la - sgb * lb)
+        na = sga * avg
+        nb = sgb * (-avg)
+        nb = jnp.where(rev, jnp.flip(nb, -1), nb)
+        sym = jnp.take(jnp.concatenate([na, nb], axis=0), sym_src,
+                       axis=0).reshape(6, 4, n)
+
+        return jnp.concatenate(
+            [G_h.reshape(6, 4 * h, n), G_ua.reshape(6, 4 * h, n),
+             G_ub.reshape(6, 4 * h, n), sym], axis=1)
+
+    return route
+
+
 def make_cov_stage_inkernel(
     n: int,
     halo: int,
@@ -676,7 +817,7 @@ def make_fused_ssprk3_cov_inkernel(
     from .swe_step import SSPRK3_COEFFS
 
     n, halo = grid.n, grid.halo
-    route = make_cov_strip_router(grid)
+    route = make_cov_strip_router_linear(grid)
     mk = lambda a, b: make_cov_stage_inkernel(
         n, halo, float(grid.dalpha), float(grid.radius), gravity, omega,
         dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
@@ -693,6 +834,394 @@ def make_fused_ssprk3_cov_inkernel(
         h2, u2, s2 = stage2(h0, u0, h1, u1, route(s1), b_ext)
         h3, u3, s3 = stage3(h0, u0, h2, u2, route(s2), b_ext)
         return {"h": h3, "u": u3, "strips": s3}
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Compact fused stage: interior-only state in HBM, split-orientation strips.
+#
+# Two layout changes over the in-kernel stepper above:
+#
+# 1. Interior-only carry.  Extended (M, M) fields, M = n + 2h, are (388,
+#    388) blocks at C384: the lane dimension pads to 4x128 = 512, so every
+#    field DMA moves ~32% dead lanes, and the kernel writes each face
+#    twice (full block + interior overwrite).  The carry holds only the
+#    (n, n) interiors — perfectly (8, 128)-tiled at production sizes —
+#    and the stage kernel assembles the extended field in VMEM scratch
+#    from the interior block and the routed ghosts.
+#
+# 2. Split-orientation strips.  The single packed strip tensor stores W/E
+#    strips transposed, so the kernel pays ~13 thin (h, n)<->(n, h)
+#    transposes per face per stage (measured ~7 us/stage at C384 — Mosaic
+#    lowers them to sublane/lane shuffle chains).  Instead the S/N strips
+#    and sym rows live in a row-major tensor and the W/E strips and sym
+#    cols in a column-major tensor; the kernel reads/writes both natively
+#    with zero transposes, and the router (already a handful of big XLA
+#    ops) absorbs the orientation change in its one static row-gather
+#    plus a single whole-tensor transpose each way.  The (6, n, 6h+2)
+#    column tensor DMAs with lane padding (6h+2 -> 128), ~1 MB extra per
+#    stage — noise next to the transpose savings.
+#
+# Arithmetic is unchanged: interiors are bitwise-identical to the
+# extended-carry stepper (tested).  Ghost corners in scratch are
+# uninitialized garbage; the dimension-split stencils never read them
+# (the only corner touches are produced-then-sliced-away bern band
+# cells, see rhs_core_cov).
+# ---------------------------------------------------------------------------
+
+
+def pack_strips_cov_split(h_int, u_int, n: int, halo: int):
+    """Boundary strips of interior fields, split by orientation.
+
+    Returns ``(strips_sn, strips_we)``: ``strips_sn`` is ``(6, 6h, n)``
+    holding, per field in (h, u_a, u_b), the raw S rows then N rows;
+    ``strips_we`` is ``(6, n, 6h)`` holding, per field, the raw W columns
+    then E columns.  Raw = interior values in storage order (row 0 / col 0
+    nearest the S/W edge; row h-1 / col h-1 nearest the N/E edge).
+    """
+    h = halo
+    fields = (h_int, u_int[0], u_int[1])
+    sn = jnp.concatenate(
+        [blk for q in fields for blk in (q[:, 0:h, :], q[:, n - h : n, :])],
+        axis=1)
+    we = jnp.concatenate(
+        [blk for q in fields for blk in (q[:, :, 0:h], q[:, :, n - h : n])],
+        axis=2)
+    return sn, we
+
+
+def make_cov_strip_router_split(grid):
+    """Linear router over the split-orientation strip layout.
+
+    ``route(strips_sn, strips_we) -> (ghosts_sn, ghosts_we)`` with
+    ``ghosts_sn`` ``(6, 6h+2, n)`` (placed S/N ghost blocks per field +
+    the two symmetrized S/N edge-normal rows) and ``ghosts_we``
+    ``(6, n, 6h+2)`` (placed W/E ghost columns + sym W/E columns).  Same
+    algebra as :func:`make_cov_strip_router_linear` (bitwise-identical
+    ghost/sym values); only the storage orientation differs, so the stage
+    kernel never transposes.
+    """
+    import numpy as np
+
+    n, halo = grid.n, grid.halo
+    h = halo
+    i0, i1 = halo, halo + n
+    adj = build_connectivity()
+    EORDER = (EDGE_S, EDGE_N, EDGE_W, EDGE_E)
+    SLOT = {e: s for s, e in enumerate(EORDER)}
+    F = 2 * 6 * 6 * h          # sn section + weT section row count
+
+    def src_row(fi: int, g: int, e: int, depth: int) -> int:
+        """Flat source row of face g / edge e / field fi at canonical
+        ``depth`` (0 = nearest the edge), in [sn ; weT] order."""
+        kr = depth if e in (EDGE_S, EDGE_W) else h - 1 - depth
+        sec = 0 if e in (EDGE_S, EDGE_N) else 6 * 6 * h
+        pair = 0 if e in (EDGE_S, EDGE_W) else h
+        return sec + g * 6 * h + fi * 2 * h + pair + kr
+
+    # Ghost-block gather: output (fi, f, epos, k) in placed layout.  The
+    # placed depth flip applies to S and W destinations (their edge-
+    # adjacent slot is the last row/col of the ghost block).
+    def ghost_idx(edges):
+        out = np.empty((3, 6, 2, h), np.int64)
+        for fi in range(3):
+            for f in range(6):
+                for p, e in enumerate(edges):
+                    link = adj[f][e]
+                    for k in range(h):
+                        dep = (h - 1 - k) if e in (EDGE_S, EDGE_W) else k
+                        r = src_row(fi, link.nbr_face, link.nbr_edge, dep)
+                        out[fi, f, p, k] = r + (F if link.reversed_ else 0)
+        return out
+
+    idx_sn = ghost_idx((EDGE_S, EDGE_N))
+    idx_we = ghost_idx((EDGE_W, EDGE_E))
+    # Interior boundary-adjacent rows of (u_a, u_b) for the edge normals.
+    idx_int = np.empty((2, 6, 4), np.int64)
+    for c in range(2):
+        for f in range(6):
+            for s, e in enumerate(EORDER):
+                idx_int[c, f, s] = src_row(1 + c, f, e, 0)
+    idx_all = jnp.asarray(np.concatenate(
+        [idx_sn.reshape(-1), idx_we.reshape(-1), idx_int.reshape(-1)]))
+    n_sn = idx_sn.size
+    n_we = idx_we.size
+
+    # Placed rotation tables, split by orientation: (4, 6, 2, h, n).
+    Tc = np.asarray(_rotation_tables(grid))
+    T_sn = jnp.asarray(np.stack(
+        [Tc[:, :, EDGE_S, ::-1], Tc[:, :, EDGE_N]], axis=2))
+    T_we = jnp.asarray(np.stack(
+        [Tc[:, :, EDGE_W, ::-1], Tc[:, :, EDGE_E]], axis=2))
+
+    met = {
+        EDGE_W: (grid.ginv_aa_xf[0, i0:i1, i0], grid.ginv_ab_xf[0, i0:i1, i0]),
+        EDGE_E: (grid.ginv_aa_xf[0, i0:i1, i1], grid.ginv_ab_xf[0, i0:i1, i1]),
+        EDGE_S: (grid.ginv_ab_yf[0, i0, i0:i1], grid.ginv_bb_yf[0, i0, i0:i1]),
+        EDGE_N: (grid.ginv_ab_yf[0, i1, i0:i1], grid.ginv_bb_yf[0, i1, i0:i1]),
+    }
+    M0 = jnp.stack([jnp.asarray(met[e][0]) for e in EORDER])[None]
+    M1 = jnp.stack([jnp.asarray(met[e][1]) for e in EORDER])[None]
+
+    links = [lk for lk, _ in edge_pairs(adj)]
+    backs = [bk for _, bk in edge_pairs(adj)]
+    link_rows = jnp.asarray([lk.face * 4 + SLOT[lk.edge] for lk in links])
+    back_rows = jnp.asarray([bk.face * 4 + SLOT[bk.edge] for bk in backs])
+    rev = jnp.asarray([[lk.reversed_] for lk in links])
+    sga = jnp.asarray([[_OUT_SIGN[lk.edge]] for lk in links], jnp.float32)
+    sgb = jnp.asarray([[_OUT_SIGN[bk.edge]] for bk in backs], jnp.float32)
+    sym_src = np.empty(24, np.int64)
+    for i, (lk, bk) in enumerate(zip(links, backs)):
+        sym_src[lk.face * 4 + SLOT[lk.edge]] = i
+        sym_src[bk.face * 4 + SLOT[bk.edge]] = 12 + i
+    sym_src = jnp.asarray(sym_src)
+    adj_k = [h - 1, 0]          # placed edge-adjacent row: S/W flip, N/E not
+
+    def route(strips_sn, strips_we):
+        s_src = jnp.concatenate(
+            [strips_sn.reshape(6 * 6 * h, n),
+             jnp.transpose(strips_we, (0, 2, 1)).reshape(6 * 6 * h, n)],
+            axis=0)
+        s_all = jnp.concatenate([s_src, jnp.flip(s_src, -1)], axis=0)
+        rows = jnp.take(s_all, idx_all, axis=0)
+        C_sn = rows[:n_sn].reshape(3, 6, 2, h, n)
+        C_we = rows[n_sn : n_sn + n_we].reshape(3, 6, 2, h, n)
+        I_u = rows[n_sn + n_we :].reshape(2, 6, 4, n)
+
+        G_sn = [C_sn[0],
+                T_sn[0] * C_sn[1] + T_sn[1] * C_sn[2],
+                T_sn[2] * C_sn[1] + T_sn[3] * C_sn[2]]
+        G_we = [C_we[0],
+                T_we[0] * C_we[1] + T_we[1] * C_we[2],
+                T_we[2] * C_we[1] + T_we[3] * C_we[2]]
+
+        gadj_a = jnp.stack(
+            [G_sn[1][:, 0, adj_k[0]], G_sn[1][:, 1, adj_k[1]],
+             G_we[1][:, 0, adj_k[0]], G_we[1][:, 1, adj_k[1]]], axis=1)
+        gadj_b = jnp.stack(
+            [G_sn[2][:, 0, adj_k[0]], G_sn[2][:, 1, adj_k[1]],
+             G_we[2][:, 0, adj_k[0]], G_we[2][:, 1, adj_k[1]]], axis=1)
+        ubar0 = 0.5 * (I_u[0] + gadj_a)
+        ubar1 = 0.5 * (I_u[1] + gadj_b)
+        L = (M0 * ubar0 + M1 * ubar1).reshape(24, n)
+
+        la = jnp.take(L, link_rows, axis=0)
+        lb = jnp.take(L, back_rows, axis=0)
+        lb = jnp.where(rev, jnp.flip(lb, -1), lb)
+        avg = 0.5 * (sga * la - sgb * lb)
+        na = sga * avg
+        nb = sgb * (-avg)
+        nb = jnp.where(rev, jnp.flip(nb, -1), nb)
+        sym = jnp.take(jnp.concatenate([na, nb], axis=0), sym_src,
+                       axis=0).reshape(6, 4, n)
+
+        gsn = jnp.concatenate(
+            [jnp.concatenate([g.reshape(6, 2 * h, n) for g in G_sn], axis=1),
+             sym[:, 0:2]], axis=1)
+        gwe_rows = jnp.concatenate(
+            [jnp.concatenate([g.reshape(6, 2 * h, n) for g in G_we], axis=1),
+             sym[:, 2:4]], axis=1)
+        return gsn, jnp.transpose(gwe_rows, (0, 2, 1))
+
+    return route
+
+
+def make_cov_stage_compact(
+    n: int,
+    halo: int,
+    dalpha: float,
+    radius: float,
+    gravity: float,
+    omega: float,
+    dt: float,
+    a: float,
+    b: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """One fused covariant RK stage over interior-only state.
+
+    ``a == 0``: ``stage(hc, uc, gsn, gwe, b_ext)``; else
+    ``stage(h0, u0, hc, uc, gsn, gwe, b_ext)``.  Prognostic fields are
+    interior ``(6, n, n)`` / ``(2, 6, n, n)``; ``b_ext`` stays extended
+    (static, needs its one-deep ring for the Bernoulli band); ``gsn`` /
+    ``gwe`` per :func:`make_cov_strip_router_split`.  Returns
+    ``(h, u, strips_sn, strips_we)``.  No transposes anywhere in the
+    kernel: every strip read/write is in its storage orientation.
+    """
+    import numpy as np
+
+    m = n + 2 * halo
+    i0, i1 = halo, halo + n
+    d = float(dalpha)
+    g_dt = b * dt
+    recon = pick_recon(scheme, halo, n, limiter)
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+    with_y0 = a != 0.0
+    h = halo
+
+    def fill_ghosts(scratch, int_val, gsn, gwe, fi):
+        scratch[i0:i1, i0:i1] = int_val
+        scratch[0:h, i0:i1] = gsn[fi * 2 * h : fi * 2 * h + h]
+        scratch[i1 : i1 + h, i0:i1] = gsn[fi * 2 * h + h : (fi + 1) * 2 * h]
+        scratch[i0:i1, 0:h] = gwe[:, fi * 2 * h : fi * 2 * h + h]
+        scratch[i0:i1, i1 : i1 + h] = gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h]
+        return scratch[:]
+
+    def kernel(*refs):
+        if with_y0:
+            (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+             h0_ref, u0_ref, hc_ref, uc_ref, gsn_ref, gwe_ref, b_ref,
+             ho_ref, uo_ref, ssn_ref, swe_ref, *scratch) = refs
+        else:
+            (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+             hc_ref, uc_ref, gsn_ref, gwe_ref, b_ref,
+             ho_ref, uo_ref, ssn_ref, swe_ref, *scratch) = refs
+
+        gsn = gsn_ref[0]
+        gwe = gwe_ref[0]
+        hf = fill_ghosts(scratch[0], hc_ref[0], gsn, gwe, 0)
+        ua = fill_ghosts(scratch[1], uc_ref[0, 0], gsn, gwe, 1)
+        ub = fill_ghosts(scratch[2], uc_ref[1, 0], gsn, gwe, 2)
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        ssn = gsn[6 * h : 6 * h + 2]
+        swe = gwe[:, 6 * h : 6 * h + 2]
+
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            hf, ua, ub, b_ref[0], ssn, swe,
+            n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
+
+        fa = jnp.float32(a)
+        fb = jnp.float32(b)
+        fg = jnp.float32(g_dt)
+
+        def emit(int_old, y0, tend, out_ref, fi, lead=()):
+            if with_y0:
+                int_new = (fa * y0 + fb * int_old) + fg * tend
+            elif b == 1.0:
+                int_new = int_old + fg * tend
+            else:
+                int_new = fb * int_old + fg * tend
+            out_ref[lead + (0,)] = int_new
+            ssn_ref[0, fi * 2 * h : fi * 2 * h + h] = int_new[0:h, :]
+            ssn_ref[0, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[n - h : n, :]
+            swe_ref[0, :, fi * 2 * h : fi * 2 * h + h] = int_new[:, 0:h]
+            swe_ref[0, :, fi * 2 * h + h : (fi + 1) * 2 * h] = (
+                int_new[:, n - h : n])
+
+        if with_y0:
+            emit(hc_ref[0], h0_ref[0], dh, ho_ref, 0)
+            emit(uc_ref[0, 0], u0_ref[0, 0], dua, uo_ref, 1, lead=(0,))
+            emit(uc_ref[1, 0], u0_ref[1, 0], dub, uo_ref, 2, lead=(1,))
+        else:
+            emit(hc_ref[0], None, dh, ho_ref, 0)
+            emit(uc_ref[0, 0], None, dua, uo_ref, 1, lead=(0,))
+            emit(uc_ref[1, 0], None, dub, uo_ref, 2, lead=(1,))
+
+    fz_spec = pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+                           memory_space=pltpu.SMEM)
+    coord_specs = [
+        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    hi_blk = pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                          memory_space=pltpu.VMEM)
+    ui_blk = pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
+                          memory_space=pltpu.VMEM)
+    be_blk = pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                          memory_space=pltpu.VMEM)
+    gsn_blk = pl.BlockSpec((1, 6 * h + 2, n), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    gwe_blk = pl.BlockSpec((1, n, 6 * h + 2), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    ssn_blk = pl.BlockSpec((1, 6 * h, n), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    swe_blk = pl.BlockSpec((1, n, 6 * h), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+
+    in_specs = [fz_spec] + coord_specs
+    if with_y0:
+        in_specs += [hi_blk, ui_blk]
+    in_specs += [hi_blk, ui_blk, gsn_blk, gwe_blk, be_blk]
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pl.GridSpec(
+            grid=(6,),
+            in_specs=in_specs,
+            out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
+            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                            for _ in range(3)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    if with_y0:
+        def stage(h0, u0, hc, uc, gsn, gwe, b_ext):
+            return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
+                              h0, u0, hc, uc, gsn, gwe, b_ext))
+    else:
+        def stage(hc, uc, gsn, gwe, b_ext):
+            return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
+                              hc, uc, gsn, gwe, b_ext))
+    return stage
+
+
+def make_fused_ssprk3_cov_compact(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    b_ext,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """``step(y, t) -> y`` over ``y = {h, u, strips_sn, strips_we}``.
+
+    The production stepper: three compact stage kernels (interior-only
+    fields, orientation-native strips) plus three linear strip routes.
+    Initialise the carry with :meth:`CovariantShallowWater.compact_state`.
+    """
+    from .swe_step import SSPRK3_COEFFS
+
+    route = make_cov_strip_router_split(grid)
+    mk = lambda a, b: make_cov_stage_compact(
+        grid.n, grid.halo, float(grid.dalpha), float(grid.radius), gravity,
+        omega, dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
+    )
+    (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+    stage1 = mk(a1, b1)
+    stage2 = mk(a2, b2)
+    stage3 = mk(a3, b3)
+
+    def step(y, t):
+        del t
+        h0, u0 = y["h"], y["u"]
+        gsn, gwe = route(y["strips_sn"], y["strips_we"])
+        h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
+        gsn, gwe = route(sn1, we1)
+        h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
+        gsn, gwe = route(sn2, we2)
+        h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
+        return {"h": h3, "u": u3, "strips_sn": sn3, "strips_we": we3}
 
     return step
 

@@ -23,6 +23,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import functools
 import math
 import time
 from typing import Any, Callable, Dict, Optional
@@ -96,14 +97,25 @@ class Simulation:
         # per compiled segment, so the strip carry stays on device between
         # I/O strides.  Sharded runs are handled by make_stepper_for.
         self._fused_step = None
+        self._fused_prep = None
         m = self.model
         if (self.setup is None and cfg.time.scheme == "ssprk3"
                 and getattr(m, "backend", "").startswith("pallas")
                 and getattr(m, "nu4", 0.0) == 0.0
                 and hasattr(m, "make_fused_step")):
             try:
-                self._fused_step = m.make_fused_step(cfg.time.dt)
-                log.info("using fused extended-state SSPRK3 stepper")
+                # The stepper and its carry-prep are a matched pair: pick
+                # both here so they cannot drift apart.
+                if hasattr(m, "compact_state"):
+                    self._fused_step = m.make_fused_step(cfg.time.dt)
+                    self._fused_prep = m.compact_state
+                    log.info("using compact fused SSPRK3 stepper "
+                             "(interior-only carry)")
+                else:
+                    self._fused_step = m.make_fused_step(cfg.time.dt)
+                    self._fused_prep = functools.partial(
+                        m.extend_state, with_strips=True)
+                    log.info("using fused extended-state SSPRK3 stepper")
             except Exception as e:
                 log.warning(
                     "fused stepper unavailable (%s: %s); falling back to "
@@ -188,10 +200,12 @@ class Simulation:
             if self._fused_step is not None:
                 m, fused = self.model, self._fused_step
 
+                prep = self._fused_prep
+
                 def fn(y, t, _k=k, _dt=dt):
-                    y_ext = m.extend_state(y, with_strips=True)
-                    y_ext, t = integrate(fused, y_ext, t, _k, _dt)
-                    return m.restrict_state(y_ext), t
+                    y_c = prep(y)
+                    y_c, t = integrate(fused, y_c, t, _k, _dt)
+                    return m.restrict_state(y_c), t
 
                 fn = jax.jit(fn)
             else:
