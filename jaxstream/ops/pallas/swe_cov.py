@@ -162,29 +162,38 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     two_omega = jnp.float32(2.0 * omega)
 
     # ---- continuity ------------------------------------------------------
+    # Flux-form velocities U = sqrtg u^perp directly via the folded metric
+    # (fg_*: sqrtg g^ij is cheaper than either factor, see _fast_frame) —
+    # the upwind flux then needs no separate sqrtg multiply.  Symmetrized
+    # seam normals are imposed as sqrtg_edge * sym: both panels multiply
+    # the identical sym strip by the identical edge sqrtg (the equiangular
+    # sqrtg is even in the along-edge coordinate), so cross-seam flux
+    # equality — hence exact mass conservation — is preserved.
     Fx = _fast_frame(xfr[:, h0:h1 + 1], yc[h0:h1], radius)
     uba = 0.5 * (ua[h0:h1, h0 - 1:h1] + ua[h0:h1, h0:h1 + 1])
     ubb = 0.5 * (ub[h0:h1, h0 - 1:h1] + ub[h0:h1, h0:h1 + 1])
-    ux = Fx["inv_aa"] * uba + Fx["inv_ab"] * ubb          # (n, n+1)
+    ux = Fx["fg_aa"] * uba + Fx["fg_ab"] * ubb      # sqrtg u^a, (n, n+1)
     if sym_we is not None:
+        sgW = _fast_frame(xfr[:, h0:h0 + 1], yc[h0:h1], radius)["sqrtg"]
+        sgE = _fast_frame(xfr[:, h1:h1 + 1], yc[h0:h1], radius)["sqrtg"]
         colx = jax.lax.broadcasted_iota(jnp.int32, (n, n + 1), 1)
-        ux = jnp.where(colx == 0, sym_we[:, 0:1], ux)
-        ux = jnp.where(colx == n, sym_we[:, 1:2], ux)
+        ux = jnp.where(colx == 0, sgW * sym_we[:, 0:1], ux)
+        ux = jnp.where(colx == n, sgE * sym_we[:, 1:2], ux)
     qL, qR = recon(hf[h0:h1, :], -1)
-    fx = Fx["sqrtg"] * (jnp.maximum(ux, 0.0) * qL
-                        + jnp.minimum(ux, 0.0) * qR)
+    fx = jnp.maximum(ux, 0.0) * qL + jnp.minimum(ux, 0.0) * qR
 
     Fy = _fast_frame(xr[:, h0:h1], yfc[h0:h1 + 1], radius)
     vba = 0.5 * (ua[h0 - 1:h1, h0:h1] + ua[h0:h1 + 1, h0:h1])
     vbb = 0.5 * (ub[h0 - 1:h1, h0:h1] + ub[h0:h1 + 1, h0:h1])
-    uy = Fy["inv_ab"] * vba + Fy["inv_bb"] * vbb          # (n+1, n)
+    uy = Fy["fg_ab"] * vba + Fy["fg_bb"] * vbb      # sqrtg u^b, (n+1, n)
     if sym_sn is not None:
+        sgS = _fast_frame(xr[:, h0:h1], yfc[h0:h0 + 1], radius)["sqrtg"]
+        sgN = _fast_frame(xr[:, h0:h1], yfc[h1:h1 + 1], radius)["sqrtg"]
         rowy = jax.lax.broadcasted_iota(jnp.int32, (n + 1, n), 0)
-        uy = jnp.where(rowy == 0, sym_sn[0:1, :], uy)
-        uy = jnp.where(rowy == n, sym_sn[1:2, :], uy)
+        uy = jnp.where(rowy == 0, sgS * sym_sn[0:1, :], uy)
+        uy = jnp.where(rowy == n, sgN * sym_sn[1:2, :], uy)
     qL, qR = recon(hf[:, h0:h1], -2)
-    fy = Fy["sqrtg"] * (jnp.maximum(uy, 0.0) * qL
-                        + jnp.minimum(uy, 0.0) * qR)
+    fy = jnp.maximum(uy, 0.0) * qL + jnp.minimum(uy, 0.0) * qR
 
     # ---- momentum (vector-invariant, covariant components) ---------------
     # The cell-center frame Fc is the interior slice of the band frame Fb:

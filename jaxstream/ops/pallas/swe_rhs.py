@@ -252,6 +252,14 @@ def _fast_frame(xr, yc, radius):
         "inv_ab": rho2 * ((xr * inv_R2dxda_r) * (yc * inv_dydb_c)),
         "sqrtg": (sg_row * dydb_c) * (inv_rho2 * inv_rho),
         "inv_sqrtg": ((one / sg_row) * inv_dydb_c) * (rho2 * rho2 * inv_rho),
+        # Flux-form (sqrtg-folded) inverse metric: the continuity flux
+        # needs sqrtg * g^ij, whose closed forms are *cheaper* than either
+        # factor — sqrtg g^aa = (1+Y^2)/rho, sqrtg g^bb = (1+X^2)/rho,
+        # sqrtg g^ab = X Y / rho.  (Unused entries are pruned at trace
+        # time, so the extra entries cost nothing where not consumed.)
+        "fg_aa": dydb_c * inv_rho,
+        "fg_bb": dxda_r * inv_rho,
+        "fg_ab": (xr * yc) * inv_rho,
     }
 
 
