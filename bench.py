@@ -54,7 +54,7 @@ def tc2_parity(n=48, hours=24.0):
     return float(err)
 
 
-def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=2000):
+def bench_tc5(n=384, dt=60.0, warm_steps=10, timed_steps=6000):
     import jax
     import jax.numpy as jnp
 

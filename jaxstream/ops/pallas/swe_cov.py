@@ -218,11 +218,13 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
 
     dub_da = (ub[h0:h1, h0 + 1:h1 + 1] - ub[h0:h1, h0 - 1:h1 - 1]) * inv2d
     dua_db = (ua[h0 + 1:h1 + 1, h0:h1] - ua[h0 - 1:h1 - 1, h0:h1]) * inv2d
-    zeta = (dub_da - dua_db) * Fc["inv_sqrtg"]
 
-    # Coriolis: f = 2 Omega rhat_z, rhat_z = (c0z + X cxz + Y cyz)/rho.
+    # (zeta + f) sqrtg expanded: zeta sqrtg is just the covariant curl
+    # (zeta = curl / sqrtg), so only the Coriolis part needs the metric —
+    # two fewer full-field multiplies and no inv_sqrtg/sqrtg pair.
+    # f = 2 Omega rhat_z, rhat_z = (c0z + X cxz + Y cyz)/rho.
     rz = (fz[0] + Fc["x"] * fz[1] + Fc["y"] * fz[2]) * Fc["inv_rho"]
-    absv = (zeta + two_omega * rz) * Fc["sqrtg"]
+    absv = (dub_da - dua_db) + (two_omega * rz) * Fc["sqrtg"]
 
     dua = absv * ucb[1:-1, 1:-1] - dba
     dub = -absv * uca[1:-1, 1:-1] - dbb
