@@ -50,6 +50,10 @@ __all__ = ["CovariantShallowWater"]
 class CovariantShallowWater(SWEBase):
     """State ``{"h": (6, n, n), "u": (2, 6, n, n)}``, u covariant."""
 
+    #: make_fused_step handles nu4 > 0 (two-kernel del^4 stage pair);
+    #: Simulation's fused-path gate reads this capability flag.
+    fused_supports_nu4 = True
+
     def __init__(
         self,
         grid: CubedSphereGrid,
@@ -118,12 +122,22 @@ class CovariantShallowWater(SWEBase):
         :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
         production path) carries interior-only fields — initialise with
         :meth:`compact_state`; ``compact=False`` keeps the extended-state
-        carry from :meth:`extend_state` ``(with_strips=True)``.  Requires
-        ``backend='pallas'`` and ``nu4 == 0``."""
+        carry from :meth:`extend_state` ``(with_strips=True)``.
+        ``nu4 > 0`` (the Galewsky filter) uses the two-kernel del^4
+        stage pair, compact carry only.  Requires ``backend='pallas'``."""
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
+        interpret = self.backend == "pallas_interpret"
         if self.nu4 != 0.0:
-            raise ValueError("make_fused_step does not support nu4 > 0")
+            if not compact:
+                raise ValueError("nu4 > 0 requires the compact carry")
+            from ..ops.pallas.swe_cov import make_fused_ssprk3_cov_nu4
+
+            return make_fused_ssprk3_cov_nu4(
+                self.grid, self.gravity, self.omega, dt, self.b_ext,
+                self.nu4, scheme=self.scheme, limiter=self.limiter,
+                interpret=interpret,
+            )
         from ..ops.pallas.swe_cov import (
             make_fused_ssprk3_cov_compact, make_fused_ssprk3_cov_inkernel)
 
@@ -132,7 +146,7 @@ class CovariantShallowWater(SWEBase):
         return mk(
             self.grid, self.gravity, self.omega, dt, self.b_ext,
             scheme=self.scheme, limiter=self.limiter,
-            interpret=(self.backend == "pallas_interpret"),
+            interpret=interpret,
         )
 
     def initial_state(self, h_ext, v_ext) -> State:

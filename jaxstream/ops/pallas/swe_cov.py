@@ -53,6 +53,9 @@ __all__ = [
     "make_fused_ssprk3_cov_inkernel",
     "make_cov_stage_compact",
     "make_fused_ssprk3_cov_compact",
+    "lap_core",
+    "make_cov_stage_nu4",
+    "make_fused_ssprk3_cov_nu4",
     "make_cov_stage_nbr",
     "make_fused_ssprk3_cov_nbr",
 ]
@@ -1038,6 +1041,72 @@ def make_cov_strip_router_split(grid):
     return route
 
 
+def _cov_blockspecs(n, halo):
+    """The shared BlockSpec set of the compact-carry stage kernels."""
+    m = n + 2 * halo
+    h = halo
+    fz_spec = pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+                           memory_space=pltpu.SMEM)
+    coord_specs = [
+        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    hi_blk = pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                          memory_space=pltpu.VMEM)
+    ui_blk = pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
+                          memory_space=pltpu.VMEM)
+    be_blk = pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                          memory_space=pltpu.VMEM)
+    gsn_blk = pl.BlockSpec((1, 6 * h + 2, n), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    gwe_blk = pl.BlockSpec((1, n, 6 * h + 2), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    ssn_blk = pl.BlockSpec((1, 6 * h, n), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    swe_blk = pl.BlockSpec((1, n, 6 * h), lambda f: (f, 0, 0),
+                           memory_space=pltpu.VMEM)
+    return fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk, \
+        ssn_blk, swe_blk
+
+
+def _make_fill(n, halo, i0, i1, corners: bool = False):
+    """Shared in-kernel ghost fill / strip emit over the split layout."""
+    h = halo
+
+    def fill_ghosts(scratch, int_val, gsn, gwe, fi):
+        scratch[i0:i1, i0:i1] = int_val
+        scratch[0:h, i0:i1] = gsn[fi * 2 * h : fi * 2 * h + h]
+        scratch[i1 : i1 + h, i0:i1] = gsn[fi * 2 * h + h : (fi + 1) * 2 * h]
+        scratch[i0:i1, 0:h] = gwe[:, fi * 2 * h : fi * 2 * h + h]
+        scratch[i0:i1, i1 : i1 + h] = gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h]
+        if corners:
+            # The Laplacian's cross-derivative faces read the h x h ghost
+            # corners (unlike the dimension-split advective stencils).
+            # Same edge-ghost averaging as parallel.halo._fill_corners —
+            # purely face-local, no extra communication.
+            half = jnp.float32(0.5)
+            scratch[0:h, 0:h] = half * (scratch[0:h, i0 : i0 + 1]
+                                        + scratch[i0 : i0 + 1, 0:h])
+            scratch[0:h, i1 : i1 + h] = half * (
+                scratch[0:h, i1 - 1 : i1] + scratch[i0 : i0 + 1, i1 : i1 + h])
+            scratch[i1 : i1 + h, 0:h] = half * (
+                scratch[i1 : i1 + h, i0 : i0 + 1] + scratch[i1 - 1 : i1, 0:h])
+            scratch[i1 : i1 + h, i1 : i1 + h] = half * (
+                scratch[i1 : i1 + h, i1 - 1 : i1]
+                + scratch[i1 - 1 : i1, i1 : i1 + h])
+        return scratch[:]
+
+    def emit_strips(ssn_ref, swe_ref, int_new, fi):
+        ssn_ref[0, fi * 2 * h : fi * 2 * h + h] = int_new[0:h, :]
+        ssn_ref[0, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[n - h : n, :]
+        swe_ref[0, :, fi * 2 * h : fi * 2 * h + h] = int_new[:, 0:h]
+        swe_ref[0, :, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[:, n - h : n]
+
+    return fill_ghosts, emit_strips
+
+
 def make_cov_stage_compact(
     n: int,
     halo: int,
@@ -1073,14 +1142,7 @@ def make_cov_stage_compact(
     frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
     with_y0 = a != 0.0
     h = halo
-
-    def fill_ghosts(scratch, int_val, gsn, gwe, fi):
-        scratch[i0:i1, i0:i1] = int_val
-        scratch[0:h, i0:i1] = gsn[fi * 2 * h : fi * 2 * h + h]
-        scratch[i1 : i1 + h, i0:i1] = gsn[fi * 2 * h + h : (fi + 1) * 2 * h]
-        scratch[i0:i1, 0:h] = gwe[:, fi * 2 * h : fi * 2 * h + h]
-        scratch[i0:i1, i1 : i1 + h] = gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h]
-        return scratch[:]
+    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1)
 
     def kernel(*refs):
         if with_y0:
@@ -1120,11 +1182,7 @@ def make_cov_stage_compact(
             else:
                 int_new = fb * int_old + fg * tend
             out_ref[lead + (0,)] = int_new
-            ssn_ref[0, fi * 2 * h : fi * 2 * h + h] = int_new[0:h, :]
-            ssn_ref[0, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[n - h : n, :]
-            swe_ref[0, :, fi * 2 * h : fi * 2 * h + h] = int_new[:, 0:h]
-            swe_ref[0, :, fi * 2 * h + h : (fi + 1) * 2 * h] = (
-                int_new[:, n - h : n])
+            emit_strips(ssn_ref, swe_ref, int_new, fi)
 
         if with_y0:
             emit(hc_ref[0], h0_ref[0], dh, ho_ref, 0)
@@ -1135,28 +1193,8 @@ def make_cov_stage_compact(
             emit(uc_ref[0, 0], None, dua, uo_ref, 1, lead=(0,))
             emit(uc_ref[1, 0], None, dub, uo_ref, 2, lead=(1,))
 
-    fz_spec = pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
-                           memory_space=pltpu.SMEM)
-    coord_specs = [
-        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
-    ]
-    hi_blk = pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
-                          memory_space=pltpu.VMEM)
-    ui_blk = pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
-                          memory_space=pltpu.VMEM)
-    be_blk = pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
-                          memory_space=pltpu.VMEM)
-    gsn_blk = pl.BlockSpec((1, 6 * h + 2, n), lambda f: (f, 0, 0),
-                           memory_space=pltpu.VMEM)
-    gwe_blk = pl.BlockSpec((1, n, 6 * h + 2), lambda f: (f, 0, 0),
-                           memory_space=pltpu.VMEM)
-    ssn_blk = pl.BlockSpec((1, 6 * h, n), lambda f: (f, 0, 0),
-                           memory_space=pltpu.VMEM)
-    swe_blk = pl.BlockSpec((1, n, 6 * h), lambda f: (f, 0, 0),
-                           memory_space=pltpu.VMEM)
+    (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
+     ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
 
     in_specs = [fz_spec] + coord_specs
     if with_y0:
@@ -1233,6 +1271,285 @@ def make_fused_ssprk3_cov_compact(
         gsn, gwe = route(sn2, we2)
         h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
         return {"h": h3, "u": u3, "strips_sn": sn3, "strips_we": we3}
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Fused hyperdiffusion (del^4) stepper: two kernels + two routes per stage.
+#
+# The Galewsky jet — the flagship validation case — needs a del^4 filter
+# (nu4 > 0), which the single-kernel stages above cannot provide: del^4
+# is two chained Laplacians with a ghost refill between them (the
+# second Laplacian reads the FIRST one's halo, which lives on the
+# neighbor panel).  Rather than widening halos (the 2-ring band near
+# cube corners would need corner ghosts, which the cubed sphere does
+# not have), each RK stage runs the existing strip machinery twice:
+#
+#   route(state strips) -> kernel A: fill state ghosts, advective RHS,
+#       partial stage combine y_adv = (a y0 + b yc) + b dt L_adv, and
+#       l1 = lap(h), lap(u_a), lap(u_b); emits l1 boundary strips
+#   route(l1 strips)    -> kernel B: fill l1 ghosts (the same rotation
+#       tables apply — lap of covariant components IS a covariant pair),
+#       l2 = lap(l1), y_new = y_adv - b dt nu4 l2; emits state strips
+#
+# This reproduces the classic path's fill(lap(fill(lap)))) structure
+# (jaxstream/models/shallow_water_cov.py rhs, nu4 branch) with closed-
+# form in-kernel metrics; agreement is op-reordering roundoff (tested).
+# ---------------------------------------------------------------------------
+
+
+def lap_core(xr, xfr, yc, yfc, psi, *, n, halo, d, radius):
+    """Laplace-Beltrami of one ghost-filled (M, M) face -> (n, n).
+
+    The kernel-math twin of :func:`jaxstream.ops.fv.laplacian` (same
+    conservative flux form and stencils, cross-shaped and corner-free),
+    with face metrics from the sqrtg-folded closed forms.
+    """
+    h0, h1 = halo, halo + n
+    invd = jnp.float32(1.0 / d)
+    inv2d = jnp.float32(0.5 / d)
+
+    pr = psi[h0:h1, :]
+    dpa = (pr[:, h0:h1 + 1] - pr[:, h0 - 1:h1]) * invd
+    dpb_c = (psi[h0 + 1:h1 + 1, :] - psi[h0 - 1:h1 - 1, :]) * inv2d
+    dpb_f = 0.5 * (dpb_c[:, h0 - 1:h1] + dpb_c[:, h0:h1 + 1])
+    Fx = _fast_frame(xfr[:, h0:h1 + 1], yc[h0:h1], radius)
+    fx = Fx["fg_aa"] * dpa + Fx["fg_ab"] * dpb_f
+
+    pc = psi[:, h0:h1]
+    dpb = (pc[h0:h1 + 1, :] - pc[h0 - 1:h1, :]) * invd
+    dpa_c = (psi[:, h0 + 1:h1 + 1] - psi[:, h0 - 1:h1 - 1]) * inv2d
+    dpa_f = 0.5 * (dpa_c[h0 - 1:h1, :] + dpa_c[h0:h1 + 1, :])
+    Fy = _fast_frame(xr[:, h0:h1], yfc[h0:h1 + 1], radius)
+    fy = Fy["fg_bb"] * dpb + Fy["fg_ab"] * dpa_f
+
+    Fc = _fast_frame(xr[:, h0:h1], yc[h0:h1], radius)
+    return ((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * (
+        Fc["inv_sqrtg"] * invd)
+
+
+def make_cov_stage_nu4(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    a: float,
+    b: float,
+    nu4: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """One covariant RK stage with del^4 filtering, as a kernel pair.
+
+    Returns ``(stage_a, stage_b)``:
+      * ``stage_a(y0h, y0u, hc, uc, gsn, gwe, b_ext) -> (h_adv, u_adv,
+        l1h, l1u, sn_l1, we_l1)`` (``y0*`` omitted when ``a == 0``),
+      * ``stage_b(h_adv, u_adv, l1h, l1u, gsn, gwe) -> (h, u, sn, we)``.
+    """
+    import numpy as np
+
+    n, halo = grid.n, grid.halo
+    m = n + 2 * halo
+    i0, i1 = halo, halo + n
+    d = float(grid.dalpha)
+    radius = float(grid.radius)
+    g_dt = b * dt
+    recon = pick_recon(scheme, halo, n, limiter)
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+    with_y0 = a != 0.0
+    h = halo
+    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1, corners=True)
+    (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
+     ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
+
+    lap = lambda xr, xfr, ycol, yfcol, psi: lap_core(
+        xr, xfr, ycol, yfcol, psi, n=n, halo=halo, d=d, radius=radius)
+
+    def kernel_a(*refs):
+        if with_y0:
+            (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+             h0_ref, u0_ref, hc_ref, uc_ref, gsn_ref, gwe_ref, b_ref,
+             ha_ref, ua_ref, l1h_ref, l1u_ref, ssn_ref, swe_ref,
+             *scratch) = refs
+        else:
+            (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+             hc_ref, uc_ref, gsn_ref, gwe_ref, b_ref,
+             ha_ref, ua_ref, l1h_ref, l1u_ref, ssn_ref, swe_ref,
+             *scratch) = refs
+
+        gsn = gsn_ref[0]
+        gwe = gwe_ref[0]
+        hf = fill_ghosts(scratch[0], hc_ref[0], gsn, gwe, 0)
+        ua = fill_ghosts(scratch[1], uc_ref[0, 0], gsn, gwe, 1)
+        ub = fill_ghosts(scratch[2], uc_ref[1, 0], gsn, gwe, 2)
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        ssn = gsn[6 * h : 6 * h + 2]
+        swe = gwe[:, 6 * h : 6 * h + 2]
+
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            hf, ua, ub, b_ref[0], ssn, swe,
+            n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
+
+        fa = jnp.float32(a)
+        fb = jnp.float32(b)
+        fg = jnp.float32(g_dt)
+
+        def combine(int_old, y0, tend):
+            if with_y0:
+                return (fa * y0 + fb * int_old) + fg * tend
+            if b == 1.0:
+                return int_old + fg * tend
+            return fb * int_old + fg * tend
+
+        if with_y0:
+            ha_ref[0] = combine(hc_ref[0], h0_ref[0], dh)
+            ua_ref[0, 0] = combine(uc_ref[0, 0], u0_ref[0, 0], dua)
+            ua_ref[1, 0] = combine(uc_ref[1, 0], u0_ref[1, 0], dub)
+        else:
+            ha_ref[0] = combine(hc_ref[0], None, dh)
+            ua_ref[0, 0] = combine(uc_ref[0, 0], None, dua)
+            ua_ref[1, 0] = combine(uc_ref[1, 0], None, dub)
+
+        for fi, (psi, ref, lead) in enumerate(
+                ((hf, l1h_ref, ()), (ua, l1u_ref, (0,)), (ub, l1u_ref, (1,)))):
+            l1 = lap(xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:], psi)
+            ref[lead + (0,)] = l1
+            emit_strips(ssn_ref, swe_ref, l1, fi)
+
+    def kernel_b(*refs):
+        (xr_ref, xfr_ref, yc_ref, yfc_ref,
+         ha_ref, ua_ref, l1h_ref, l1u_ref, gsn_ref, gwe_ref,
+         ho_ref, uo_ref, ssn_ref, swe_ref, *scratch) = refs
+
+        gsn = gsn_ref[0]
+        gwe = gwe_ref[0]
+        damp = jnp.float32(g_dt * nu4)
+        for fi, (int_ref, lead, adv_ref, out_ref) in enumerate(
+                ((l1h_ref, (), ha_ref, ho_ref),
+                 (l1u_ref, (0,), ua_ref, uo_ref),
+                 (l1u_ref, (1,), ua_ref, uo_ref))):
+            l1f = fill_ghosts(scratch[fi], int_ref[lead + (0,)], gsn, gwe, fi)
+            l2 = lap(xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:], l1f)
+            int_new = adv_ref[lead + (0,)] - damp * l2
+            out_ref[lead + (0,)] = int_new
+            emit_strips(ssn_ref, swe_ref, int_new, fi)
+
+    in_a = [fz_spec] + coord_specs
+    if with_y0:
+        in_a += [hi_blk, ui_blk]
+    in_a += [hi_blk, ui_blk, gsn_blk, gwe_blk, be_blk]
+    call_a = pl.pallas_call(
+        kernel_a,
+        grid_spec=pl.GridSpec(
+            grid=(6,),
+            in_specs=in_a,
+            out_specs=[hi_blk, ui_blk, hi_blk, ui_blk, ssn_blk, swe_blk],
+            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                            for _ in range(3)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    call_b = pl.pallas_call(
+        kernel_b,
+        grid_spec=pl.GridSpec(
+            grid=(6,),
+            in_specs=coord_specs + [hi_blk, ui_blk, hi_blk, ui_blk,
+                                    gsn_blk, gwe_blk],
+            out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
+            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                            for _ in range(3)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    if with_y0:
+        def stage_a(h0, u0, hc, uc, gsn, gwe, b_ext):
+            return tuple(call_a(frames_z, x_row, xf_row, x_col, xf_col,
+                                h0, u0, hc, uc, gsn, gwe, b_ext))
+    else:
+        def stage_a(hc, uc, gsn, gwe, b_ext):
+            return tuple(call_a(frames_z, x_row, xf_row, x_col, xf_col,
+                                hc, uc, gsn, gwe, b_ext))
+
+    def stage_b(h_adv, u_adv, l1h, l1u, gsn, gwe):
+        return tuple(call_b(x_row, xf_row, x_col, xf_col,
+                            h_adv, u_adv, l1h, l1u, gsn, gwe))
+
+    return stage_a, stage_b
+
+
+def make_fused_ssprk3_cov_nu4(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    b_ext,
+    nu4: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """``step(y, t) -> y`` with del^4 filtering, over the compact carry.
+
+    Six kernels + six routes per step (two per RK stage); same carry and
+    router as :func:`make_fused_ssprk3_cov_compact`.
+    """
+    from .swe_step import SSPRK3_COEFFS
+
+    route = make_cov_strip_router_split(grid)
+    mk = lambda a, b: make_cov_stage_nu4(
+        grid, gravity, omega, dt, a, b, nu4,
+        scheme=scheme, limiter=limiter, interpret=interpret,
+    )
+    (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+    s1a, s1b = mk(a1, b1)
+    s2a, s2b = mk(a2, b2)
+    s3a, s3b = mk(a3, b3)
+
+    def half_stage(sa, sb, args):
+        ha, uadv, l1h, l1u, sn1, we1 = sa(*args)
+        gsn, gwe = route(sn1, we1)
+        return sb(ha, uadv, l1h, l1u, gsn, gwe)
+
+    def step(y, t):
+        del t
+        h0, u0 = y["h"], y["u"]
+        gsn, gwe = route(y["strips_sn"], y["strips_we"])
+        h1, u1, sn, we = half_stage(s1a, s1b, (h0, u0, gsn, gwe, b_ext))
+        gsn, gwe = route(sn, we)
+        h2, u2, sn, we = half_stage(
+            s2a, s2b, (h0, u0, h1, u1, gsn, gwe, b_ext))
+        gsn, gwe = route(sn, we)
+        h3, u3, sn, we = half_stage(
+            s3a, s3b, (h0, u0, h2, u2, gsn, gwe, b_ext))
+        return {"h": h3, "u": u3, "strips_sn": sn, "strips_we": we}
 
     return step
 

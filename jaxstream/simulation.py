@@ -99,9 +99,12 @@ class Simulation:
         self._fused_step = None
         self._fused_prep = None
         m = self.model
+        # nu4 > 0 is fused only where the model declares support (the
+        # covariant model's two-kernel del^4 stage pair).
         if (self.setup is None and cfg.time.scheme == "ssprk3"
                 and getattr(m, "backend", "").startswith("pallas")
-                and getattr(m, "nu4", 0.0) == 0.0
+                and (getattr(m, "nu4", 0.0) == 0.0
+                     or getattr(m, "fused_supports_nu4", False))
                 and hasattr(m, "make_fused_step")):
             try:
                 # The stepper and its carry-prep are a matched pair: pick
