@@ -1,0 +1,90 @@
+"""Worker for test_multihost_mp: one process of a 2-process CPU pod.
+
+Runs the covariant SWE model one SSPRK3 step, sharded panel-wise over
+the global (panel, y, x) mesh with XLA collectives between processes
+(Gloo on CPU — the DCN stand-in), then checks this process's shards
+against a full single-device reference computed locally.  Prints
+``MH_WORKER_OK <proc_id>`` on success.
+
+Invoked as: python mh_worker.py <proc_id> <nproc> <port>
+"""
+
+import os
+import sys
+
+proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from jaxstream.parallel import multihost  # noqa: E402
+
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=nproc, process_id=proc_id)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from jaxstream.config import (  # noqa: E402
+    EARTH_GRAVITY,
+    EARTH_OMEGA,
+    EARTH_RADIUS,
+)
+from jaxstream.geometry.cubed_sphere import build_grid  # noqa: E402
+from jaxstream.models.shallow_water_cov import (  # noqa: E402
+    CovariantShallowWater,
+)
+from jaxstream.physics.initial_conditions import williamson_tc5  # noqa: E402
+
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == 3 * nproc
+
+n, dt = 16, 600.0
+grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA,
+                              b_ext=b_ext)
+state0 = model.initial_state(h_ext, v_ext)
+
+# Single-device reference, computed fully in this process.
+ref = model.make_step(dt, "ssprk3")(state0, jnp.float32(0.0))
+
+# Global mesh: 6 panels over 6 devices across the 2 processes (the
+# halo-exchange axis spans processes -> every cube-edge exchange is an
+# inter-process collective).
+mesh = multihost.pod_mesh(panel=6)
+spec_h = P("panel")
+spec_u = P(None, "panel")
+
+
+def shard_global(x, spec):
+    sh = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(x.shape, sh,
+                                        lambda idx: np.asarray(x)[idx])
+
+
+state = {"h": shard_global(state0["h"], spec_h),
+         "u": shard_global(state0["u"], spec_u)}
+step = jax.jit(model.make_step(dt, "ssprk3"),
+               out_shardings={"h": NamedSharding(mesh, spec_h),
+                              "u": NamedSharding(mesh, spec_u)})
+out = step(state, jnp.float32(0.0))
+jax.block_until_ready(out)
+
+# Each process validates the shards it can address.
+for key, spec in (("h", spec_h), ("u", spec_u)):
+    full = np.asarray(ref[key], dtype=np.float64)
+    for shard in out[key].addressable_shards:
+        got = np.asarray(shard.data, dtype=np.float64)
+        want = full[shard.index]
+        np.testing.assert_allclose(
+            got, want, rtol=0, atol=1e-5 * np.max(np.abs(full)),
+            err_msg=f"{key} shard {shard.index}")
+
+print(f"MH_WORKER_OK {proc_id}", flush=True)
