@@ -275,12 +275,41 @@ def make_sharded_cov_stepper(model, setup, dt: float):
         pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
         return jnp.pad(x, pad)
 
+    nu4 = float(getattr(model, "nu4", 0.0))
+    if nu4 != 0.0:
+        from ..ops.pallas.swe_cov import lap_core
+        from ..ops.pallas.swe_rhs import coord_rows
+        from .halo import _fill_corners
+
+        x_row, xf_row, x_col, xf_col, _ = coord_rows(grid.n, halo)
+        lap1 = functools.partial(
+            lap_core, x_row, xf_row, x_col, xf_col,
+            n=grid.n, halo=halo, d=float(grid.dalpha),
+            radius=float(grid.radius))
+
     def body(state, tabs, fz, b_loc):
         def f(h_int, u_int):
             h_e = embed(h_int)
             u_e = embed(u_int)
             h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
             dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
+            if nu4 != 0.0:
+                # del^4 = lap(lap(.)) with an exchanged refill between,
+                # exactly the fused nu4 stepper's structure: the same
+                # strip exchange applies (lap of a covariant pair is a
+                # covariant pair), and the Laplace-Beltrami cross-terms
+                # need the ghost corners (face-local averaging).
+                def lap3(he, ue):
+                    he = _fill_corners(he, halo, grid.n)
+                    ue = _fill_corners(ue, halo, grid.n)
+                    return (lap1(he[0])[None],
+                            jnp.stack([lap1(ue[0, 0])[None],
+                                       lap1(ue[1, 0])[None]]))
+                l1h, l1u = lap3(h_e, u_e)
+                l1h_e, l1u_e, _, _ = exchange(embed(l1h), embed(l1u), tabs)
+                l2h, l2u = lap3(l1h_e, l1u_e)
+                dh = dh - nu4 * l2h
+                du = du - nu4 * l2u
             return dh, du
 
         h0, u0 = state["h"], state["u"]

@@ -52,13 +52,6 @@ def make_stepper_for(model, setup, example_state, dt: float,
                     "the explicit covariant shard path implements ssprk3 "
                     f"only; got scheme={scheme!r}"
                 )
-            if getattr(model, "nu4", 0.0) != 0.0:
-                raise ValueError(
-                    "the explicit covariant shard path does not apply "
-                    "hyperdiffusion (nu4 > 0); set "
-                    "parallelization.use_shard_map: false (GSPMD) or "
-                    "physics.hyperdiffusion: 0"
-                )
             return make_sharded_cov_stepper(model, setup, dt)
         return make_sharded_stepper(model, setup, example_state, dt, scheme)
     return jax.jit(model.make_step(dt, scheme))
