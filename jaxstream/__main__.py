@@ -66,6 +66,12 @@ def _cmd_schedule(args):
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    # ``python -m jaxstream config.yaml`` == ``... run config.yaml``.
+    if argv and argv[0] not in ("run", "info", "schedule", "-h", "--help"):
+        argv = ["run"] + list(argv)
+
     p = argparse.ArgumentParser(prog="jaxstream")
     sub = p.add_subparsers(dest="cmd", required=True)
 
