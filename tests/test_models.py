@@ -148,3 +148,41 @@ def test_sharded_matches_single_device():
         # divergence per step (measured ~1e-7 absolute after one step).
         scale = np.abs(a).max() + 1.0
         np.testing.assert_allclose(a / scale, b / scale, rtol=0, atol=1e-5)
+
+
+def test_swe_tc6_wave_propagates_eastward():
+    """TC6 Rossby-Haurwitz: the wavenumber-4 height pattern must stay
+    intact over 3 days and drift eastward at roughly the linear RH phase
+    speed nu = (R(3+R)w - 2 Omega) / ((1+R)(2+R)) (~12.2 deg/day for the
+    standard parameters; SWE dynamics deviate by O(10%))."""
+    from jaxstream.viz.plots import to_latlon
+
+    n = 32
+    g = build_grid(n, halo=2, radius=A, dtype=jnp.float64)
+    h0e, v0e = williamson_tc6(g, G, OM)
+    model = ShallowWater(g, G, OM)
+    s0 = model.initial_state(h0e, v0e)
+    days = 3.0
+    s, _ = model.run(s0, int(days * 86400 / 600), 600.0)
+    h1 = np.asarray(s["h"])
+    assert np.isfinite(h1).all()
+
+    def m4_phase_amp(h_int):
+        ll = np.asarray(to_latlon(jnp.asarray(h_int), nlat=91, nlon=180))
+        row = ll[int(round((45 + 90) / 2)), :]          # ~45N circle
+        row = np.nan_to_num(row, nan=float(np.nanmean(row)))
+        c4 = np.fft.rfft(row - row.mean())[4]
+        return np.angle(c4), np.abs(c4)
+
+    p0, a0 = m4_phase_amp(np.asarray(s0["h"]))
+    p1, a1 = m4_phase_amp(h1)
+    # Shape preserved: wave-4 amplitude within 20%.
+    assert 0.8 * a0 < a1 < 1.2 * a0, (a0, a1)
+    # Eastward drift: the m=4 Fourier phase decreases by m*dlon for an
+    # eastward shift dlon; unwrap to the nearest branch.
+    w_w = 7.848e-6
+    nu = (4 * (3 + 4) * w_w - 2 * OM) / ((1 + 4) * (2 + 4))   # rad/s
+    expect = 4 * np.degrees(nu * days * 86400.0)              # m*shift, deg
+    drift = -np.degrees(p1 - p0)
+    drift = (drift - expect + 180.0) % 360.0 - 180.0 + expect
+    assert expect * 0.6 < drift < expect * 1.4, (drift, expect)
