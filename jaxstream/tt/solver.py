@@ -175,12 +175,19 @@ def _round_factored(A, B, r: int):
     back as one (n, R) @ (R, r) matmul per side.  Same O(n R^2) flops as
     QR, but all of it is *matmul* — the MXU/BLAS-native shape (tall
     XLA QRs measured ~4x slower than the equivalent Gram matmuls on
-    CPU, and matmul is the TPU-native path).  Rank-deficient directions
-    are floored at eps * max-eigenvalue: they carry ~zero energy and are
-    discarded by the top-r slice, so the floor never pollutes retained
-    directions (Gram squares the condition number — with f64 and the
-    floor this is benign; validated to ~1e-13 against the dense oracle
-    in the demo and tests).
+    CPU, and matmul is the TPU-native path).
+
+    The returned factors are **balanced** — each side carries
+    ``sqrt(sigma)`` — which is load-bearing for numerics, not cosmetic:
+    with balanced inputs the Gram eigenvalues are ~sigma rather than
+    sigma^2 (half the conditioning exponent), and an exactly-zero field
+    has BOTH factors zero, so no orphaned O(1) basis rows (from the SVD
+    of a zero matrix) survive to masquerade as real directions in later
+    Gram passes — that pathology produced O(1) errors in the nonlinear
+    SWE stepper before balancing.  Numerically-dead directions (below
+    eps * max + tiny) are masked out of the inverse scalings rather
+    than floored: dividing roundoff-level rows by a floored sigma
+    injects garbage.
 
     All shapes static (R and r are trace-time constants) — jit-safe.
     """
@@ -188,14 +195,22 @@ def _round_factored(A, B, r: int):
     H = B @ B.T                          # (R, R)
     va, Ea = jnp.linalg.eigh(G)
     vb, Eb = jnp.linalg.eigh(H)
-    va = jnp.maximum(va, jnp.finfo(va.dtype).eps * va[-1])
-    vb = jnp.maximum(vb, jnp.finfo(vb.dtype).eps * vb[-1])
-    sa, sb = jnp.sqrt(va), jnp.sqrt(vb)
-    # A = Qa Ra with Qa = A Ea sa^-1 (orthonormal), Ra = sa Ea^T.
-    core = (sa[:, None] * (Ea.T @ Eb)) * sb[None, :]
+    fi = jnp.finfo(va.dtype)
+    keep_a = va > fi.eps * va[-1] + fi.tiny
+    keep_b = vb > fi.eps * vb[-1] + fi.tiny
+    sa = jnp.sqrt(jnp.where(keep_a, va, 1.0))
+    sb = jnp.sqrt(jnp.where(keep_b, vb, 1.0))
+    sa_m = jnp.where(keep_a, sa, 0.0)
+    sb_m = jnp.where(keep_b, sb, 0.0)
+    inv_sa = jnp.where(keep_a, 1.0 / sa, 0.0)
+    inv_sb = jnp.where(keep_b, 1.0 / sb, 0.0)
+    # A = Qa Ra with Qa = A Ea sa^-1 (orthonormal on kept directions),
+    # Ra = sa Ea^T; likewise for B^T.  SVD the (R, R) coupling core.
+    core = (sa_m[:, None] * (Ea.T @ Eb)) * sb_m[None, :]
     u, s, vt = jnp.linalg.svd(core)
-    A_new = A @ (Ea @ (u[:, :r] * (s[None, :r] / sa[:, None])))
-    B_new = ((vt[:r] / sb[None, :]) @ Eb.T) @ B
+    rs = jnp.sqrt(s[:r])
+    A_new = A @ (Ea @ (u[:, :r] * rs[None, :] * inv_sa[:, None]))
+    B_new = ((vt[:r] * rs[:, None] * inv_sb[None, :]) @ Eb.T) @ B
     return A_new, B_new
 
 
@@ -253,9 +268,14 @@ def make_tt_stepper_static(
 
 
 def factor_field(q, rank: int):
-    """(n, m) field -> rank-``rank`` factors (A, B) via truncated SVD."""
+    """(n, m) field -> balanced rank-``rank`` factors via truncated SVD.
+
+    Balanced (each side carries sqrt(sigma)) to match
+    :func:`_round_factored` — see its docstring for why that matters.
+    """
     u, s, vt = jnp.linalg.svd(jnp.asarray(q), full_matrices=False)
-    return u[:, :rank] * s[None, :rank], vt[:rank]
+    rs = jnp.sqrt(s[:rank])
+    return u[:, :rank] * rs[None, :], rs[:, None] * vt[:rank]
 
 
 def unfactor_field(q):
