@@ -62,6 +62,72 @@ __all__ = [
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
 
+# Slot order of the vectorized routers' per-face edge tables.
+_EORDER = (EDGE_S, EDGE_N, EDGE_W, EDGE_E)
+_SLOT = {e: s for s, e in enumerate(_EORDER)}
+
+
+def _pair_sym_tables(grid):
+    """Shared static tables of the routers' edge-normal symmetrization.
+
+    Returns ``(M0, M1, link_rows, back_rows, rev, sga, sgb, sym_src)``:
+    the (1, 4, n) edge-face inverse-metric rows per slot (face-independent
+    on the equiangular grid; (iab, ibb) for S/N, (iaa, iab) for W/E —
+    covariant_face_normal_velocity's pairs), the 12 physical edges' row
+    selections into the (24, n) local-normal table, reversal/sign
+    columns, and the scatter order back to (face*4 + slot) rows.
+    """
+    import numpy as np
+
+    n, halo = grid.n, grid.halo
+    i0, i1 = halo, halo + n
+    adj = build_connectivity()
+    met = {
+        EDGE_W: (grid.ginv_aa_xf[0, i0:i1, i0], grid.ginv_ab_xf[0, i0:i1, i0]),
+        EDGE_E: (grid.ginv_aa_xf[0, i0:i1, i1], grid.ginv_ab_xf[0, i0:i1, i1]),
+        EDGE_S: (grid.ginv_ab_yf[0, i0, i0:i1], grid.ginv_bb_yf[0, i0, i0:i1]),
+        EDGE_N: (grid.ginv_ab_yf[0, i1, i0:i1], grid.ginv_bb_yf[0, i1, i0:i1]),
+    }
+    M0 = jnp.stack([jnp.asarray(met[e][0]) for e in _EORDER])[None]
+    M1 = jnp.stack([jnp.asarray(met[e][1]) for e in _EORDER])[None]
+
+    links = [lk for lk, _ in edge_pairs(adj)]
+    backs = [bk for _, bk in edge_pairs(adj)]
+    link_rows = jnp.asarray([lk.face * 4 + _SLOT[lk.edge] for lk in links])
+    back_rows = jnp.asarray([bk.face * 4 + _SLOT[bk.edge] for bk in backs])
+    rev = jnp.asarray([[lk.reversed_] for lk in links])
+    sga = jnp.asarray([[_OUT_SIGN[lk.edge]] for lk in links], jnp.float32)
+    sgb = jnp.asarray([[_OUT_SIGN[bk.edge]] for bk in backs], jnp.float32)
+    sym_src = np.empty(24, np.int64)
+    for i, (lk, bk) in enumerate(zip(links, backs)):
+        sym_src[lk.face * 4 + _SLOT[lk.edge]] = i
+        sym_src[bk.face * 4 + _SLOT[bk.edge]] = 12 + i
+    return (M0, M1, link_rows, back_rows, rev, sga, sgb,
+            jnp.asarray(sym_src))
+
+
+def _pair_symmetrize(I_u, gadj_a, gadj_b, tables):
+    """Vectorized :func:`_symmetrized_strips` algebra on (6, 4, n) rows.
+
+    ``I_u``: (2, 6, 4, n) interior boundary-adjacent covariant rows;
+    ``gadj_*``: (6, 4, n) edge-adjacent ghost rows (rotated).  Returns the
+    per-face sym strips as (6, 4, n) in slot order — operand order matches
+    the loop implementation exactly (bitwise, tested).
+    """
+    M0, M1, link_rows, back_rows, rev, sga, sgb, sym_src = tables
+    ubar0 = 0.5 * (I_u[0] + gadj_a)
+    ubar1 = 0.5 * (I_u[1] + gadj_b)
+    L = (M0 * ubar0 + M1 * ubar1).reshape(24, -1)
+    la = jnp.take(L, link_rows, axis=0)
+    lb = jnp.take(L, back_rows, axis=0)
+    lb = jnp.where(rev, jnp.flip(lb, -1), lb)
+    avg = 0.5 * (sga * la - sgb * lb)
+    na = sga * avg
+    nb = sgb * (-avg)
+    nb = jnp.where(rev, jnp.flip(nb, -1), nb)
+    return jnp.take(jnp.concatenate([na, nb], axis=0), sym_src,
+                    axis=0).reshape(6, 4, -1)
+
 
 def _local_edge_normal(grid, u_ext, face: int, edge: int):
     """This panel's own normal velocity at one edge's boundary faces.
@@ -545,10 +611,7 @@ def make_cov_strip_router_linear(grid):
     n, halo = grid.n, grid.halo
     h = halo
     R = 12 * h
-    i0, i1 = h, h + n
     adj = build_connectivity()
-    EORDER = (EDGE_S, EDGE_N, EDGE_W, EDGE_E)
-    SLOT = {e: s for s, e in enumerate(EORDER)}
     off = {EDGE_S: 0, EDGE_N: h, EDGE_W: 2 * h, EDGE_E: 3 * h}
 
     # Rotation tables in *placed* layout, slot-ordered (4, 6, 4, halo, n):
@@ -556,8 +619,8 @@ def make_cov_strip_router_linear(grid):
     # elementwise rotation, so flipping the canonical tables once here lets
     # the routed strips be multiplied in placed layout directly.
     Tc = np.asarray(_rotation_tables(grid))          # (4, 6, 4, h, n) by EDGE_*
-    Tp = np.stack([Tc[:, :, e] for e in EORDER], axis=2)
-    for s, e in enumerate(EORDER):
+    Tp = np.stack([Tc[:, :, e] for e in _EORDER], axis=2)
+    for s, e in enumerate(_EORDER):
         if e in (EDGE_S, EDGE_W):
             Tp[:, :, s] = Tp[:, :, s, ::-1]
     Tp = jnp.asarray(Tp)
@@ -568,7 +631,7 @@ def make_cov_strip_router_linear(grid):
     # canonicalization (depth flip for N/E sources) into the permutation.
     idx = np.empty((3, 6, 4, h), np.int64)
     for f in range(6):
-        for s, e in enumerate(EORDER):
+        for s, e in enumerate(_EORDER):
             link = adj[f][e]
             for k in range(h):
                 kc = (h - 1 - k) if e in (EDGE_S, EDGE_W) else k
@@ -583,39 +646,14 @@ def make_cov_strip_router_linear(grid):
     # normals.  Nearest-to-edge depth is h-1 for N/E blocks, 0 for S/W.
     idx_int = np.empty((2, 6, 4), np.int64)
     for f in range(6):
-        for s, e in enumerate(EORDER):
+        for s, e in enumerate(_EORDER):
             k = h - 1 if e in (EDGE_N, EDGE_E) else 0
             for c in range(2):
                 idx_int[c, f, s] = f * R + (1 + c) * 4 * h + off[e] + k
     idx_all = jnp.asarray(np.concatenate([idx.reshape(-1),
                                           idx_int.reshape(-1)]))
 
-    # Edge-face inverse-metric rows per slot (face-independent on the
-    # equiangular grid), stacked (1, 4, n): (iab, ibb) for S/N rows,
-    # (iaa, iab) for W/E columns — covariant_face_normal_velocity's pairs.
-    met = {
-        EDGE_W: (grid.ginv_aa_xf[0, i0:i1, i0], grid.ginv_ab_xf[0, i0:i1, i0]),
-        EDGE_E: (grid.ginv_aa_xf[0, i0:i1, i1], grid.ginv_ab_xf[0, i0:i1, i1]),
-        EDGE_S: (grid.ginv_ab_yf[0, i0, i0:i1], grid.ginv_bb_yf[0, i0, i0:i1]),
-        EDGE_N: (grid.ginv_ab_yf[0, i1, i0:i1], grid.ginv_bb_yf[0, i1, i0:i1]),
-    }
-    M0 = jnp.stack([jnp.asarray(met[e][0]) for e in EORDER])[None]
-    M1 = jnp.stack([jnp.asarray(met[e][1]) for e in EORDER])[None]
-
-    # Pair combine tables over the 12 physical edges (L rows are (f*4+s)).
-    links = [lk for lk, _ in edge_pairs(adj)]
-    backs = [bk for _, bk in edge_pairs(adj)]
-    link_rows = jnp.asarray([lk.face * 4 + SLOT[lk.edge] for lk in links])
-    back_rows = jnp.asarray([bk.face * 4 + SLOT[bk.edge] for bk in backs])
-    rev = jnp.asarray([[lk.reversed_] for lk in links])
-    sga = jnp.asarray([[_OUT_SIGN[lk.edge]] for lk in links], jnp.float32)
-    sgb = jnp.asarray([[_OUT_SIGN[bk.edge]] for bk in backs], jnp.float32)
-    # Scatter (na rows 0..11, nb rows 12..23) back to (f*4+s) order.
-    sym_src = np.empty(24, np.int64)
-    for i, (lk, bk) in enumerate(zip(links, backs)):
-        sym_src[lk.face * 4 + SLOT[lk.edge]] = i
-        sym_src[bk.face * 4 + SLOT[bk.edge]] = 12 + i
-    sym_src = jnp.asarray(sym_src)
+    sym_tables = _pair_sym_tables(grid)
 
     # Adjacent ghost row of each placed (h, n) block: S/W blocks are
     # depth-flipped so the edge-adjacent row is h-1; N/E it is row 0.
@@ -634,19 +672,7 @@ def make_cov_strip_router_linear(grid):
 
         gadj_a = jnp.stack([G_ua[:, s, adj_k[s]] for s in range(4)], axis=1)
         gadj_b = jnp.stack([G_ub[:, s, adj_k[s]] for s in range(4)], axis=1)
-        ubar0 = 0.5 * (I_u[0] + gadj_a)
-        ubar1 = 0.5 * (I_u[1] + gadj_b)
-        L = (M0 * ubar0 + M1 * ubar1).reshape(24, n)
-
-        la = jnp.take(L, link_rows, axis=0)
-        lb = jnp.take(L, back_rows, axis=0)
-        lb = jnp.where(rev, jnp.flip(lb, -1), lb)
-        avg = 0.5 * (sga * la - sgb * lb)
-        na = sga * avg
-        nb = sgb * (-avg)
-        nb = jnp.where(rev, jnp.flip(nb, -1), nb)
-        sym = jnp.take(jnp.concatenate([na, nb], axis=0), sym_src,
-                       axis=0).reshape(6, 4, n)
+        sym = _pair_symmetrize(I_u, gadj_a, gadj_b, sym_tables)
 
         return jnp.concatenate(
             [G_h.reshape(6, 4 * h, n), G_ua.reshape(6, 4 * h, n),
@@ -920,10 +946,7 @@ def make_cov_strip_router_split(grid):
 
     n, halo = grid.n, grid.halo
     h = halo
-    i0, i1 = halo, halo + n
     adj = build_connectivity()
-    EORDER = (EDGE_S, EDGE_N, EDGE_W, EDGE_E)
-    SLOT = {e: s for s, e in enumerate(EORDER)}
     F = 2 * 6 * 6 * h          # sn section + weT section row count
 
     def src_row(fi: int, g: int, e: int, depth: int) -> int:
@@ -955,7 +978,7 @@ def make_cov_strip_router_split(grid):
     idx_int = np.empty((2, 6, 4), np.int64)
     for c in range(2):
         for f in range(6):
-            for s, e in enumerate(EORDER):
+            for s, e in enumerate(_EORDER):
                 idx_int[c, f, s] = src_row(1 + c, f, e, 0)
     idx_all = jnp.asarray(np.concatenate(
         [idx_sn.reshape(-1), idx_we.reshape(-1), idx_int.reshape(-1)]))
@@ -969,27 +992,7 @@ def make_cov_strip_router_split(grid):
     T_we = jnp.asarray(np.stack(
         [Tc[:, :, EDGE_W, ::-1], Tc[:, :, EDGE_E]], axis=2))
 
-    met = {
-        EDGE_W: (grid.ginv_aa_xf[0, i0:i1, i0], grid.ginv_ab_xf[0, i0:i1, i0]),
-        EDGE_E: (grid.ginv_aa_xf[0, i0:i1, i1], grid.ginv_ab_xf[0, i0:i1, i1]),
-        EDGE_S: (grid.ginv_ab_yf[0, i0, i0:i1], grid.ginv_bb_yf[0, i0, i0:i1]),
-        EDGE_N: (grid.ginv_ab_yf[0, i1, i0:i1], grid.ginv_bb_yf[0, i1, i0:i1]),
-    }
-    M0 = jnp.stack([jnp.asarray(met[e][0]) for e in EORDER])[None]
-    M1 = jnp.stack([jnp.asarray(met[e][1]) for e in EORDER])[None]
-
-    links = [lk for lk, _ in edge_pairs(adj)]
-    backs = [bk for _, bk in edge_pairs(adj)]
-    link_rows = jnp.asarray([lk.face * 4 + SLOT[lk.edge] for lk in links])
-    back_rows = jnp.asarray([bk.face * 4 + SLOT[bk.edge] for bk in backs])
-    rev = jnp.asarray([[lk.reversed_] for lk in links])
-    sga = jnp.asarray([[_OUT_SIGN[lk.edge]] for lk in links], jnp.float32)
-    sgb = jnp.asarray([[_OUT_SIGN[bk.edge]] for bk in backs], jnp.float32)
-    sym_src = np.empty(24, np.int64)
-    for i, (lk, bk) in enumerate(zip(links, backs)):
-        sym_src[lk.face * 4 + SLOT[lk.edge]] = i
-        sym_src[bk.face * 4 + SLOT[bk.edge]] = 12 + i
-    sym_src = jnp.asarray(sym_src)
+    sym_tables = _pair_sym_tables(grid)
     adj_k = [h - 1, 0]          # placed edge-adjacent row: S/W flip, N/E not
 
     def route(strips_sn, strips_we):
@@ -1016,19 +1019,7 @@ def make_cov_strip_router_split(grid):
         gadj_b = jnp.stack(
             [G_sn[2][:, 0, adj_k[0]], G_sn[2][:, 1, adj_k[1]],
              G_we[2][:, 0, adj_k[0]], G_we[2][:, 1, adj_k[1]]], axis=1)
-        ubar0 = 0.5 * (I_u[0] + gadj_a)
-        ubar1 = 0.5 * (I_u[1] + gadj_b)
-        L = (M0 * ubar0 + M1 * ubar1).reshape(24, n)
-
-        la = jnp.take(L, link_rows, axis=0)
-        lb = jnp.take(L, back_rows, axis=0)
-        lb = jnp.where(rev, jnp.flip(lb, -1), lb)
-        avg = 0.5 * (sga * la - sgb * lb)
-        na = sga * avg
-        nb = sgb * (-avg)
-        nb = jnp.where(rev, jnp.flip(nb, -1), nb)
-        sym = jnp.take(jnp.concatenate([na, nb], axis=0), sym_src,
-                       axis=0).reshape(6, 4, n)
+        sym = _pair_symmetrize(I_u, gadj_a, gadj_b, sym_tables)
 
         gsn = jnp.concatenate(
             [jnp.concatenate([g.reshape(6, 2 * h, n) for g in G_sn], axis=1),
