@@ -424,3 +424,34 @@ def test_cov_fused_nu4_matches_classic():
         b = np.asarray(out[k], dtype=np.float64)
         scale = np.max(np.abs(a)) + 1e-300
         np.testing.assert_allclose(b, a, atol=5e-4 * scale, err_msg=k)
+
+
+def test_cov_mega_step_parity():
+    """Whole-step single-kernel stepper (experimental; measured slower
+    than the compact 3-kernel stepper at C384 — kept as the documented
+    VMEM-residency experiment).  h matches the compact stepper bitwise;
+    all fields to ~ulp level (SMEM-loaded vs literal RK coefficients
+    change constant folding; the drift compounds over steps)."""
+    from jaxstream.ops.pallas.swe_mega import make_fused_ssprk3_cov_mega
+
+    n = 12
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    pal = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                omega=EARTH_OMEGA, b_ext=b_ext,
+                                backend="pallas_interpret")
+    state = pal.initial_state(h_ext, v_ext)
+    dt = 600.0
+    step_c = pal.make_fused_step(dt)
+    step_m = make_fused_ssprk3_cov_mega(grid, EARTH_GRAVITY, EARTH_OMEGA,
+                                        dt, pal.b_ext, interpret=True)
+    yc = pal.compact_state(state)
+    ym = dict(yc)
+    for _ in range(3):
+        yc = step_c(yc, 0.0)
+        ym = step_m(ym, 0.0)
+    for k in ("h", "u", "strips_sn", "strips_we"):
+        a = np.asarray(yc[k], dtype=np.float64)
+        b = np.asarray(ym[k], dtype=np.float64)
+        scale = np.max(np.abs(a)) + 1e-300
+        np.testing.assert_allclose(b, a, atol=1e-6 * scale, err_msg=k)
