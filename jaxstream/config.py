@@ -84,6 +84,7 @@ class TimeConfig:
 class IOConfig:
     history_path: str = "history"
     history_stride: int = 0          # steps between snapshots; 0 = off
+    history_tt_rank: int = 0         # >0: TT-compress snapshots (lossy)
     checkpoint_path: str = "checkpoints"
     checkpoint_stride: int = 0
 

@@ -25,18 +25,41 @@ __all__ = ["HistoryWriter", "save_geometry", "load_geometry_arrays"]
 
 
 class HistoryWriter:
-    """Append state snapshots to a zarr group with a record time axis."""
+    """Append state snapshots to a zarr group with a record time axis.
 
-    def __init__(self, path: str, attrs: Optional[Dict] = None):
+    ``tt_rank`` switches a field to Tensor-Train (truncated-SVD)
+    compressed storage: each trailing 2-D panel is stored as its
+    rank-``tt_rank`` factor pair (the deck's "TT-friendly 2D tiles",
+    p.4, applied to the pipeline's history box, p.6).  Fields whose
+    panels are too small to profit are stored raw; :meth:`read`
+    reconstructs transparently either way.  Lossy at the SVD-truncation
+    level — pick the rank from the run's accuracy budget.
+    """
+
+    def __init__(self, path: str, attrs: Optional[Dict] = None,
+                 tt_rank: Optional[int] = None):
+        self.tt_rank = tt_rank
         if os.path.exists(os.path.join(path, ".zgroup")):
             self.group = open_group(path)
             tarr = self.group["time"]
             self._len = tarr.shape[0]
+            stored = self.group.attrs.get("tt_rank")
+            if stored is not None:
+                self.tt_rank = stored
         else:
             self.group = ZarrGroup.create(
-                path, {**(attrs or {}), "conventions": "jaxstream-history-1"}
+                path, {**(attrs or {}), "conventions": "jaxstream-history-1",
+                       "tt_rank": tt_rank}
             )
             self._len = 0
+
+    def _write(self, name: str, i: int, a: np.ndarray) -> None:
+        if name not in self.group:
+            self.group.create_array(
+                name, shape=(0,) + a.shape, dtype=a.dtype,
+                chunks=(1,) + a.shape,
+            )
+        self.group[name].write_index0(i, a)
 
     def append(self, state: Dict, t: float) -> int:
         """Write one snapshot; returns its record index."""
@@ -49,19 +72,32 @@ class HistoryWriter:
         tarr.write_index0(i, np.asarray(float(t)))
         for name, arr in state.items():
             a = np.asarray(arr)
-            if name not in self.group:
-                self.group.create_array(
-                    name,
-                    shape=(0,) + a.shape,
-                    dtype=a.dtype,
-                    chunks=(1,) + a.shape,
-                )
-            self.group[name].write_index0(i, a)
+            r = self.tt_rank
+            ny, nx = (a.shape[-2], a.shape[-1]) if a.ndim >= 2 else (0, 0)
+            if (r is not None and a.ndim >= 3
+                    and r * (ny + nx) < ny * nx):
+                lead = a.shape[:-2]
+                flat = a.reshape((-1, ny, nx)).astype(np.float32)
+                u, s, vt = np.linalg.svd(flat, full_matrices=False)
+                rs = np.sqrt(s[:, :r])
+                A = (u[:, :, :r] * rs[:, None, :]).reshape(lead + (ny, r))
+                B = (rs[:, :, None] * vt[:, :r]).reshape(lead + (r, nx))
+                self._write(name + "__ttA", i, A)
+                self._write(name + "__ttB", i, B)
+            else:
+                self._write(name, i, a)
         self._len = i + 1
         return i
 
     def read(self, name: str) -> np.ndarray:
-        return self.group[name].read()
+        """Read a field's full record axis, reconstructing TT storage."""
+        if name in self.group:
+            return self.group[name].read()
+        if name + "__ttA" in self.group:
+            A = self.group[name + "__ttA"].read()
+            B = self.group[name + "__ttB"].read()
+            return np.einsum("...ir,...rj->...ij", A, B)
+        raise KeyError(name)
 
     @property
     def times(self) -> np.ndarray:

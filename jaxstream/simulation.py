@@ -133,7 +133,9 @@ class Simulation:
         if io.history_stride > 0:
             save_geometry(io.history_path + ".geometry", self.grid)
             self.history = HistoryWriter(
-                io.history_path, attrs={"model": mcfg.name, "ic": mcfg.initial_condition}
+                io.history_path,
+                attrs={"model": mcfg.name, "ic": mcfg.initial_condition},
+                tt_rank=io.history_tt_rank or None,
             )
         if io.checkpoint_stride > 0:
             self.checkpoints = CheckpointManager(io.checkpoint_path)

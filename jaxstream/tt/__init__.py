@@ -1,7 +1,11 @@
 """Tensor-Train compressed numerics (the reference's research direction).
 
-Deck p.3/p.5/p.19: TT compression of panel fields and the compressed
--algebra layer; operator-level TT numerics are roadmap (SURVEY.md §2.2).
+Deck p.3/p.5/p.19: TT compression of panel fields, the compressed-
+algebra layer (:mod:`.tensor_train`), operator-level TT stepping with a
+jit-able static-rank fast path (:mod:`.solver`), and the full nonlinear
+2-D SWE in factored form (:mod:`.swe2d`) — the LANL problem the deck
+cites, one step past its roadmap.  TT-compressed history output plugs
+into the pipeline via ``io.history_tt_rank``.
 """
 
 from .tensor_train import (

@@ -81,3 +81,39 @@ def test_checkpoint_roundtrip(tmp_path):
     # Restore a specific step.
     r10, t10 = CheckpointManager(str(tmp_path / "ckpt")).restore(10)
     assert t10 == 6000.0
+
+
+def test_history_tt_compression_roundtrip(tmp_path):
+    """TT-compressed history: factors stored instead of full panels,
+    reconstruction at the SVD truncation floor, raw fallback for small
+    fields, and rank persisted for reopening."""
+    from jaxstream.io.history import HistoryWriter
+
+    rng = np.random.default_rng(0)
+    n = 64
+    x = np.linspace(0, 2 * np.pi, n)
+    X, Y = np.meshgrid(x, x, indexing="ij")
+    # Smooth low-rank-ish field + a tiny field that should stay raw.
+    h = (1000.0 + np.sin(X) * np.cos(Y)
+         + 0.1 * np.cos(2 * X) * np.sin(3 * Y))[None].repeat(6, 0)
+    small = rng.standard_normal((4,))
+
+    path = str(tmp_path / "hist_tt")
+    w = HistoryWriter(path, tt_rank=12)
+    w.append({"h": h.astype(np.float32), "small": small}, 0.0)
+    w.append({"h": (h * 1.01).astype(np.float32), "small": small}, 60.0)
+
+    assert "h__ttA" in w.group and "h" not in w.group
+    assert "small" in w.group
+    got = w.read("h")
+    assert got.shape == (2, 6, n, n)
+    scale = np.max(np.abs(h))
+    assert np.max(np.abs(got[0] - h)) < 1e-4 * scale
+    # Storage actually shrinks: 2*n*r vs n*n per panel.
+    assert w.group["h__ttA"].shape[-1] == 12
+
+    # Reopen: rank comes back from attrs; appending keeps compressing.
+    w2 = HistoryWriter(path)
+    assert w2.tt_rank == 12
+    w2.append({"h": h.astype(np.float32), "small": small}, 120.0)
+    assert w2.read("h").shape[0] == 3
