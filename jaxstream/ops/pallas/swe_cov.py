@@ -243,6 +243,10 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     ubb = 0.5 * (ub[h0:h1, h0 - 1:h1] + ub[h0:h1, h0:h1 + 1])
     ux = Fx["fg_aa"] * uba + Fx["fg_ab"] * ubb      # sqrtg u^a, (n, n+1)
     if sym_we is not None:
+        # Seam imposition costs ~29 us/step at C384 (measured by
+        # disabling it); concat assembly instead of iota-selects was
+        # tried and is no cheaper (and Mosaic rejects the misaligned
+        # lane-dim concat outright).
         sgW = _fast_frame(xfr[:, h0:h0 + 1], yc[h0:h1], radius)["sqrtg"]
         sgE = _fast_frame(xfr[:, h1:h1 + 1], yc[h0:h1], radius)["sqrtg"]
         colx = jax.lax.broadcasted_iota(jnp.int32, (n, n + 1), 1)
