@@ -25,7 +25,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # explicitly.
 import jax  # noqa: E402  (must import after XLA_FLAGS is set)
 
-jax.config.update("jax_platforms", "cpu")
-# Tests use float64 oracles (SURVEY.md §7: "f64-on-CPU oracle"); library
-# code is dtype-explicit so this only sharpens test-side math.
-jax.config.update("jax_enable_x64", True)
+if os.environ.get("JAXSTREAM_TPU_SMOKE"):
+    # tests/test_tpu_smoke.py compiles the fused kernels on the real
+    # chip — leave the sitecustomize's TPU platform in place, and keep
+    # x64 off: with it on, i64 index types leak into the Pallas trace
+    # and Mosaic rejects the kernel (f32 compute throughout anyway).
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
+    # Tests use float64 oracles (SURVEY.md §7: "f64-on-CPU oracle");
+    # library code is dtype-explicit so this only sharpens test math.
+    jax.config.update("jax_enable_x64", True)
