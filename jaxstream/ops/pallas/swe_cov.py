@@ -412,6 +412,99 @@ def make_cov_rhs_pallas(
     return rhs
 
 
+def make_cov_rhs_pallas_local(
+    n: int,
+    halo: int,
+    dalpha: float,
+    radius: float,
+    gravity: float,
+    omega: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """Covariant RHS for ONE local block with runtime coordinates.
+
+    The sub-panel (block-mesh) twin of ``make_cov_rhs_pallas(n_faces=1,
+    external_sym=True)``: here the gnomonic coordinate rows/columns are
+    *runtime operands* too, because each device's block covers a
+    different patch of its face.  Signature::
+
+        rhs(fz, xr, xfr, yc, yfc, h_ext, u_ext, b_ext, sym_sn, sym_we)
+            -> (dh (1, n, n), du (2, 1, n, n))
+
+    with ``xr``/``xfr`` (1, m) rows, ``yc``/``yfc`` (m, 1) columns of
+    the block's extended tan-coordinates, ``fz`` (1, 1, 3) the face
+    frame z-components, and sym strips imposed at all four block edges
+    (panel seams get the pair-symmetrized values; intra-panel seams the
+    plain shared face normal — both sides bitwise-equal either way, so
+    cross-device flux telescoping is exact).
+    """
+    m = n + 2 * halo
+    d = float(dalpha)
+    recon = pick_recon(scheme, halo, n, limiter)
+
+    def kernel(fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref, h_ref, u_ref,
+               b_ref, ssn_ref, swe_ref, dh_ref, du_ref):
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            h_ref[0], u_ref[0, 0], u_ref[1, 0], b_ref[0],
+            ssn_ref[0], swe_ref[0], n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
+        dh_ref[0] = dh
+        du_ref[0, 0] = dua
+        du_ref[1, 0] = dub
+
+    grid_spec = pl.GridSpec(
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 1, m, m), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 2), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 1, n, n), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    def rhs(fz, xr, xfr, yc, yfc, h_ext, u_ext, b_ext, sym_sn, sym_we):
+        return tuple(call(fz, xr, xfr, yc, yfc,
+                          h_ext, u_ext, b_ext, sym_sn, sym_we))
+
+    return rhs
+
+
 # ---------------------------------------------------------------------------
 # Fused SSPRK3 with in-kernel exchange — the covariant TPU fast path.
 #

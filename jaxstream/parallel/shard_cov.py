@@ -150,6 +150,81 @@ def _maybe_flip(row, rev):
     return jnp.where(rev > 0.5, jnp.flip(row, axis=-1), row)
 
 
+#: Table-row order expected by :func:`apply_cov_cube_recv`.
+CUBE_ROW_NAMES = ("edge_sel", "rev_sel", "is_link", "s_link", "s_back",
+                  "T_mine", "T_oadj", "met_mine", "met_oth")
+
+
+def apply_cov_cube_recv(h_blk, u_blk, u_send, recv, rows, write_idx):
+    """Shared cube-edge receive: rotate, write ghosts, symmetrize.
+
+    The bitwise-critical half of a cube-edge exchange stage, common to
+    the one-face-per-device and block-mesh paths (one source of truth
+    for the seam-conservation algebra).  ``rows`` are this device's
+    table values in :data:`CUBE_ROW_NAMES` order; ``write_idx`` selects
+    the ghost edge to write (4 = inactive no-op, used by boundary
+    gating on the block mesh).  Returns ``(h_blk, u_blk, mine)`` with
+    ``mine`` the symmetrized edge-normal strip of this stage's edge —
+    both sides of the physical edge compute it bitwise-equal.
+    """
+    e_s, rev, isl, sl, sb, Tm, To, mm, mo = rows
+    del e_s
+
+    gu0 = Tm[0] * recv[1] + Tm[1] * recv[2]
+    gu1 = Tm[2] * recv[1] + Tm[3] * recv[2]
+    writers = [functools.partial(write_strip, face=0, edge=e)
+               for e in range(4)] + [lambda b, strip: b]
+    ghost = jnp.stack([recv[0], gu0, gu1])           # (3, halo, n)
+    blk3 = jnp.concatenate([h_blk[None], u_blk], axis=0)
+    blk3 = lax.switch(
+        write_idx, [lambda b, st, w=w: w(b, strip=st) for w in writers],
+        blk3, ghost,
+    )
+    h_blk = blk3[0]
+    u_blk = blk3[1:3]
+
+    # --- symmetrized edge normal (bitwise on both sides) ----------------
+    int_adj = u_send[:, 0, :]                # my adjacent row, my order
+    ghost_adj = jnp.stack([gu0[0], gu1[0]])
+    ubar = 0.5 * (int_adj + ghost_adj)
+    n_mine = mm[0] * ubar[0] + mm[1] * ubar[1]
+
+    # The other panel's own normal, in ITS canonical order.
+    oth_int = _maybe_flip(recv[1:3, 0, :], rev)      # back to its order
+    my_adj_f = _maybe_flip(int_adj, rev)             # as it received
+    oth_ghost = jnp.stack([
+        To[0] * my_adj_f[0] + To[1] * my_adj_f[1],
+        To[2] * my_adj_f[0] + To[3] * my_adj_f[1],
+    ])
+    obar = 0.5 * (oth_int + oth_ghost)
+    n_oth = mo[0] * obar[0] + mo[1] * obar[1]
+
+    n_link = jnp.where(isl > 0.5, n_mine, n_oth)
+    n_back_lo = jnp.where(isl > 0.5, _maybe_flip(n_oth, rev),
+                          _maybe_flip(n_mine, rev))
+    avg = 0.5 * (sl * n_link - sb * n_back_lo)
+    mine = jnp.where(isl > 0.5, sl * avg,
+                     _maybe_flip(sb * (-avg), rev))
+    return h_blk, u_blk, mine
+
+
+def ssprk3_sharded_body(f, state, dt):
+    """The explicit paths' shared SSPRK3 stage combination."""
+    from ..ops.pallas.swe_step import SSPRK3_COEFFS
+
+    (_, _), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+    h0, u0 = state["h"], state["u"]
+    dh, du = f(h0, u0)
+    h1 = h0 + dt * dh
+    u1 = u0 + dt * du
+    dh, du = f(h1, u1)
+    h2 = a2 * h0 + b2 * (h1 + dt * dh)
+    u2 = a2 * u0 + b2 * (u1 + dt * du)
+    dh, du = f(h2, u2)
+    return {"h": a3 * h0 + b3 * (h2 + dt * dh),
+            "u": a3 * u0 + b3 * (u2 + dt * du)}
+
+
 def make_cov_shard_exchange(program: CovShardProgram):
     """``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)``.
 
@@ -172,57 +247,16 @@ def make_cov_shard_exchange(program: CovShardProgram):
         us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
                         for e in range(4)], axis=1)          # (2, 4, halo, n)
         for s, perm in enumerate(program.perms):
-            e_s = t["edge_sel"][0, s]
-            rev = t["rev_sel"][0, s]
+            rows = tuple(t[name][0, s] for name in CUBE_ROW_NAMES)
+            e_s, rev = rows[0], rows[1]
             h_send = jnp.take(hs, e_s, axis=0)
             u_send = jnp.take(us, e_s, axis=1)
             payload = jnp.concatenate([h_send[None], u_send])  # (3, halo, n)
             payload = _maybe_flip(payload, rev)
             recv = lax.ppermute(payload, axis, perm)
 
-            # Ghost fill: h is a plain copy; u rotates through T_mine.
-            Tm = t["T_mine"][0, s]                           # (4, halo, n)
-            gu0 = Tm[0] * recv[1] + Tm[1] * recv[2]
-            gu1 = Tm[2] * recv[1] + Tm[3] * recv[2]
-            writers = [functools.partial(write_strip, face=0, edge=e)
-                       for e in range(4)]
-            ghost = jnp.stack([recv[0], gu0, gu1])           # (3, halo, n)
-            blk3 = jnp.concatenate([h_blk[None], u_blk], axis=0)
-            blk3 = lax.switch(
-                e_s, [lambda b, st, w=w: w(b, strip=st) for w in writers],
-                blk3, ghost,
-            )
-            h_blk = blk3[0]                  # (1, M, M)
-            u_blk = blk3[1:3]                # (2, 1, M, M)
-
-            # --- symmetrized edge normal (bitwise on both sides) --------
-            int_adj = u_send[:, 0, :]            # my adjacent row, my order
-            ghost_adj = jnp.stack([gu0[0], gu1[0]])
-            ubar = 0.5 * (int_adj + ghost_adj)
-            mm = t["met_mine"][0, s]
-            n_mine = mm[0] * ubar[0] + mm[1] * ubar[1]
-
-            # The other panel's own normal, in ITS canonical order.
-            oth_int = _maybe_flip(recv[1:3, 0, :], rev)      # back to its order
-            my_adj_f = _maybe_flip(int_adj, rev)             # as it received
-            To = t["T_oadj"][0, s]
-            oth_ghost = jnp.stack([
-                To[0] * my_adj_f[0] + To[1] * my_adj_f[1],
-                To[2] * my_adj_f[0] + To[3] * my_adj_f[1],
-            ])
-            obar = 0.5 * (oth_int + oth_ghost)
-            mo = t["met_oth"][0, s]
-            n_oth = mo[0] * obar[0] + mo[1] * obar[1]
-
-            isl = t["is_link"][0, s]
-            sl = t["s_link"][0, s]
-            sb = t["s_back"][0, s]
-            n_link = jnp.where(isl > 0.5, n_mine, n_oth)
-            n_back_lo = jnp.where(isl > 0.5, _maybe_flip(n_oth, rev),
-                                  _maybe_flip(n_mine, rev))
-            avg = 0.5 * (sl * n_link - sb * n_back_lo)
-            mine = jnp.where(isl > 0.5, sl * avg,
-                             _maybe_flip(sb * (-avg), rev))
+            h_blk, u_blk, mine = apply_cov_cube_recv(
+                h_blk, u_blk, u_send, recv, rows, e_s)
             sym = jnp.where(
                 (jnp.arange(4) == e_s)[:, None], mine[None], sym)
 
@@ -267,9 +301,6 @@ def make_sharded_cov_stepper(model, setup, dt: float):
     axes = mesh.axis_names                      # ('panel', 'y', 'x')
     pstate = {"h": P(axes[0]), "u": P(None, axes[0])}
     ptab = {k: P(axes[0]) for k in program.tables}
-    from ..ops.pallas.swe_step import SSPRK3_COEFFS
-
-    (_, _), (a2, b2), (a3, b3) = SSPRK3_COEFFS  # stage 1 is y0 + dt f
 
     def embed(x):
         pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
@@ -312,16 +343,7 @@ def make_sharded_cov_stepper(model, setup, dt: float):
                 du = du - nu4 * l2u
             return dh, du
 
-        h0, u0 = state["h"], state["u"]
-        dh, du = f(h0, u0)
-        h1 = h0 + dt * dh
-        u1 = u0 + dt * du
-        dh, du = f(h1, u1)
-        h2 = a2 * h0 + b2 * (h1 + dt * dh)
-        u2 = a2 * u0 + b2 * (u1 + dt * du)
-        dh, du = f(h2, u2)
-        return {"h": a3 * h0 + b3 * (h2 + dt * dh),
-                "u": a3 * u0 + b3 * (u2 + dt * du)}
+        return ssprk3_sharded_body(f, state, dt)
 
     shard_body = jax.shard_map(
         body, mesh=mesh,

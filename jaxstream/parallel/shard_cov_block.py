@@ -1,0 +1,381 @@
+"""Explicit block-mesh shard_map stepper for the covariant formulation.
+
+Completes the explicit tier's matrix (DESIGN.md "formulation ×
+parallelism"): the covariant flagship on a ``(panel, y, x)`` =
+``(6, s, s)`` mesh — the reference's planned ``tiles_per_edge`` scaling
+(``/root/reference/JAX-DevLab-Examples.py:31-37``, annotated "3 → 54
+tiles" on the config screenshot, deck p.8) with the rotation-form
+vector exchange instead of the Cartesian componentwise one.
+
+Structure per SSPRK3 stage, per device (one sub-panel block each):
+
+* **Intra-panel ghosts**: 4 neighbor ``ppermute``s over the 'y'/'x'
+  axes carrying one ``(3, halo, n_loc)`` payload (h + both covariant
+  components — same basis on both sides, no rotation).
+* **Cube edges**: the 4 race-free stages as joint ``ppermute``s over
+  the full device product axis (only face-boundary blocks participate);
+  receivers rotate the velocity strips through per-device slices of the
+  face-level rotation tables (the same ``_rotation_tables`` source of
+  truth as every other covariant path).
+* **Seam normals**: every block edge gets an imposed edge-normal strip.
+  Panel seams use the canonical (link, back) symmetrization algebra on
+  the exchanged adjacent rows (bitwise-equal on both sides, as in
+  :mod:`.shard_cov`); intra-panel seams need no pair algebra at all —
+  ``0.5 * (mine + theirs)`` is bitwise-commutative and both sides scale
+  by identical stored-metric rows, so the shared value is exact by
+  construction.  Cross-device flux telescoping (mass conservation) is
+  therefore exact in both directions.
+
+The per-block RHS runs :func:`...swe_cov.make_cov_rhs_pallas_local`
+with the block's own coordinate rows as runtime operands (each device
+covers a different patch of its face's gnomonic coordinates).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..geometry.connectivity import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    build_connectivity,
+    build_schedule,
+)
+from ..geometry.cubed_sphere import FACE_AXES, extended_coords
+from .halo import read_strip, write_strip
+from .shard_cov import (
+    CUBE_ROW_NAMES,
+    apply_cov_cube_recv,
+    ssprk3_sharded_body,
+)
+from .shard_halo import _block_coords
+
+__all__ = ["CovBlockProgram", "make_sharded_cov_block_stepper"]
+
+_OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
+
+
+class CovBlockProgram:
+    """Static schedule + per-device tables for the covariant block mesh.
+
+    All ``(6, s, s, ...)`` tables shard ``P('panel', 'y', 'x')``; the
+    SPMD program is uniform and reads its own rows.
+    """
+
+    def __init__(self, grid, s: int, axis_names=("panel", "y", "x")):
+        n, halo = grid.n, grid.halo
+        if n % s:
+            raise ValueError(f"n={n} not divisible by blocks-per-edge {s}")
+        n_loc = n // s
+        if n_loc < halo:
+            raise ValueError(f"local block {n_loc} smaller than halo {halo}")
+        self.s = s
+        self.n_loc = n_loc
+        self.halo = halo
+        self.axis_names = tuple(axis_names)
+        ax_panel, ax_y, ax_x = self.axis_names
+        adj = build_connectivity()
+        schedule = build_schedule(adj)
+        nst = len(schedule)
+        i0f, i1f = halo, halo + n          # face-extended interior range
+
+        # ---- intra-panel neighbor shifts --------------------------------
+        fwd = [(i, i + 1) for i in range(s - 1)]
+        bwd = [(i + 1, i) for i in range(s - 1)]
+        self.intra_perms = [
+            (ax_x, fwd, EDGE_E, EDGE_W),
+            (ax_x, bwd, EDGE_W, EDGE_E),
+            (ax_y, fwd, EDGE_N, EDGE_S),
+            (ax_y, bwd, EDGE_S, EDGE_N),
+        ]
+
+        # ---- cube-edge stages (joint permutes over boundary blocks) -----
+        def lin(f, iy, ix):
+            return (f * s + iy) * s + ix
+
+        stage_of = {}
+        self.cube_perms = []
+        for t, stage in enumerate(schedule):
+            perm = []
+            for link, back in stage:
+                for lk, other, isl in ((link, back, True),
+                                       (back, link, False)):
+                    for k in range(s):
+                        kk = s - 1 - k if lk.reversed_ else k
+                        src = lin(lk.face, *_block_coords(lk.edge, k, s))
+                        dst = lin(lk.nbr_face,
+                                  *_block_coords(lk.nbr_edge, kk, s))
+                        perm.append((src, dst))
+                        iy, ix = _block_coords(lk.edge, k, s)
+                        stage_of[(lk.face, iy, ix, lk.edge)] = (
+                            t, link, back, isl, k, kk)
+            assert len(set(d for _, d in perm)) == len(perm)
+            self.cube_perms.append(perm)
+
+        # ---- per-device tables ------------------------------------------
+        from ..ops.pallas.swe_cov import _rotation_tables
+
+        T_all = np.asarray(_rotation_tables(grid))   # (4, 6, 4, halo, n)
+        gaa_xf = np.asarray(grid.ginv_aa_xf)
+        gab_xf = np.asarray(grid.ginv_ab_xf)
+        gab_yf = np.asarray(grid.ginv_ab_yf)
+        gbb_yf = np.asarray(grid.ginv_bb_yf)
+
+        def met_seg(face, edge, iy, ix):
+            """(2, n_loc) metric rows of block (iy, ix)'s ``edge``."""
+            if edge in (EDGE_W, EDGE_E):
+                fi = i0f + (ix if edge == EDGE_W else ix + 1) * n_loc
+                r0, r1 = i0f + iy * n_loc, i0f + (iy + 1) * n_loc
+                return np.stack([gaa_xf[face, r0:r1, fi],
+                                 gab_xf[face, r0:r1, fi]])
+            fi = i0f + (iy if edge == EDGE_S else iy + 1) * n_loc
+            c0, c1 = i0f + ix * n_loc, i0f + (ix + 1) * n_loc
+            return np.stack([gab_yf[face, fi, c0:c1],
+                             gbb_yf[face, fi, c0:c1]])
+
+        edge_sel = np.zeros((6, s, s, nst), np.int32)
+        active = np.zeros((6, s, s, nst), np.float32)
+        rev_sel = np.zeros((6, s, s, nst), np.float32)
+        is_link = np.zeros((6, s, s, nst), np.float32)
+        s_link = np.zeros((6, s, s, nst), np.float32)
+        s_back = np.zeros((6, s, s, nst), np.float32)
+        T_mine = np.zeros((6, s, s, nst, 4, halo, n_loc), np.float32)
+        T_oadj = np.zeros((6, s, s, nst, 4, n_loc), np.float32)
+        met_mine = np.zeros((6, s, s, nst, 2, n_loc), np.float32)
+        met_oth = np.zeros((6, s, s, nst, 2, n_loc), np.float32)
+        met_edge = np.zeros((6, s, s, 4, 2, n_loc), np.float32)
+
+        for f in range(6):
+            for iy in range(s):
+                for ix in range(s):
+                    for e in range(4):
+                        met_edge[f, iy, ix, e] = met_seg(f, e, iy, ix)
+
+        for (f, iy, ix, e), (t, link, back, isl, k, kk) in stage_of.items():
+            other = back if isl else link
+            seg = slice(k * n_loc, (k + 1) * n_loc)
+            oseg = slice(kk * n_loc, (kk + 1) * n_loc)
+            edge_sel[f, iy, ix, t] = e
+            active[f, iy, ix, t] = 1.0
+            rev_sel[f, iy, ix, t] = float(link.reversed_)
+            is_link[f, iy, ix, t] = float(isl)
+            s_link[f, iy, ix, t] = _OUT_SIGN[link.edge]
+            s_back[f, iy, ix, t] = _OUT_SIGN[back.edge]
+            T_mine[f, iy, ix, t] = T_all[:, f, e][:, :, seg]
+            T_oadj[f, iy, ix, t] = T_all[:, other.face, other.edge][
+                :, 0, oseg]
+            met_mine[f, iy, ix, t] = met_edge[f, iy, ix, e]
+            oy, ox = _block_coords(other.edge, kk, s)
+            met_oth[f, iy, ix, t] = met_seg(other.face, other.edge, oy, ox)
+
+        # ---- per-device coordinates and frames --------------------------
+        ac, af, _ = extended_coords(n, halo)
+        xr = np.zeros((6, s, s, 1, n_loc + 2 * halo), np.float32)
+        xfr = np.zeros_like(xr)
+        yc = np.zeros((6, s, s, n_loc + 2 * halo, 1), np.float32)
+        yfc = np.zeros_like(yc)
+        for iy in range(s):
+            for ix in range(s):
+                cseg = slice(ix * n_loc, ix * n_loc + n_loc + 2 * halo)
+                rseg = slice(iy * n_loc, iy * n_loc + n_loc + 2 * halo)
+                xr[:, iy, ix, 0, :] = np.tan(ac[cseg])
+                xfr[:, iy, ix, 0, :] = np.tan(af[cseg])
+                yc[:, iy, ix, :, 0] = np.tan(ac[rseg])
+                yfc[:, iy, ix, :, 0] = np.tan(af[rseg])
+        fz = np.broadcast_to(
+            np.asarray(FACE_AXES, np.float32)[:, None, None, None, :, 2],
+            (6, s, s, 1, 3)).copy()
+
+        self.tables = {
+            "edge_sel": jnp.asarray(edge_sel),
+            "active": jnp.asarray(active),
+            "rev_sel": jnp.asarray(rev_sel),
+            "is_link": jnp.asarray(is_link),
+            "s_link": jnp.asarray(s_link),
+            "s_back": jnp.asarray(s_back),
+            "T_mine": jnp.asarray(T_mine),
+            "T_oadj": jnp.asarray(T_oadj),
+            "met_mine": jnp.asarray(met_mine),
+            "met_oth": jnp.asarray(met_oth),
+            "met_edge": jnp.asarray(met_edge),
+            "xr": jnp.asarray(xr),
+            "xfr": jnp.asarray(xfr),
+            "yc": jnp.asarray(yc),
+            "yfc": jnp.asarray(yfc),
+            "fz": jnp.asarray(fz),
+        }
+
+
+def _flip(row, rev):
+    return jnp.where(rev > 0.5, jnp.flip(row, axis=-1), row)
+
+
+def make_cov_block_exchange(program: CovBlockProgram):
+    """``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)``.
+
+    Local function for ``shard_map`` over the ``(6, s, s)`` mesh; the
+    blocks are local ``(1, m_loc, m_loc)`` / ``(2, 1, m_loc, m_loc)``
+    and ``t`` holds this device's table rows (leading dims 1).
+    """
+    n, halo = program.n_loc, program.halo
+    joint = program.axis_names
+
+    def exchange(h_blk, u_blk, t):
+        def tt(name):
+            v = t[name]
+            return v.reshape(v.shape[3:])      # drop (1, 1, 1) device dims
+
+        sym = jnp.zeros((4, n), jnp.float32)
+        hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
+                        for e in range(4)])                  # (4, halo, n)
+        us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
+                        for e in range(4)], axis=1)          # (2, 4, halo, n)
+        met_edge = tt("met_edge")                            # (4, 2, n)
+
+        # ---- intra-panel neighbors (same basis; no rotation) ------------
+        writers = [lambda b, st, e=e: write_strip(b, 0, e, st)
+                   for e in range(4)] + [lambda b, st: b]
+        for axname, perm, e_send, e_recv in program.intra_perms:
+            if not perm:
+                continue
+            payload = jnp.concatenate(
+                [hs[e_send][None], us[:, e_send]])           # (3, halo, n)
+            recv = lax.ppermute(payload, axname, perm)
+            blk3 = jnp.concatenate([h_blk[None], u_blk], axis=0)
+            blk3 = writers[e_recv](blk3, recv)
+            h_blk = blk3[0]
+            u_blk = blk3[1:3]
+            # Shared seam normal: 0.5*(mine + theirs) is commutative, so
+            # both sides compute the identical value with identical
+            # metric rows — no pair algebra needed off the cube edges.
+            ubar = 0.5 * (us[:, e_recv, 0, :] + recv[1:3, 0, :])
+            n_seam = (met_edge[e_recv, 0] * ubar[0]
+                      + met_edge[e_recv, 1] * ubar[1])
+            sym = jnp.where((jnp.arange(4) == e_recv)[:, None],
+                            n_seam[None], sym)
+
+        # ---- cube-edge stages (shared seam algebra, shard_cov.py) -------
+        for st, perm in enumerate(program.cube_perms):
+            rows = tuple(tt(name)[st] for name in CUBE_ROW_NAMES)
+            e_s, rev = rows[0], rows[1]
+            act = tt("active")[st]
+            u_send = jnp.take(us, e_s, axis=1)
+            payload = _flip(jnp.concatenate(
+                [jnp.take(hs, e_s, axis=0)[None], u_send]), rev)
+            recv = lax.ppermute(payload, joint, perm)
+
+            h_blk, u_blk, mine = apply_cov_cube_recv(
+                h_blk, u_blk, u_send, recv, rows,
+                jnp.where(act > 0.5, e_s, 4))
+            sym = jnp.where(
+                ((jnp.arange(4) == e_s) & (act > 0.5))[:, None],
+                mine[None], sym)
+
+        sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]     # (1, 2, n)
+        sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
+        return h_blk, u_blk, sym_sn, sym_we
+
+    return exchange
+
+
+def make_sharded_cov_block_stepper(model, setup, dt: float):
+    """``step(state, t) -> state`` for the covariant model on (6, s, s).
+
+    State is the usual interior pytree ``{"h": (6, n, n),
+    "u": (2, 6, n, n)}`` sharded over all three mesh axes.  Requires
+    ``nu4 == 0`` (use GSPMD for filtered runs on block meshes).
+    """
+    grid = model.grid
+    s = setup.sy
+    if setup.mesh is None or setup.panel != 6 or setup.sy != setup.sx \
+            or s < 2:
+        raise ValueError(
+            f"covariant block path needs a (panel=6, s, s) mesh with "
+            f"s >= 2; got panel={setup.panel}, y={setup.sy}, x={setup.sx}"
+        )
+    if getattr(model, "nu4", 0.0) != 0.0:
+        raise ValueError(
+            "the covariant block path does not apply hyperdiffusion "
+            "(nu4 > 0); use the GSPMD path (use_shard_map: false)"
+        )
+    mesh = setup.mesh
+    halo = grid.halo
+    program = CovBlockProgram(grid, s)
+    n_loc = program.n_loc
+    exchange = make_cov_block_exchange(program)
+    platform = getattr(mesh.devices.flat[0], "platform", "cpu")
+
+    from ..ops.pallas.swe_cov import make_cov_rhs_pallas_local
+
+    rhs_local = make_cov_rhs_pallas_local(
+        n_loc, halo, float(grid.dalpha), float(grid.radius),
+        model.gravity, model.omega, scheme=model.scheme,
+        limiter=model.limiter, interpret=(platform != "tpu"),
+    )
+
+    axes = mesh.axis_names
+    pstate = {"h": P(*axes), "u": P(None, *axes)}
+    ptab = {k: P(axes[0], axes[1], axes[2])
+            for k in program.tables}
+
+    # Static per-block b: overlapping extended blocks cannot come from
+    # plain sharding, so pre-slice them host-side into a (6, s, s,
+    # m_loc, m_loc) table sharded like everything else.
+    m_loc = n_loc + 2 * halo
+    b_np = np.asarray(model.b_ext)
+    b_blocks = np.zeros((6, s, s, m_loc, m_loc), np.float32)
+    for iy in range(s):
+        for ix in range(s):
+            b_blocks[:, iy, ix] = b_np[
+                :, iy * n_loc : iy * n_loc + m_loc,
+                ix * n_loc : ix * n_loc + m_loc]
+    b_blocks = jnp.asarray(b_blocks)
+
+    def embed(x):
+        pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
+        return jnp.pad(x, pad)
+
+    def body(state, tabs, b_loc):
+        fz = tabs["fz"].reshape(1, 1, 3)
+        xr = tabs["xr"].reshape(1, m_loc)
+        xfr = tabs["xfr"].reshape(1, m_loc)
+        yc = tabs["yc"].reshape(m_loc, 1)
+        yfc = tabs["yfc"].reshape(m_loc, 1)
+        b_e = b_loc.reshape(1, m_loc, m_loc)
+
+        def f(h_int, u_int):
+            h_e = embed(h_int)
+            u_e = embed(u_int)
+            h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
+            dh, du = rhs_local(fz, xr, xfr, yc, yfc, h_e, u_e, b_e,
+                               ssn, swe)
+            return dh, du
+
+        return ssprk3_sharded_body(f, state, dt)
+
+    shard_body = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pstate, ptab, P(*axes)),
+        out_specs=pstate,
+        check_vma=False,
+    )
+
+    tables = {
+        k: jax.device_put(v, NamedSharding(mesh, ptab[k]))
+        for k, v in program.tables.items()
+    }
+    b_sh = jax.device_put(b_blocks, NamedSharding(mesh, P(*axes)))
+
+    @jax.jit
+    def step(state, t):
+        del t
+        return shard_body(state, tables, b_sh)
+
+    return step

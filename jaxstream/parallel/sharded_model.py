@@ -42,16 +42,21 @@ def make_stepper_for(model, setup, example_state, dt: float,
     """
     if setup is not None and setup.use_shard_map:
         if hasattr(model, "exchange_u"):
-            # Covariant formulation: its explicit path carries the
+            # Covariant formulation: its explicit paths carry the
             # rotation exchange + seam symmetrization as ppermute strips
-            # and runs the Pallas RHS kernel per device (SSPRK3 only).
+            # and run the Pallas RHS kernel per device (SSPRK3 only) —
+            # one face per device, or sub-panel blocks (tiles_per_edge
+            # > 1) on the (6, s, s) mesh.
             from .shard_cov import make_sharded_cov_stepper
+            from .shard_cov_block import make_sharded_cov_block_stepper
 
             if scheme != "ssprk3":
                 raise ValueError(
                     "the explicit covariant shard path implements ssprk3 "
                     f"only; got scheme={scheme!r}"
                 )
+            if setup.panel == 6 and setup.sy == setup.sx and setup.sy > 1:
+                return make_sharded_cov_block_stepper(model, setup, dt)
             return make_sharded_cov_stepper(model, setup, dt)
         return make_sharded_stepper(model, setup, example_state, dt, scheme)
     return jax.jit(model.make_step(dt, scheme))

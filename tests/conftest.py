@@ -30,9 +30,24 @@ if os.environ.get("JAXSTREAM_TPU_SMOKE"):
     # chip — leave the sitecustomize's TPU platform in place, and keep
     # x64 off: with it on, i64 index types leak into the Pallas trace
     # and Mosaic rejects the kernel (f32 compute throughout anyway).
+    # Every non-smoke test is skipped in this mode (they assume the
+    # CPU pin and f64 oracles) — see pytest_collection_modifyitems.
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
     # Tests use float64 oracles (SURVEY.md §7: "f64-on-CPU oracle");
     # library code is dtype-explicit so this only sharpens test math.
     jax.config.update("jax_enable_x64", True)
+
+
+def pytest_collection_modifyitems(config, items):
+    if not os.environ.get("JAXSTREAM_TPU_SMOKE"):
+        return
+    import pytest
+
+    skip = pytest.mark.skip(
+        reason="JAXSTREAM_TPU_SMOKE runs only tests/test_tpu_smoke.py "
+               "(the CPU pin and f64 oracles are disabled in this mode)")
+    for item in items:
+        if "test_tpu_smoke" not in str(item.fspath):
+            item.add_marker(skip)

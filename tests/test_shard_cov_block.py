@@ -1,0 +1,25 @@
+"""Explicit covariant block-mesh stepper vs the single-device oracle.
+
+Needs 24 virtual devices ((6, 2, 2) mesh) — more than the conftest's 8 —
+so the check runs in a subprocess with its own XLA_FLAGS
+(tests/cov_block_worker.py): rotation exchange on cube-edge block
+segments, raw intra-panel neighbor strips, per-block seam normals, and
+the per-block Pallas RHS with runtime coordinates.
+"""
+
+import os
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(__file__), "cov_block_worker.py")
+
+
+def test_cov_block_24_devices_matches_oracle():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    res = subprocess.run(
+        [sys.executable, _WORKER], capture_output=True, text=True,
+        timeout=420, env=env,
+    )
+    tail = "\n".join((res.stdout + res.stderr).splitlines()[-15:])
+    assert res.returncode == 0, f"worker failed:\n{tail}"
+    assert "COV_BLOCK_OK" in res.stdout, tail
