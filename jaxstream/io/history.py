@@ -31,9 +31,12 @@ class HistoryWriter:
     compressed storage: each trailing 2-D panel is stored as its
     rank-``tt_rank`` factor pair (the deck's "TT-friendly 2D tiles",
     p.4, applied to the pipeline's history box, p.6).  Fields whose
-    panels are too small to profit are stored raw; :meth:`read`
-    reconstructs transparently either way.  Lossy at the SVD-truncation
-    level — pick the rank from the run's accuracy budget.
+    panels are too small to profit — or whose dtype is not f32/f64 —
+    are stored raw; :meth:`read` reconstructs transparently either way.
+    Factors keep the field's own dtype (no hidden downcast).  Lossy at
+    the SVD-truncation level — pick the rank from the run's accuracy
+    budget.  On reopen the stored ``tt_rank`` attr wins over the
+    constructor argument (the store's layout is fixed at creation).
     """
 
     def __init__(self, path: str, attrs: Optional[Dict] = None,
@@ -43,9 +46,12 @@ class HistoryWriter:
             self.group = open_group(path)
             tarr = self.group["time"]
             self._len = tarr.shape[0]
-            stored = self.group.attrs.get("tt_rank")
-            if stored is not None:
-                self.tt_rank = stored
+            # The store's layout (raw 'h' vs 'h__ttA'/'h__ttB') is fixed at
+            # creation; adopt the stored rank unconditionally — including a
+            # stored None — so a reopen can never split one field across
+            # both layouts.
+            if "tt_rank" in self.group.attrs:
+                self.tt_rank = self.group.attrs["tt_rank"]
         else:
             self.group = ZarrGroup.create(
                 path, {**(attrs or {}), "conventions": "jaxstream-history-1",
@@ -74,10 +80,26 @@ class HistoryWriter:
             a = np.asarray(arr)
             r = self.tt_rank
             ny, nx = (a.shape[-2], a.shape[-1]) if a.ndim >= 2 else (0, 0)
-            if (r is not None and a.ndim >= 3
-                    and r * (ny + nx) < ny * nx):
+            # A field's layout (raw vs TT factors) is decided at its FIRST
+            # write and honored forever after — a rank/dtype change between
+            # appends or across reopens (incl. legacy stores with no stored
+            # tt_rank attr) must never split one field across both layouts.
+            if name + "__ttA" in self.group:
+                use_tt = True
+            elif name in self.group:
+                use_tt = False
+            else:
+                use_tt = (r is not None and a.ndim >= 3
+                          and a.dtype in (np.float32, np.float64)
+                          and r * (ny + nx) < ny * nx)
+            if use_tt:
+                if name + "__ttA" in self.group:
+                    r = self.group[name + "__ttA"].shape[-1]
+                    a = a.astype(self.group[name + "__ttA"].dtype, copy=False)
+                elif a.dtype not in (np.float32, np.float64):
+                    a = a.astype(np.float64)
                 lead = a.shape[:-2]
-                flat = a.reshape((-1, ny, nx)).astype(np.float32)
+                flat = a.reshape((-1, ny, nx))
                 u, s, vt = np.linalg.svd(flat, full_matrices=False)
                 rs = np.sqrt(s[:, :r])
                 A = (u[:, :, :r] * rs[:, None, :]).reshape(lead + (ny, r))

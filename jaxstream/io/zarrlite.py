@@ -26,6 +26,20 @@ __all__ = ["ZarrGroup", "ZarrArray", "open_group"]
 _FILL = {"f": 0.0, "i": 0, "u": 0, "b": False}
 
 
+def _dump_json(path: str, obj: Any) -> None:
+    """Serialize metadata exactly as zarr-python v2 does.
+
+    zarr-python's ``zarr.util.json_dumps`` uses ``indent=4``,
+    ``sort_keys=True``, ascii, ``(',', ': ')`` separators — matching it
+    byte-for-byte means stores written here are indistinguishable from
+    ones written by the real package (golden-fixture tested,
+    ``tests/test_io.py::test_zarr_golden_fixture``).
+    """
+    with open(path, "w") as fh:
+        fh.write(json.dumps(obj, indent=4, sort_keys=True,
+                            ensure_ascii=True, separators=(",", ": ")))
+
+
 def _dtype_str(dt: np.dtype) -> str:
     dt = np.dtype(dt)
     if dt.byteorder == "=":
@@ -66,11 +80,9 @@ class ZarrArray:
             "order": "C",
             "filters": None,
         }
-        with open(os.path.join(path, ".zarray"), "w") as fh:
-            json.dump(meta, fh, indent=1)
+        _dump_json(os.path.join(path, ".zarray"), meta)
         if attrs:
-            with open(os.path.join(path, ".zattrs"), "w") as fh:
-                json.dump(attrs, fh, indent=1)
+            _dump_json(os.path.join(path, ".zattrs"), attrs)
         return ZarrArray(path)
 
     # -- chunk addressing ----------------------------------------------------
@@ -133,8 +145,7 @@ class ZarrArray:
     def resize0(self, new_len: int) -> None:
         self.shape = (new_len,) + self.shape[1:]
         self.meta["shape"] = list(self.shape)
-        with open(os.path.join(self.path, ".zarray"), "w") as fh:
-            json.dump(self.meta, fh, indent=1)
+        _dump_json(os.path.join(self.path, ".zarray"), self.meta)
 
     def read(self) -> np.ndarray:
         out = np.full(self.shape, self.meta["fill_value"], dtype=self.dtype)
@@ -171,11 +182,9 @@ class ZarrGroup:
     @staticmethod
     def create(path: str, attrs: Optional[Dict[str, Any]] = None) -> "ZarrGroup":
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, ".zgroup"), "w") as fh:
-            json.dump({"zarr_format": 2}, fh)
+        _dump_json(os.path.join(path, ".zgroup"), {"zarr_format": 2})
         if attrs:
-            with open(os.path.join(path, ".zattrs"), "w") as fh:
-                json.dump(attrs, fh, indent=1)
+            _dump_json(os.path.join(path, ".zattrs"), attrs)
         return ZarrGroup(path)
 
     def create_array(self, name: str, shape, dtype, chunks=None, attrs=None):

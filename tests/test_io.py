@@ -83,6 +83,112 @@ def test_checkpoint_roundtrip(tmp_path):
     assert t10 == 6000.0
 
 
+def test_zarr_golden_fixture(tmp_path):
+    """Interop oracle: a vendored zarr-v2 store authored to the spec
+    independently of zarrlite (see its README.txt).  zarrlite must (a)
+    read it exactly and (b) re-serialize the same logical content
+    byte-for-byte — metadata formatting included."""
+    golden = os.path.join(os.path.dirname(__file__), "fixtures",
+                          "golden_zarr_v2")
+    g = open_group(golden)
+    assert g.attrs == {"step": 7, "title": "golden"}
+    h = g["h"].read()
+    expect_h = (np.arange(2 * 3 * 5, dtype="<f4").reshape(2, 3, 5) * 0.5
+                + 1000.0)
+    np.testing.assert_array_equal(h, expect_h)
+    assert h.dtype == np.dtype("<f4")
+    np.testing.assert_array_equal(g["time"].read(), [0.0, 600.0])
+    np.testing.assert_array_equal(g["count"].read(), np.arange(4))
+
+    # Re-create through zarrlite's writer; every file must be byte-equal.
+    p = str(tmp_path / "rewrite")
+    g2 = ZarrGroup.create(p, {"step": 7, "title": "golden"})
+    g2.create_array("h", (2, 3, 5), "<f4", (1, 3, 2)).write_full(expect_h)
+    g2.create_array("time", (2,), "<f8", (1,)).write_full(
+        np.array([0.0, 600.0]))
+    g2.create_array("count", (4,), "<i8", (3,)).write_full(np.arange(4))
+    def listing(root, skip=()):
+        out = {}
+        for dirpath, _, files in os.walk(root):
+            rel = os.path.relpath(dirpath, root)
+            for f in files:
+                if f in skip:
+                    continue
+                out[os.path.normpath(os.path.join(rel, f))] = os.path.join(
+                    dirpath, f)
+        return out
+
+    gold = listing(golden, skip=("README.txt",))
+    mine = listing(p)
+    assert sorted(gold) == sorted(mine)  # no extra/missing files either way
+    for rel in gold:
+        a = open(gold[rel], "rb").read()
+        b = open(mine[rel], "rb").read()
+        assert a == b, f"byte mismatch in {rel}"
+
+
+def test_history_reopen_adopts_stored_rank_layout(tmp_path):
+    """A store created raw must stay raw on reopen even if the reopening
+    writer asks for a tt_rank — the layout is fixed at creation."""
+    p = str(tmp_path / "hist_raw")
+    w = HistoryWriter(p)  # created with tt_rank=None → raw layout
+    h = np.linspace(0, 1, 6 * 64 * 64, dtype=np.float32).reshape(6, 64, 64)
+    w.append({"h": h}, 0.0)
+    w2 = HistoryWriter(p, tt_rank=8)
+    assert w2.tt_rank is None  # stored None wins
+    w2.append({"h": h * 2}, 60.0)
+    assert "h" in w2.group and "h__ttA" not in w2.group
+    assert w2.read("h").shape[0] == 2 == len(w2.times)
+
+
+def test_history_field_layout_is_sticky(tmp_path):
+    """Each field's raw-vs-TT layout is fixed at its first write: a legacy
+    store with no stored tt_rank attr (pre-TT-feature), reopened with a
+    constructor rank, must keep appending existing fields in their
+    original layout — and a dtype change between appends must not flip a
+    TT field to raw."""
+    import json as _json
+
+    p = str(tmp_path / "hist_legacy")
+    w = HistoryWriter(p)
+    h = np.linspace(0, 1, 6 * 64 * 64, dtype=np.float32).reshape(6, 64, 64)
+    w.append({"h": h}, 0.0)
+    # Simulate a pre-TT-feature store: drop the tt_rank key from .zattrs.
+    zattrs = os.path.join(p, ".zattrs")
+    attrs = _json.load(open(zattrs))
+    del attrs["tt_rank"]
+    _json.dump(attrs, open(zattrs, "w"))
+
+    w2 = HistoryWriter(p, tt_rank=8)
+    assert w2.tt_rank == 8  # no stored attr -> constructor rank kept
+    w2.append({"h": h * 2}, 60.0)   # existing field: stays raw
+    assert "h" in w2.group and "h__ttA" not in w2.group
+    assert w2.read("h").shape[0] == 2 == len(w2.times)
+
+    # New field in the same store may compress; a later f64 append must
+    # keep the TT layout (cast to the stored factor dtype), not go raw.
+    w2.append({"h": h * 3, "q": h}, 120.0)
+    assert "q__ttA" in w2.group and "q" not in w2.group
+    w2.append({"h": h * 4, "q": (h * 2).astype(np.float64)}, 180.0)
+    assert "q" not in w2.group
+    q = w2.read("q")  # record axis spans all 4 appends (0,1 are fill)
+    assert q.dtype == np.float32 and q.shape[0] == 4
+    assert np.max(np.abs(q[3] - 2 * h)) < 1e-3 * np.max(np.abs(h))
+
+
+def test_history_tt_preserves_dtype(tmp_path):
+    """f64 history fields compress to f64 factors — no silent f32 cast."""
+    p = str(tmp_path / "hist_f64")
+    w = HistoryWriter(p, tt_rank=8)
+    h = (1000.0 + np.linspace(0, 1, 6 * 64 * 64)).reshape(6, 64, 64)
+    assert h.dtype == np.float64
+    w.append({"h": h}, 0.0)
+    assert w.group["h__ttA"].dtype == np.float64
+    got = w.read("h")
+    assert got.dtype == np.float64
+    assert np.max(np.abs(got[0] - h)) < 1e-9 * np.max(np.abs(h))
+
+
 def test_history_tt_compression_roundtrip(tmp_path):
     """TT-compressed history: factors stored instead of full panels,
     reconstruction at the SVD truncation floor, raw fallback for small
