@@ -28,7 +28,8 @@ import jax
 
 __all__ = [
     "HardwareRoof", "TPU_V4_CLASS", "TPU_V5E", "TPU_V5P",
-    "cost_analysis", "roofline", "Roofline", "StepTimer", "trace",
+    "cost_analysis", "analytic_cov_step_cost", "roofline", "Roofline",
+    "StepTimer", "trace",
 ]
 
 
@@ -47,8 +48,18 @@ class HardwareRoof:
 
 # The deck's example roofline (p.19) and the chips this repo targets.
 TPU_V4_CLASS = HardwareRoof("TPU v4 class (deck p.19)", 900.0, 275.0)
-TPU_V5E = HardwareRoof("TPU v5e", 819.0, 197.0)       # bf16 peak; f32 ~ half
+TPU_V5E = HardwareRoof("TPU v5e", 819.0, 197.0)       # bf16 MXU peak
 TPU_V5P = HardwareRoof("TPU v5p", 2765.0, 459.0)
+# VPU (elementwise f32) roofs: the FV stencil kernels never touch the
+# MXU, so their compute roof is the vector unit.  The nominal FMA peak is
+# ~(8, 128) lanes x 2 (FMA) x ~1.7 GHz ~ 3.5 TFLOP/s on v5e, but the
+# stencil op mix is ~half selects/abs/min/max (limiters, upwinding) which
+# occupy a full VPU slot for 1 flop — the *effective* elementwise roof
+# for this mix is ~2.6 TFLOP/s.  DESIGN.md's stage-kernel bisection
+# sustains ~2.0 TFLOP/s in the RHS window (~77% of this roof, "at or
+# near the VPU roofline").  v5p scaled by clock/core ratio.
+TPU_V5E_VPU = HardwareRoof("TPU v5e VPU f32 stencil-mix", 819.0, 2.6)
+TPU_V5P_VPU = HardwareRoof("TPU v5p VPU f32 stencil-mix", 2765.0, 5.5)
 
 
 def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
@@ -56,6 +67,13 @@ def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
 
     Returns ``{"flops": F, "bytes": B, "ai": F/B}`` from the compiled
     executable — post-fusion, so it reflects real HBM traffic estimates.
+
+    .. warning:: **Excludes Pallas kernels.**  XLA cannot see inside
+       custom calls, so a program whose math lives in Pallas kernels
+       reports near-zero flops here (the round-1 bench printed a roofline
+       ~200x off this way).  For the fused SWE steppers use
+       :func:`analytic_cov_step_cost` — the kernels are static stencils
+       with countable work.
     """
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     compiled = jitted.lower(*args, **kwargs).compile()
@@ -68,6 +86,56 @@ def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
         "flops": flops,
         "bytes": nbytes,
         "ai": flops / nbytes if nbytes else float("inf"),
+    }
+
+
+# Itemized per-cell VPU-op counts for the covariant fused SSPRK3 stage
+# kernel (ops/pallas/swe_cov.py::rhs_core_cov + the in-kernel RK combine),
+# counting each elementwise add/mul/min/max/abs/sign/select/rsqrt as one
+# flop.  Derivation (per interior cell, per stage):
+#   continuity, per direction (x2):
+#     band frame consumed entries (rho2, rsqrt, fg_aa, fg_ab)      ~5
+#     face-average velocities + flux-form contraction               7
+#     upwind flux (max/min selects + 2 mul + add)                   5
+#     PLR reconstruction = 4 + limiter slope (see _RECON_FLOPS)
+#   divergence + inv_sqrtg scaling                                  9
+#   momentum (band frame ~10, u^i raise 6, KE+Bernoulli 7, grads 8,
+#             Coriolis rz 5, abs-vorticity 4, tendencies 4)        44
+#   in-kernel SSPRK3 combine (axpy on 3 fields)                    12
+# Totals (MC): 2*(17+19) + 9 + 44 + 12 = 137 flops/cell/stage — the
+# DESIGN.md stage-kernel bisection measured "~150 flops/cell" at
+# ~2 TFLOP/s sustained; treat the count as +-15%.
+_RECON_FLOPS = {"none": 6, "minmod": 14, "mc": 19, "vanleer": 16}
+
+
+def analytic_cov_step_cost(n: int, *, limiter: str = "mc",
+                           dtype_bytes: int = 4, stages: int = 3,
+                           n_faces: int = 6) -> Dict[str, float]:
+    """Analytic flops/bytes for ONE fused covariant SSPRK3 step at C``n``.
+
+    Pallas custom calls are invisible to :func:`cost_analysis`; this is
+    the hand-counted replacement for the production stepper
+    (``make_fused_ssprk3_cov_compact``).  Bytes model the compact
+    interior-only carry: per stage each face reads its 3-field carry,
+    the 3-field y0 (stages 2-3), the orography, and writes 3 fields —
+    amortized ~9 field-passes/stage — plus the strip traffic
+    (~4*n*(halo+...) per face, <1% at C384, folded into the field count).
+
+    Returns ``{"flops", "bytes", "ai", "flops_per_cell_stage"}``.
+    """
+    recon = _RECON_FLOPS.get(limiter, _RECON_FLOPS["mc"])
+    per_cell_stage = 2 * (17 + recon) + 9 + 44 + 12
+    cells = n_faces * n * n
+    flops = float(per_cell_stage * cells * stages)
+    # field passes: stage1 reads y(3)+b(1) writes 3 = 7;
+    # stages 2,3 read y(3)+y0(3)+b(1) write 3 = 10  -> 27 per 3 stages.
+    field_passes = 7 + 10 * (stages - 1)
+    nbytes = float(field_passes * cells * dtype_bytes)
+    return {
+        "flops": flops,
+        "bytes": nbytes,
+        "ai": flops / nbytes,
+        "flops_per_cell_stage": float(per_cell_stage),
     }
 
 
@@ -93,7 +161,21 @@ class Roofline:
 
     @property
     def bound(self) -> str:
+        """Chart-side classification: which side of the ridge the AI is on."""
         return "memory" if self.ai < self.roof.ridge else "compute"
+
+    @property
+    def binding(self) -> str:
+        """Which resource the *measured* run leans on harder.
+
+        Utilization-based (achieved/peak per resource) — the right label
+        when DMA and compute overlap: a kernel at 57% of the VPU roof and
+        36% of HBM is compute-bound even if its AI sits left of the
+        ridge.  Matches DESIGN.md's stage-kernel bisection methodology.
+        """
+        cu = self.achieved_tflops / self.roof.peak_tflops
+        mu = self.achieved_gbps / self.roof.hbm_gbps
+        return "compute" if cu >= mu else "memory"
 
     @property
     def roof_tflops(self) -> float:
@@ -106,13 +188,16 @@ class Roofline:
         return self.achieved_tflops / self.roof_tflops if self.roof_tflops else 0.0
 
     def report(self) -> str:
+        cu = 100 * self.achieved_tflops / self.roof.peak_tflops
+        mu = 100 * self.achieved_gbps / self.roof.hbm_gbps
         return (
             f"roofline [{self.roof.name}]: AI={self.ai:.3f} flops/byte "
-            f"(ridge {self.roof.ridge:.1f} -> {self.bound}-bound); "
-            f"achieved {self.achieved_tflops:.2f} TFLOP/s, "
-            f"{self.achieved_gbps:.0f} GB/s; "
-            f"roof at this AI {self.roof_tflops:.2f} TFLOP/s "
-            f"({100 * self.efficiency:.0f}% of attainable)"
+            f"(ridge {self.roof.ridge:.1f}); "
+            f"achieved {self.achieved_tflops:.2f} TFLOP/s ({cu:.0f}% of "
+            f"compute roof), {self.achieved_gbps:.0f} GB/s ({mu:.0f}% of "
+            f"HBM) -> {self.binding}-bound; "
+            f"attainable at this AI {self.roof_tflops:.2f} TFLOP/s "
+            f"({100 * self.efficiency:.0f}%)"
         )
 
 

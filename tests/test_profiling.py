@@ -51,6 +51,34 @@ def test_roofline_from_measurement():
     assert 0.0 <= r.efficiency
 
 
+def test_analytic_cov_step_cost_matches_design_bisection():
+    """The hand count must agree with DESIGN.md's measured stage-kernel
+    bisection: ~150 flops/cell/stage (+-15%) and a byte model whose DMA
+    time at C384 lands near the measured ~40 us/stage machinery floor."""
+    from jaxstream.utils.profiling import TPU_V5E_VPU, analytic_cov_step_cost
+
+    c = analytic_cov_step_cost(384)
+    assert 120 <= c["flops_per_cell_stage"] <= 175
+    cells = 6 * 384 * 384
+    assert c["flops"] == pytest.approx(
+        c["flops_per_cell_stage"] * cells * 3)
+    # ~9 field passes/stage * 4 B -> per-stage DMA at 819 GB/s in the
+    # 35-55 us window (the measured floor is ~40 us/stage).
+    per_stage_bytes = c["bytes"] / 3
+    dma_us = per_stage_bytes / 819e9 * 1e6
+    assert 25 < dma_us < 60
+    # Limiter choice moves the count in the right direction.
+    assert (analytic_cov_step_cost(384, limiter="none")["flops"]
+            < c["flops"])
+    # At the measured ~3050 steps/s the binding label must be compute
+    # (VPU), matching the bisection — not the ridge-side "memory" label.
+    r = Roofline(c["flops"], c["bytes"], seconds=1.0 / 3050.0,
+                 roof=TPU_V5E_VPU)
+    assert r.binding == "compute"
+    assert 1.0 < r.achieved_tflops < 3.5
+    assert "compute-bound" in r.report()
+
+
 def test_step_timer_discards_compile():
     timer = StepTimer(discard=1)
 
