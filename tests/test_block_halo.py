@@ -138,6 +138,7 @@ print('OK block exchange == reference')
     assert "OK block exchange == reference" in out
 
 
+@pytest.mark.slow
 def test_block_sharded_stepper_matches_single_24dev():
     """Full SWE SSPRK3 step on the 24-device block mesh == single device."""
     out = _run_sub(r"""

@@ -7,6 +7,8 @@ The covariant halo exchange itself is exact relative to the Cartesian
 route (first test).
 """
 
+import pytest
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -68,6 +70,7 @@ def _l2_height_error(grid, model, state0, out):
                          / np.sum(area * h0 ** 2)))
 
 
+@pytest.mark.slow
 def test_tc2_error_parity_with_cartesian():
     """Steady-state TC2: both formulations sit at the same truncation level."""
     n = 24
@@ -96,6 +99,7 @@ def test_tc2_error_parity_with_cartesian():
     assert np.max(np.abs(hv - hc)) < 5e-3 * scale
 
 
+@pytest.mark.slow
 def test_tc5_mass_conservation_and_stability():
     n = 24
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
@@ -130,7 +134,6 @@ def test_to_cartesian_roundtrip():
 
 
 def test_shard_map_path_raises_clearly():
-    import pytest
 
     from jaxstream.parallel.sharded_model import make_sharded_stepper
 
@@ -140,9 +143,9 @@ def test_shard_map_path_raises_clearly():
         make_sharded_stepper(cov, None, None, 60.0)
 
 
+@pytest.mark.slow
 def test_cov_pallas_rhs_parity():
     """Fused covariant kernel vs the jnp oracle (interpret mode, f32)."""
-    import pytest
 
     for case in ("tc2", "tc5"):
         n = 16
@@ -189,6 +192,7 @@ def test_cov_pallas_step_conserves_mass():
     assert abs(m1 - m0) / abs(m0) < 2e-6, (m1 - m0) / m0
 
 
+@pytest.mark.slow
 def test_cov_fused_step_parity():
     """Fused in-kernel-exchange covariant stepper vs the jnp oracle."""
     n = 12
@@ -266,6 +270,7 @@ def test_cov_routers_bitwise_equal_loop_oracle():
                                       g0[:, R + 2 : R + 4], err_msg="sym W/E")
 
 
+@pytest.mark.slow
 def test_cov_compact_vs_extended_bitwise():
     """The interior-only (compact) stepper is bitwise-identical to the
     extended-carry stepper: same arithmetic, different HBM layout."""
@@ -297,6 +302,7 @@ def test_cov_compact_vs_extended_bitwise():
     assert np.array_equal(np.asarray(yc["strips_we"]), np.asarray(we))
 
 
+@pytest.mark.slow
 def test_cov_fused_step_conserves_mass():
     n = 16
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
@@ -318,6 +324,7 @@ def test_cov_fused_step_conserves_mass():
     assert abs(m1 - m0) / abs(m0) < 2e-6, (m1 - m0) / m0
 
 
+@pytest.mark.slow
 def test_cov_nbr_step_parity():
     """Neighbor-read fused stepper (experimental) vs the jnp oracle."""
     from jaxstream.ops.fv import embed_interior
@@ -345,6 +352,7 @@ def test_cov_nbr_step_parity():
         np.testing.assert_allclose(b, a, atol=2e-4 * scale, err_msg=k)
 
 
+@pytest.mark.slow
 def test_cov_hyperdiffusion_galewsky_smoke():
     """nu4 > 0 path: del^4 filter with covariant-exchange refill runs and
     damps; Galewsky is the IC family that needs it."""
@@ -371,6 +379,7 @@ def test_cov_hyperdiffusion_galewsky_smoke():
     assert roughness(h1) < roughness(h0)
 
 
+@pytest.mark.slow
 def test_cov_ppm_kernel_and_fused_step():
     """PPM reconstruction (halo=3) through the covariant kernel paths."""
     grid = build_grid(12, halo=3, radius=EARTH_RADIUS, dtype=jnp.float32)
@@ -395,6 +404,7 @@ def test_cov_ppm_kernel_and_fused_step():
     assert np.all(np.isfinite(np.asarray(y["h"])))
 
 
+@pytest.mark.slow
 def test_cov_fused_nu4_matches_classic():
     """The two-kernel del^4 fused stage pair tracks the classic path
     (fill(lap(fill(lap)))) with stored metrics) to op-reordering
@@ -426,6 +436,7 @@ def test_cov_fused_nu4_matches_classic():
         np.testing.assert_allclose(b, a, atol=5e-4 * scale, err_msg=k)
 
 
+@pytest.mark.slow
 def test_cov_mega_step_parity():
     """Whole-step single-kernel stepper (experimental; measured slower
     than the compact 3-kernel stepper at C384 — kept as the documented
@@ -457,6 +468,7 @@ def test_cov_mega_step_parity():
         np.testing.assert_allclose(b, a, atol=1e-6 * scale, err_msg=k)
 
 
+@pytest.mark.slow
 def test_cov_fused_nu4_ppm_combination():
     """PPM reconstruction (halo=3) and the del^4 stage pair compose."""
     from jaxstream.physics.initial_conditions import galewsky

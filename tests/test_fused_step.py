@@ -19,6 +19,7 @@ from jaxstream.physics.initial_conditions import williamson_tc2, williamson_tc5
 
 @pytest.mark.parametrize("case", ["tc2", "tc5"])
 @pytest.mark.parametrize("in_kernel", [False, True])
+@pytest.mark.slow
 def test_fused_step_parity(case, in_kernel):
     n = 12
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)

@@ -38,6 +38,7 @@ def test_lazy_matches_eager(dtype, rtol):
         np.testing.assert_allclose(b, a, atol=rtol * scale, err_msg=name)
 
 
+@pytest.mark.slow
 def test_swe_step_parity_lazy_vs_eager():
     n = 16
     kw = dict(halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)

@@ -25,6 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_pod_step_matches_reference():
     # (Guarded by the communicate() timeout below; no pytest-timeout in
     # this image.)

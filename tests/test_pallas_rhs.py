@@ -42,6 +42,7 @@ def test_rhs_parity(case):
         np.testing.assert_allclose(b, a, atol=5e-5 * scale, err_msg=k)
 
 
+@pytest.mark.slow
 def test_step_parity_short_run():
     n = 12
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)

@@ -8,6 +8,7 @@ oracle to f32 op-reordering roundoff, and conserve mass to roundoff.
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,7 @@ def _setup(n=16):
     return grid, model, model.initial_state(h_ext, v_ext)
 
 
+@pytest.mark.slow
 def test_sharded_cov_step_matches_oracle():
     grid, model, s0 = _setup()
     dt = 600.0
@@ -91,6 +93,7 @@ def test_sharded_cov_collectives_in_hlo():
     assert "collective-permute" in txt
 
 
+@pytest.mark.slow
 def test_sharded_cov_nu4_matches_classic():
     """del^4 on the explicit shard path (exchange - lap - exchange - lap
     per stage, closed-form metric) tracks the classic single-device path
@@ -129,6 +132,7 @@ def test_sharded_cov_nu4_matches_classic():
         np.testing.assert_allclose(b, a, atol=5e-4 * scale, err_msg=k)
 
 
+@pytest.mark.slow
 def test_covariant_gspmd_blocked_mesh_parity():
     """Blocked (panel, y, x) meshes run the covariant model via GSPMD;
     results match single-device to f32 op-reordering roundoff."""

@@ -7,6 +7,8 @@ segments, raw intra-panel neighbor strips, per-block seam normals, and
 the per-block Pallas RHS with runtime coordinates.
 """
 
+import pytest
+
 import os
 import subprocess
 import sys
@@ -14,6 +16,7 @@ import sys
 _WORKER = os.path.join(os.path.dirname(__file__), "cov_block_worker.py")
 
 
+@pytest.mark.slow
 def test_cov_block_24_devices_matches_oracle():
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     res = subprocess.run(

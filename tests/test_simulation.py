@@ -68,6 +68,7 @@ def test_unknown_ic_rejected():
         Simulation(_cfg(model={"initial_condition": "nope"}))
 
 
+@pytest.mark.slow
 def test_history_and_checkpoint_resume(tmp_path):
     cfg = _cfg(tmp_path)
     sim = Simulation(cfg)
@@ -90,6 +91,7 @@ def test_history_and_checkpoint_resume(tmp_path):
     assert sim2.step_count == 6
 
 
+@pytest.mark.slow
 def test_sharded_matches_single_device():
     ref = Simulation(_cfg())
     ref.run()
@@ -104,6 +106,7 @@ def test_sharded_matches_single_device():
         )
 
 
+@pytest.mark.slow
 def test_lazy_grid_shard_map_matches_single_device():
     """The TPU-production combination: lazy metrics inside shard_map."""
     grid = {"n": 12, "halo": 2, "dtype": "float64", "metrics": "lazy"}
@@ -162,6 +165,7 @@ def test_yaml_exponent_literals_coerce_to_float():
         load_config("physics:\n  hyperdiffusion: banana\n")
 
 
+@pytest.mark.slow
 def test_simulation_uses_fused_stepper_for_pallas_swe():
     """Single-device pallas SWE sims run the fused extended-state path
     and match the classic jnp path to f32 roundoff."""

@@ -5,6 +5,8 @@ cites (Danis et al. 2024): the rank-r step-and-truncate evolution must
 track the dense integration for smooth fields at modest rank.
 """
 
+import pytest
+
 import numpy as np
 
 import jax
@@ -42,6 +44,7 @@ def _dense_step(dt, nu):
 
 
 @pytest.mark.parametrize("rank", [16])
+@pytest.mark.slow
 def test_tt_swe_tracks_dense(rank):
     """Error stays at the rank-truncation level: ~1e-4 after one step,
     a few percent after 60 (the radiating circular gravity wave is
@@ -90,6 +93,7 @@ def test_tt_swe_conserves_mass():
     assert abs(h1 - h0) / abs(h0) < 1e-6, (h0, h1)
 
 
+@pytest.mark.slow
 def test_tt_swe_exact_and_sketch_agree():
     """Exact Gram rounding and the randomized-sketch rounding of the
     quadratic terms stay within the truncation floor of each other."""
