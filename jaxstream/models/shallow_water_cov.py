@@ -116,7 +116,58 @@ class CovariantShallowWater(SWEBase):
         return {"h": state["h"], "u": state["u"],
                 "strips_sn": sn, "strips_we": we}
 
-    def make_fused_step(self, dt: float, compact: bool = True):
+    def encode_carry(self, y: State, carry_dtype=None,
+                     h_offset: float = 0.0, h_scale: float = 1.0,
+                     u_scale: float = 1.0) -> State:
+        """Cast a :meth:`compact_state` carry to the stepper's storage
+        encoding (per-field dtype; h stored as anomaly about
+        ``h_offset``, u divided by ``u_scale``)."""
+        import jax.numpy as jnp
+
+        if carry_dtype is None:
+            if h_offset or h_scale != 1.0 or u_scale != 1.0:
+                # f32 storage with an anomaly/scale encoding is legal in
+                # the stepper — encode it rather than silently skipping.
+                carry_dtype = jnp.float32
+            else:
+                return y
+        dt_h, dt_u = (tuple(carry_dtype)
+                      if isinstance(carry_dtype, (tuple, list))
+                      else (carry_dtype,) * 2)
+        def enc(x, off, scale, dt):
+            if off:
+                x = x - jnp.float32(off)
+            if scale != 1.0:
+                x = x / jnp.float32(scale)
+            if jnp.issubdtype(jnp.dtype(dt), jnp.integer):
+                return jnp.round(x).astype(dt)
+            return x.astype(dt)
+
+        out = dict(y)
+        out["h"] = enc(y["h"], h_offset, h_scale, dt_h)
+        out["u"] = enc(y["u"], 0.0, u_scale, dt_u)
+        return out
+
+    def decode_carry(self, y: State, h_offset: float = 0.0,
+                     h_scale: float = 1.0, u_scale: float = 1.0) -> State:
+        """Inverse of :meth:`encode_carry`: back to absolute f32."""
+        import jax.numpy as jnp
+
+        def dec(x, off, scale):
+            x = x.astype(jnp.float32)
+            if scale != 1.0:
+                x = x * jnp.float32(scale)
+            return x + jnp.float32(off) if off else x
+
+        out = dict(y)
+        out["h"] = dec(y["h"], h_offset, h_scale)
+        out["u"] = dec(y["u"], 0.0, u_scale)
+        return out
+
+    def make_fused_step(self, dt: float, compact: bool = True,
+                        carry_dtype=None, h_offset: float = 0.0,
+                        h_scale: float = 1.0, u_scale: float = 1.0,
+                        _ablate_seam: bool = False):
         """Fused SSPRK3: one Pallas kernel per stage (halo fill in-kernel,
         edge rotations/symmetrization on a packed strip carry,
         :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
@@ -124,13 +175,24 @@ class CovariantShallowWater(SWEBase):
         :meth:`compact_state`; ``compact=False`` keeps the extended-state
         carry from :meth:`extend_state` ``(with_strips=True)``.
         ``nu4 > 0`` (the Galewsky filter) uses the two-kernel del^4
-        stage pair, compact carry only.  Requires ``backend='pallas'``."""
+        stage pair, compact carry only.  Requires ``backend='pallas'``.
+
+        ``carry_dtype`` (compact only): HBM storage dtype of the h/u
+        carry — cast the :meth:`compact_state` output to match.  bf16
+        halves carry DMA; compute stays f32 (accuracy trade measured in
+        DESIGN.md).  ``_ablate_seam`` disables seam imposition — for
+        perf measurement only (breaks conservation)."""
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
         interpret = self.backend == "pallas_interpret"
         if self.nu4 != 0.0:
             if not compact:
                 raise ValueError("nu4 > 0 requires the compact carry")
+            if (carry_dtype is not None or h_offset or h_scale != 1.0
+                    or u_scale != 1.0 or _ablate_seam):
+                raise ValueError("carry_dtype/h_offset/u_scale/"
+                                 "_ablate_seam are not supported on the "
+                                 "nu4 stage pair")
             from ..ops.pallas.swe_cov import make_fused_ssprk3_cov_nu4
 
             return make_fused_ssprk3_cov_nu4(
@@ -141,9 +203,23 @@ class CovariantShallowWater(SWEBase):
         from ..ops.pallas.swe_cov import (
             make_fused_ssprk3_cov_compact, make_fused_ssprk3_cov_inkernel)
 
-        mk = (make_fused_ssprk3_cov_compact if compact
-              else make_fused_ssprk3_cov_inkernel)
-        return mk(
+        if compact:
+            import jax.numpy as jnp
+
+            return make_fused_ssprk3_cov_compact(
+                self.grid, self.gravity, self.omega, dt, self.b_ext,
+                scheme=self.scheme, limiter=self.limiter,
+                interpret=interpret,
+                carry_dtype=(jnp.float32 if carry_dtype is None
+                             else carry_dtype),
+                h_offset=h_offset, h_scale=h_scale, u_scale=u_scale,
+                seam=not _ablate_seam,
+            )
+        if (carry_dtype is not None or h_offset or h_scale != 1.0
+                or u_scale != 1.0 or _ablate_seam):
+            raise ValueError("carry_dtype/h_offset/u_scale/_ablate_seam "
+                             "require the compact carry")
+        return make_fused_ssprk3_cov_inkernel(
             self.grid, self.gravity, self.omega, dt, self.b_ext,
             scheme=self.scheme, limiter=self.limiter,
             interpret=interpret,

@@ -214,7 +214,8 @@ def sym_edge_normals(grid, u_ext):
 
 
 def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
-                 n, halo, d, radius, gravity, omega, recon):
+                 n, halo, d, radius, gravity, omega, recon,
+                 seam_scratch=None, sym_prescaled=False):
     """One face's covariant-SWE right-hand side as traceable kernel math.
 
     ``fz = (c0z, cxz, cyz)`` are the face frame's z-components (scalars,
@@ -243,15 +244,31 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     ubb = 0.5 * (ub[h0:h1, h0 - 1:h1] + ub[h0:h1, h0:h1 + 1])
     ux = Fx["fg_aa"] * uba + Fx["fg_ab"] * ubb      # sqrtg u^a, (n, n+1)
     if sym_we is not None:
-        # Seam imposition costs ~29 us/step at C384 (measured by
-        # disabling it); concat assembly instead of iota-selects was
-        # tried and is no cheaper (and Mosaic rejects the misaligned
-        # lane-dim concat outright).
-        sgW = _fast_frame(xfr[:, h0:h0 + 1], yc[h0:h1], radius)["sqrtg"]
-        sgE = _fast_frame(xfr[:, h1:h1 + 1], yc[h0:h1], radius)["sqrtg"]
-        colx = jax.lax.broadcasted_iota(jnp.int32, (n, n + 1), 1)
-        ux = jnp.where(colx == 0, sgW * sym_we[:, 0:1], ux)
-        ux = jnp.where(colx == n, sgE * sym_we[:, 1:2], ux)
+        # Seam imposition: replace the two boundary flux-velocity
+        # columns/rows with the symmetrized-edge values.  The in-kernel
+        # edge-sqrtg evals are tiny (n, 1)-shaped op chains — expensive
+        # per-op on the VPU — so the fused path pre-scales the sym rows
+        # in the strip ROUTER (vectorized across faces, sym_prescaled)
+        # and the kernel only merges.  Merge via VMEM scratch ref
+        # slice-stores when provided; iota-select otherwise (concat
+        # assembly was no cheaper, misaligned lane-dim concat and
+        # value-level dynamic_update_slice are rejected by Mosaic).
+        if sym_prescaled:
+            uW, uE = sym_we[:, 0:1], sym_we[:, 1:2]
+        else:
+            sgW = _fast_frame(xfr[:, h0:h0 + 1], yc[h0:h1], radius)["sqrtg"]
+            sgE = _fast_frame(xfr[:, h1:h1 + 1], yc[h0:h1], radius)["sqrtg"]
+            uW, uE = sgW * sym_we[:, 0:1], sgE * sym_we[:, 1:2]
+        if seam_scratch is not None:
+            sx = seam_scratch[0]
+            sx[:, :] = ux
+            sx[:, 0:1] = uW
+            sx[:, n:n + 1] = uE
+            ux = sx[:, :]
+        else:
+            colx = jax.lax.broadcasted_iota(jnp.int32, (n, n + 1), 1)
+            ux = jnp.where(colx == 0, uW, ux)
+            ux = jnp.where(colx == n, uE, ux)
     qL, qR = recon(hf[h0:h1, :], -1)
     fx = jnp.maximum(ux, 0.0) * qL + jnp.minimum(ux, 0.0) * qR
 
@@ -260,11 +277,22 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     vbb = 0.5 * (ub[h0 - 1:h1, h0:h1] + ub[h0:h1 + 1, h0:h1])
     uy = Fy["fg_ab"] * vba + Fy["fg_bb"] * vbb      # sqrtg u^b, (n+1, n)
     if sym_sn is not None:
-        sgS = _fast_frame(xr[:, h0:h1], yfc[h0:h0 + 1], radius)["sqrtg"]
-        sgN = _fast_frame(xr[:, h0:h1], yfc[h1:h1 + 1], radius)["sqrtg"]
-        rowy = jax.lax.broadcasted_iota(jnp.int32, (n + 1, n), 0)
-        uy = jnp.where(rowy == 0, sgS * sym_sn[0:1, :], uy)
-        uy = jnp.where(rowy == n, sgN * sym_sn[1:2, :], uy)
+        if sym_prescaled:
+            uS, uN = sym_sn[0:1, :], sym_sn[1:2, :]
+        else:
+            sgS = _fast_frame(xr[:, h0:h1], yfc[h0:h0 + 1], radius)["sqrtg"]
+            sgN = _fast_frame(xr[:, h0:h1], yfc[h1:h1 + 1], radius)["sqrtg"]
+            uS, uN = sgS * sym_sn[0:1, :], sgN * sym_sn[1:2, :]
+        if seam_scratch is not None:
+            sy = seam_scratch[1]
+            sy[:, :] = uy
+            sy[0:1, :] = uS
+            sy[n:n + 1, :] = uN
+            uy = sy[:, :]
+        else:
+            rowy = jax.lax.broadcasted_iota(jnp.int32, (n + 1, n), 0)
+            uy = jnp.where(rowy == 0, uS, uy)
+            uy = jnp.where(rowy == n, uN, uy)
     qL, qR = recon(hf[:, h0:h1], -2)
     fy = jnp.maximum(uy, 0.0) * qL + jnp.minimum(uy, 0.0) * qR
 
@@ -1028,7 +1056,7 @@ def pack_strips_cov_split(h_int, u_int, n: int, halo: int):
     return sn, we
 
 
-def make_cov_strip_router_split(grid):
+def make_cov_strip_router_split(grid, prescale_sym: bool = False):
     """Linear router over the split-orientation strip layout.
 
     ``route(strips_sn, strips_we) -> (ghosts_sn, ghosts_we)`` with
@@ -1038,6 +1066,11 @@ def make_cov_strip_router_split(grid):
     algebra as :func:`make_cov_strip_router_linear` (bitwise-identical
     ghost/sym values); only the storage orientation differs, so the stage
     kernel never transposes.
+
+    ``prescale_sym``: multiply the sym rows by the static edge sqrtg
+    here (vectorized over faces) so the stage kernel imposes them
+    directly — the in-kernel (n, 1)-shaped sqrtg evals were measured at
+    several us/stage of VPU time (``rhs_core_cov`` ``sym_prescaled``).
     """
     import numpy as np
 
@@ -1092,6 +1125,21 @@ def make_cov_strip_router_split(grid):
     sym_tables = _pair_sym_tables(grid)
     adj_k = [h - 1, 0]          # placed edge-adjacent row: S/W flip, N/E not
 
+    sym_scale = None
+    if prescale_sym:
+        # Static edge sqrtg rows in [S, N, W, E] order — identical for
+        # all faces (the equiangular metric is face-independent), same
+        # closed forms the kernel would otherwise evaluate per stage.
+        x_row, xf_row, x_col, xf_col, _ = coord_rows(n, h)
+        h0, h1 = h, h + n
+        r = float(grid.radius)
+        sgS = _fast_frame(x_row[:, h0:h1], xf_col[h0:h0 + 1], r)["sqrtg"]
+        sgN = _fast_frame(x_row[:, h0:h1], xf_col[h1:h1 + 1], r)["sqrtg"]
+        sgW = _fast_frame(xf_row[:, h0:h0 + 1], x_col[h0:h1], r)["sqrtg"]
+        sgE = _fast_frame(xf_row[:, h1:h1 + 1], x_col[h0:h1], r)["sqrtg"]
+        sym_scale = jnp.stack([sgS.reshape(n), sgN.reshape(n),
+                               sgW.reshape(n), sgE.reshape(n)])[None]
+
     def route(strips_sn, strips_we):
         s_src = jnp.concatenate(
             [strips_sn.reshape(6 * 6 * h, n),
@@ -1117,6 +1165,8 @@ def make_cov_strip_router_split(grid):
             [G_sn[2][:, 0, adj_k[0]], G_sn[2][:, 1, adj_k[1]],
              G_we[2][:, 0, adj_k[0]], G_we[2][:, 1, adj_k[1]]], axis=1)
         sym = _pair_symmetrize(I_u, gadj_a, gadj_b, sym_tables)
+        if sym_scale is not None:
+            sym = sym * sym_scale
 
         gsn = jnp.concatenate(
             [jnp.concatenate([g.reshape(6, 2 * h, n) for g in G_sn], axis=1),
@@ -1208,6 +1258,12 @@ def make_cov_stage_compact(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    carry_dtype=jnp.float32,
+    h_offset: float = 0.0,
+    h_scale: float = 1.0,
+    u_scale: float = 1.0,
+    seam: bool = True,
+    sym_prescaled: bool = False,
 ):
     """One fused covariant RK stage over interior-only state.
 
@@ -1218,6 +1274,21 @@ def make_cov_stage_compact(
     ``gwe`` per :func:`make_cov_strip_router_split`.  Returns
     ``(h, u, strips_sn, strips_we)``.  No transposes anywhere in the
     kernel: every strip read/write is in its storage orientation.
+
+    ``carry_dtype``: HBM storage dtype of the prognostic carry — one
+    dtype for both fields or ``(h_dtype, u_dtype)``.  Compute is always
+    f32 in-VMEM; strips stay f32.  16-bit storage halves that field's
+    carry DMA — see DESIGN.md for the measured speed/accuracy ladder.
+    ``h_offset`` stores h as an anomaly about a static offset (the
+    stored value is ``h - h_offset``), shrinking 16-bit quantization by
+    the ratio ``|h| / |h - h_offset|`` — the RK combine is affine with
+    coefficients summing to 1, so anomalies combine exactly.
+    ``u_scale`` stores u divided by a static scale (use ~grid.radius to
+    bring covariant components to O(wind speed)) so ``float16`` storage
+    neither overflows nor wastes exponent range; fp16's 10-bit mantissa
+    then makes u quantization ~8x finer than bf16.  ``seam=False``
+    ablates the symmetrized-seam imposition (measurement only: breaks
+    cross-panel conservation).
     """
     import numpy as np
 
@@ -1230,6 +1301,49 @@ def make_cov_stage_compact(
     frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
     with_y0 = a != 0.0
     h = halo
+    cdt_h, cdt_u = ((jnp.dtype(carry_dtype[0]), jnp.dtype(carry_dtype[1]))
+                    if isinstance(carry_dtype, (tuple, list))
+                    else (jnp.dtype(carry_dtype),) * 2)
+    h_offset = float(h_offset)
+    with_off = h_offset != 0.0
+    if with_off and ((with_y0 and abs(a + b - 1.0) > 1e-9)
+                     or (not with_y0 and b != 1.0)):
+        raise ValueError("h_offset needs stage coefficients summing to 1 "
+                         "(anomaly combine is only exact then); got "
+                         f"a={a}, b={b}")
+
+    u_scale = float(u_scale)
+    h_scale = float(h_scale)
+    with_scale = u_scale != 1.0
+    with_hscale = h_scale != 1.0
+
+    def f32h(x):
+        # jnp scalars must be born inside the kernel trace (a captured
+        # module-level constant is rejected by pallas_call).
+        x = x if cdt_h == jnp.float32 else x.astype(jnp.float32)
+        if with_hscale:
+            x = x * jnp.float32(h_scale)
+        return x + jnp.float32(h_offset) if with_off else x
+
+    def f32u(x):
+        x = x if cdt_u == jnp.float32 else x.astype(jnp.float32)
+        return x * jnp.float32(u_scale) if with_scale else x
+
+    def store(x, cdt):
+        """Round-to-nearest for integer storage (truncation toward zero
+        would bias every increment); plain cast for float storage.
+
+        Rounding via the magic-constant trick ``(x + 1.5*2^23) - 1.5*2^23``
+        (exact round-to-nearest-even for |x| < 2^22, which the int16
+        encodings guarantee by construction): two VPU adds, measured
+        ~2x cheaper than ``lax.round``'s lowering.
+        """
+        if cdt == jnp.float32:
+            return x
+        if jnp.issubdtype(cdt, jnp.integer):
+            c = jnp.float32(1.5 * 2.0**23)
+            return ((x + c) - c).astype(cdt)
+        return x.astype(cdt)
     fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1)
 
     def kernel(*refs):
@@ -1244,40 +1358,57 @@ def make_cov_stage_compact(
 
         gsn = gsn_ref[0]
         gwe = gwe_ref[0]
-        hf = fill_ghosts(scratch[0], hc_ref[0], gsn, gwe, 0)
-        ua = fill_ghosts(scratch[1], uc_ref[0, 0], gsn, gwe, 1)
-        ub = fill_ghosts(scratch[2], uc_ref[1, 0], gsn, gwe, 2)
+        hf = fill_ghosts(scratch[0], f32h(hc_ref[0]), gsn, gwe, 0)
+        ua = fill_ghosts(scratch[1], f32u(uc_ref[0, 0]), gsn, gwe, 1)
+        ub = fill_ghosts(scratch[2], f32u(uc_ref[1, 0]), gsn, gwe, 2)
         fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
-        ssn = gsn[6 * h : 6 * h + 2]
-        swe = gwe[:, 6 * h : 6 * h + 2]
+        ssn = gsn[6 * h : 6 * h + 2] if seam else None
+        swe = gwe[:, 6 * h : 6 * h + 2] if seam else None
 
         dh, dua, dub = rhs_core_cov(
             fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
             hf, ua, ub, b_ref[0], ssn, swe,
             n=n, halo=halo, d=d, radius=radius,
             gravity=gravity, omega=omega, recon=recon,
+            seam_scratch=(scratch[3], scratch[4]) if seam else None,
+            sym_prescaled=sym_prescaled,
         )
 
         fa = jnp.float32(a)
         fb = jnp.float32(b)
         fg = jnp.float32(g_dt)
 
-        def emit(int_old, y0, tend, out_ref, fi, lead=()):
+        def emit(int_old, y0, tend, out_ref, fi, lead=(), is_h=False):
+            # The combine runs in STORED space (h: the anomaly, u: the
+            # scaled-down value): exact because the stage coefficients
+            # sum to 1 and scaling is linear — the tendency constant
+            # absorbs 1/u_scale at trace time.  Only the emitted strips
+            # need the absolute value back.
+            cdt = cdt_h if is_h else cdt_u
+            up = ((lambda x: x) if cdt == jnp.float32
+                  else (lambda x: x.astype(jnp.float32)))
+            scale = h_scale if is_h else u_scale
+            fgf = fg if scale == 1.0 else jnp.float32(g_dt / scale)
             if with_y0:
-                int_new = (fa * y0 + fb * int_old) + fg * tend
+                int_new = (fa * up(y0) + fb * up(int_old)) + fgf * tend
             elif b == 1.0:
-                int_new = int_old + fg * tend
+                int_new = up(int_old) + fgf * tend
             else:
-                int_new = fb * int_old + fg * tend
-            out_ref[lead + (0,)] = int_new
-            emit_strips(ssn_ref, swe_ref, int_new, fi)
+                int_new = fb * up(int_old) + fgf * tend
+            out_ref[lead + (0,)] = store(int_new, cdt)
+            sval = int_new
+            if scale != 1.0:
+                sval = sval * jnp.float32(scale)
+            if is_h and with_off:
+                sval = sval + jnp.float32(h_offset)
+            emit_strips(ssn_ref, swe_ref, sval, fi)
 
         if with_y0:
-            emit(hc_ref[0], h0_ref[0], dh, ho_ref, 0)
+            emit(hc_ref[0], h0_ref[0], dh, ho_ref, 0, is_h=True)
             emit(uc_ref[0, 0], u0_ref[0, 0], dua, uo_ref, 1, lead=(0,))
             emit(uc_ref[1, 0], u0_ref[1, 0], dub, uo_ref, 2, lead=(1,))
         else:
-            emit(hc_ref[0], None, dh, ho_ref, 0)
+            emit(hc_ref[0], None, dh, ho_ref, 0, is_h=True)
             emit(uc_ref[0, 0], None, dua, uo_ref, 1, lead=(0,))
             emit(uc_ref[1, 0], None, dub, uo_ref, 2, lead=(1,))
 
@@ -1295,12 +1426,14 @@ def make_cov_stage_compact(
             grid=(6,),
             in_specs=in_specs,
             out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
-            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
-                            for _ in range(3)],
+            scratch_shapes=(
+                [pltpu.VMEM((m, m), jnp.float32) for _ in range(3)]
+                + [pltpu.VMEM((n, n + 1), jnp.float32),
+                   pltpu.VMEM((n + 1, n), jnp.float32)]),
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
-            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, n), cdt_h),
+            jax.ShapeDtypeStruct((2, 6, n, n), cdt_u),
             jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
             jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
         ],
@@ -1330,19 +1463,28 @@ def make_fused_ssprk3_cov_compact(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    carry_dtype=jnp.float32,
+    h_offset: float = 0.0,
+    h_scale: float = 1.0,
+    u_scale: float = 1.0,
+    seam: bool = True,
 ):
     """``step(y, t) -> y`` over ``y = {h, u, strips_sn, strips_we}``.
 
     The production stepper: three compact stage kernels (interior-only
     fields, orientation-native strips) plus three linear strip routes.
-    Initialise the carry with :meth:`CovariantShallowWater.compact_state`.
+    Initialise the carry with :meth:`CovariantShallowWater.compact_state`
+    (encode ``h``/``u`` per ``carry_dtype``/``h_offset`` — see
+    :meth:`CovariantShallowWater.encode_carry`).
     """
     from .swe_step import SSPRK3_COEFFS
 
-    route = make_cov_strip_router_split(grid)
+    route = make_cov_strip_router_split(grid, prescale_sym=seam)
     mk = lambda a, b: make_cov_stage_compact(
         grid.n, grid.halo, float(grid.dalpha), float(grid.radius), gravity,
         omega, dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
+        carry_dtype=carry_dtype, h_offset=h_offset, h_scale=h_scale,
+        u_scale=u_scale, seam=seam, sym_prescaled=seam,
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)

@@ -18,7 +18,9 @@ __all__ = ["slope", "plr_face_states", "ppm_face_states", "LIMITERS"]
 
 
 def _minmod2(a, b):
-    return 0.5 * (jnp.sign(a) + jnp.sign(b)) * jnp.minimum(jnp.abs(a), jnp.abs(b))
+    # Sign-free form (bitwise equal to the 0.5(sign+sign)min(abs) form).
+    return (jnp.maximum(0.0, jnp.minimum(a, b))
+            + jnp.minimum(0.0, jnp.maximum(a, b)))
 
 
 def _slope_none(dqm, dqp):
@@ -31,12 +33,18 @@ def _slope_minmod(dqm, dqp):
 
 
 def _slope_mc(dqm, dqp):
-    # Monotonized-central: minmod((dqm+dqp)/2, 2 dqm, 2 dqp).
-    sgn = 0.5 * (jnp.sign(dqm) + jnp.sign(dqp))
-    mag = jnp.minimum(
-        0.5 * jnp.abs(dqm + dqp), 2.0 * jnp.minimum(jnp.abs(dqm), jnp.abs(dqp))
-    )
-    return sgn * mag
+    # Monotonized-central: minmod((dqm+dqp)/2, 2 dqm, 2 dqp), written as
+    # max(0, min3) + min(0, max3) — the sign-free 3-arg minmod.  Bitwise
+    # equal to the sign() form (mul by 2/0.5 is exact; for same-sign
+    # args min3/max3 reproduce sgn*mag, for mixed signs both give 0)
+    # and ~4 VPU ops cheaper per cell: no sign() (2 compare+selects
+    # each) and no abs chain.  Measured on the fused C384 stepper this
+    # is most of the "limiter algebra" lever (DESIGN.md perf ladder).
+    a = 0.5 * (dqm + dqp)
+    b = 2.0 * dqm
+    c = 2.0 * dqp
+    return (jnp.maximum(0.0, jnp.minimum(jnp.minimum(a, b), c))
+            + jnp.minimum(0.0, jnp.maximum(jnp.maximum(a, b), c)))
 
 
 def _slope_vanleer(dqm, dqp):
@@ -44,10 +52,21 @@ def _slope_vanleer(dqm, dqp):
     return jnp.where(prod > 0, 2.0 * prod / (dqm + dqp + 1e-300), 0.0)
 
 
+def _slope_mc_sign(dqm, dqp):
+    # The sign() form of MC (bitwise equal to _slope_mc); kept for A/B
+    # perf measurement.
+    sgn = 0.5 * (jnp.sign(dqm) + jnp.sign(dqp))
+    mag = jnp.minimum(
+        0.5 * jnp.abs(dqm + dqp), 2.0 * jnp.minimum(jnp.abs(dqm), jnp.abs(dqp))
+    )
+    return sgn * mag
+
+
 LIMITERS = {
     "none": _slope_none,
     "minmod": _slope_minmod,
     "mc": _slope_mc,
+    "mc_sign": _slope_mc_sign,
     "vanleer": _slope_vanleer,
 }
 

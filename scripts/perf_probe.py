@@ -1,0 +1,82 @@
+"""Single-chip perf probe: steady-state step rate of fused-stepper variants.
+
+The measurement methodology of DESIGN.md ("Step-time methodology"): jit a
+``fori_loop`` of the step, size the window for multi-second runs, time
+the second call.  Usage::
+
+    python scripts/perf_probe.py [n] [variant ...]
+
+Variants: ``mc`` / ``minmod`` / ``none`` / ``vanleer`` (limiter choice
+on the compact covariant stepper), ``bf16`` (bf16 carry, h stored as
+anomaly), ``int16`` (int16 fixed-point carry, magic-constant rounding),
+``noseam`` (seam imposition ablated — measurement only, breaks
+conservation).  Default: ``mc``.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.models.shallow_water_cov import CovariantShallowWater
+from jaxstream.physics.initial_conditions import williamson_tc5
+from jaxstream.stepping import integrate
+
+
+def measure(step, y, dt, k1=3000, k2=15000):
+    """Dispatch-overhead-free steady-state rate (shared methodology:
+    :func:`jaxstream.utils.profiling.steady_state_rate`)."""
+    from jaxstream.utils.profiling import steady_state_rate
+
+    run = jax.jit(lambda y, k: integrate(step, y, 0.0, k, dt),
+                  donate_argnums=0)
+    y, _ = run(y, 10)
+    jax.block_until_ready(y["h"])
+    rate, y = steady_state_rate(lambda y, k: run(y, k)[0], y, k1=k1, k2=k2)
+    assert np.all(np.isfinite(np.asarray(y["h"])))
+    return rate
+
+
+def main():
+    args = sys.argv[1:]
+    n = int(args[0]) if args and args[0].isdigit() else 384
+    variants = [a for a in args if not a.isdigit()] or ["mc"]
+    dt = 60.0
+
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+
+    for v in variants:
+        limiter = v if v in ("mc", "minmod", "none", "vanleer") else "mc"
+        kw = {}
+        if v == "noseam":
+            kw["_ablate_seam"] = True
+        model = CovariantShallowWater(
+            grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext,
+            backend="pallas", limiter=limiter)
+        st = model.initial_state(h_ext, v_ext)
+        if v in ("bf16", "int16"):
+            off = float(0.5 * (jnp.min(st["h"]) + jnp.max(st["h"])))
+            cd = ((jnp.bfloat16,) * 2 if v == "bf16" else (jnp.int16,) * 2)
+            hs = 1.0 if v == "bf16" else 0.0625
+            us = 1.0 if v == "bf16" else float(grid.radius) / 256.0
+            kw.update(carry_dtype=cd, h_offset=off, h_scale=hs, u_scale=us)
+            step = model.make_fused_step(dt, **kw)
+            y = model.encode_carry(model.compact_state(st), cd, off, hs, us)
+        else:
+            step = model.make_fused_step(dt, **kw)
+            y = model.compact_state(st)
+        rate = measure(step, y, dt)
+        print(f"C{n} {v:8s}: {rate:8.1f} steps/s  "
+              f"({rate * dt / 86400.0:.3f} sim-days/s)")
+
+
+if __name__ == "__main__":
+    main()

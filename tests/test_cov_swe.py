@@ -222,6 +222,53 @@ def test_cov_fused_step_parity():
         np.testing.assert_allclose(b, a, atol=2e-4 * scale, err_msg=k)
 
 
+@pytest.mark.slow
+def test_cov_fused_step_carry_encodings():
+    """16-bit carry encodings of the compact stepper (DESIGN.md "carry
+    encoding ladder"): each encoding must integrate stably and track the
+    f32 carry within its quantization budget; int16 with the magic-
+    constant round must be accuracy-neutral at test tolerance."""
+    n = 12
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    pal = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                omega=EARTH_OMEGA, b_ext=b_ext,
+                                backend="pallas_interpret")
+    state = pal.initial_state(h_ext, v_ext)
+    dt = 600.0
+    y32 = pal.compact_state(state)
+    step32 = pal.make_fused_step(dt)
+    t = 0.0
+    for _ in range(5):
+        y32 = step32(y32, t)
+        t += dt
+    ref_h = np.asarray(y32["h"], np.float64)
+    ref_u = np.asarray(y32["u"], np.float64)
+
+    off = float(0.5 * (jnp.min(state["h"]) + jnp.max(state["h"])))
+    R = EARTH_RADIUS
+    cases = [
+        ("bf16-anom", (jnp.bfloat16, jnp.bfloat16), off, 1.0, 1.0, 2e-2),
+        ("int16", (jnp.int16, jnp.int16), off, 0.0625, R / 256.0, 2e-4),
+    ]
+    for name, carry, o, hs, us, tol in cases:
+        step = pal.make_fused_step(dt, carry_dtype=carry, h_offset=o,
+                                   h_scale=hs, u_scale=us)
+        y = pal.encode_carry(pal.compact_state(state), carry, o, hs, us)
+        t = 0.0
+        for _ in range(5):
+            y = step(y, t)
+            t += dt
+        dec = pal.decode_carry(y, o, hs, us)
+        h = np.asarray(dec["h"], np.float64)
+        u = np.asarray(dec["u"], np.float64)
+        assert np.all(np.isfinite(h)) and np.all(np.isfinite(u)), name
+        herr = np.max(np.abs(h - ref_h)) / np.max(np.abs(ref_h))
+        uerr = np.max(np.abs(u - ref_u)) / np.max(np.abs(ref_u))
+        assert herr < tol, (name, herr)
+        assert uerr < 10 * tol, (name, uerr)
+
+
 def test_cov_routers_bitwise_equal_loop_oracle():
     """The vectorized routers (linear packed-layout and split-orientation)
     reproduce the loop router — the readable reference implementation —
