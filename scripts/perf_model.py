@@ -1,0 +1,118 @@
+"""Per-generation perf model: measure v5e, predict v5p (VERDICT r1 #1d).
+
+Decomposes the measured C384 TC5 step into three components with
+different hardware-scaling laws, each pinned by a measurement on THIS
+chip (no hand-waving):
+
+  C  VPU-compute time   — scales with the peak-compute ratio
+  E  exposed-DMA time   — scales with the HBM-bandwidth ratio, estimated
+                          from the measured bf16-carry delta: halving a
+                          known byte count moves the step by E's
+                          sensitivity to bytes
+  F  fixed/other time   — stage machinery that tracked neither knob plus
+                          the XLA-level glue (router, copies) read from a
+                          jax.profiler device trace; scaling uncertain,
+                          so the prediction brackets it (unscaled =
+                          conservative, compute-scaled = optimistic)
+
+Run on the v5e:  python scripts/perf_model.py [--measure]
+Without --measure it uses the constants recorded below (measured
+2026-07-30, jax 0.9.0, C384 TC5 f32 compact stepper; see DESIGN.md).
+"""
+
+import sys
+
+# ---- measured inputs (v5e, C384 TC5, dispatch-overhead-free) -----------
+STEP_F32_US = 302.0       # 3 312 steps/s, scripts/perf_probe.py
+STEP_BF16_US = 282.0      # 3 547 steps/s, h-anomaly + u bf16 carry
+STAGE_KERNEL_US = 263.0   # sum of the 3 Pallas stage kernels per step,
+                          # jax.profiler device trace (body.9/10/11:
+                          # 0.527 s over 2 000 steps)
+GLUE_US = 35.0            # device while-loop total 298 us minus kernels:
+                          # router matmul/gather/rev/copy XLA ops
+FLOPS_PER_STEP = 137 * 6 * 384 * 384 * 3        # analytic count (+-15%)
+BYTES_F32_PER_STEP = 27 * 6 * 384 * 384 * 4     # 27 field passes
+BYTES_HALVED_BY_BF16 = 13.5 * 6 * 384 * 384 * 4  # 27 passes -> 13.5
+
+# ---- hardware ratios (v5p / v5e) ---------------------------------------
+COMPUTE_RATIO = 459.0 / 197.0   # peak TFLOPs ratio ~ VPU clockxcores
+HBM_RATIO = 2765.0 / 819.0
+V5P_TARGET_DAYS = 1000.0 / 256.0  # north star normalized per chip
+DT = 60.0
+
+
+def model():
+    # E: exposed-DMA sensitivity from the bf16 experiment.  Halving
+    # BYTES_HALVED_BY_BF16 saved (STEP_F32_US - STEP_BF16_US), so the
+    # exposed fraction of raw DMA time is measured, not assumed.
+    d_bytes = BYTES_HALVED_BY_BF16 / 2.0
+    raw_us_per_byte = 1.0 / 819e3          # us per byte at v5e HBM BW
+    saved_us = STEP_F32_US - STEP_BF16_US
+    exposure = saved_us / (d_bytes * raw_us_per_byte)
+    E = BYTES_F32_PER_STEP * raw_us_per_byte * exposure
+
+    # C: VPU time of the RHS at the measured ~2.0-2.3 TFLOP/s sustained
+    # (DESIGN.md stage bisection).  Use the analytic flop count over the
+    # sustained rate band; take the midpoint.
+    C_lo = FLOPS_PER_STEP / 2.3e6   # us
+    C_hi = FLOPS_PER_STEP / 2.0e6
+    C = 0.5 * (C_lo + C_hi)
+
+    F = STEP_F32_US - C - E
+    print(f"v5e decomposition (per step): C={C:.0f}us (VPU), "
+          f"E={E:.0f}us (exposed DMA, exposure={exposure:.2f}), "
+          f"F={F:.0f}us (fixed/glue; profiler: {STAGE_KERNEL_US:.0f}us "
+          f"kernels + {GLUE_US:.0f}us XLA glue)")
+
+    for fname, fscale in (("conservative (F unscaled)", 1.0),
+                          ("optimistic (F compute-scaled)", COMPUTE_RATIO)):
+        step_v5p = C / COMPUTE_RATIO + E / HBM_RATIO + F / fscale
+        rate = 1e6 / step_v5p
+        days = rate * DT / 86400.0
+        print(f"v5p prediction [{fname}]: {step_v5p:.0f}us/step -> "
+              f"{rate:.0f} steps/s -> {days:.2f} sim-days/s/chip "
+              f"({days / V5P_TARGET_DAYS:.2f}x the per-chip north star; "
+              f"256-chip ensemble aggregate {days * 256:.0f} sim-days/s)")
+
+
+def measure():
+    """Re-measure the constants live (v5e with the tunneled chip)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
+    from jaxstream.physics.initial_conditions import williamson_tc5
+    from jaxstream.stepping import integrate
+    from jaxstream.utils.profiling import steady_state_rate
+
+    n, dt = 384, DT
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    model_ = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                   omega=EARTH_OMEGA, b_ext=b_ext,
+                                   backend="pallas")
+    out = {}
+    for name, carry, off in (("f32", None, 0.0),
+                             ("bf16", (jnp.bfloat16,) * 2, 4846.0)):
+        st = model_.initial_state(h_ext, v_ext)
+        step = model_.make_fused_step(dt, carry_dtype=carry, h_offset=off)
+        y = model_.encode_carry(model_.compact_state(st), carry, off)
+        run = jax.jit(lambda y, k: integrate(step, y, 0.0, k, dt),
+                      donate_argnums=0)
+        y, _ = run(y, 10)
+        jax.block_until_ready(y["h"])
+        rate, y = steady_state_rate(lambda y, k: run(y, k)[0], y)
+        out[name] = 1e6 / rate
+        print(f"measured {name}: {rate:.0f} steps/s ({out[name]:.0f} us)")
+    print(f"-> set STEP_F32_US={out['f32']:.0f}, "
+          f"STEP_BF16_US={out['bf16']:.0f}")
+
+
+if __name__ == "__main__":
+    if "--measure" in sys.argv:
+        measure()
+    model()
