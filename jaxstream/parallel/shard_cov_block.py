@@ -175,6 +175,32 @@ class CovBlockProgram:
             oy, ox = _block_coords(other.edge, kk, s)
             met_oth[f, iy, ix, t] = met_seg(other.face, other.edge, oy, ox)
 
+        # ---- corner-ghost routing (nu4 / Laplacian support) -------------
+        # The Laplace-Beltrami cross-terms read the h x h ghost corners.
+        # On the block mesh every corner ghost is the END PATCH of some
+        # neighbor's already-filled edge-ghost strip: the x-neighbor's
+        # S/N strip end for interior columns, the y-neighbor's W/E strip
+        # end on the panel-edge columns (where the x-neighbor is across
+        # a cube edge and the strip itself already carries the rotated
+        # data), and the face-local average at true cube corners —
+        # exactly the whole-face oracle's structure.  One-hot source
+        # masks per corner in [SW, SE, NW, NE] order:
+        use_x = np.zeros((6, s, s, 4), np.float32)
+        use_y = np.zeros((6, s, s, 4), np.float32)
+        use_avg = np.zeros((6, s, s, 4), np.float32)
+        for iy in range(s):
+            for ix in range(s):
+                for c, (xdir, ydir) in enumerate(
+                        [(-1, -1), (+1, -1), (-1, +1), (+1, +1)]):
+                    has_x = (ix > 0) if xdir < 0 else (ix < s - 1)
+                    has_y = (iy > 0) if ydir < 0 else (iy < s - 1)
+                    if has_x:
+                        use_x[:, iy, ix, c] = 1.0
+                    elif has_y:
+                        use_y[:, iy, ix, c] = 1.0
+                    else:
+                        use_avg[:, iy, ix, c] = 1.0
+
         # ---- per-device coordinates and frames --------------------------
         ac, af, _ = extended_coords(n, halo)
         xr = np.zeros((6, s, s, 1, n_loc + 2 * halo), np.float32)
@@ -210,6 +236,9 @@ class CovBlockProgram:
             "yc": jnp.asarray(yc),
             "yfc": jnp.asarray(yfc),
             "fz": jnp.asarray(fz),
+            "corner_use_x": jnp.asarray(use_x),
+            "corner_use_y": jnp.asarray(use_y),
+            "corner_use_avg": jnp.asarray(use_avg),
         }
 
 
@@ -285,12 +314,76 @@ def make_cov_block_exchange(program: CovBlockProgram):
     return exchange
 
 
+def make_block_corner_fill(program: CovBlockProgram):
+    """``corner_fill(blk3, t) -> blk3`` — fill the four h x h ghost
+    corners of a stacked ``(3, m_loc, m_loc)`` block (h, u_a, u_b) from
+    the neighbors' edge-ghost strip end patches (see the corner-routing
+    tables in :class:`CovBlockProgram`).  Requires the edge ghosts to be
+    filled first; needed only by corner-reading stencils (the nu4
+    Laplacians — the dimension-split advective stencils never look)."""
+    n, h = program.n_loc, program.halo
+    i0, i1 = h, h + n
+    _, ax_y, ax_x = program.axis_names
+    # Same intra-panel shift perms the main exchange uses (s >= 2 is
+    # enforced by the stepper factory).
+    fwd = [(i, i + 1) for i in range(program.s - 1)]
+    bwd = [(i + 1, i) for i in range(program.s - 1)]
+
+    def corner_fill(blk3, t):
+        def tt(name):
+            v = t[name]
+            return v.reshape(v.shape[3:])
+
+        ux = tt("corner_use_x")          # (4,) one-hot per corner
+        uy = tt("corner_use_y")
+        ua = tt("corner_use_avg")
+
+        S = blk3[:, 0:h, i0:i1]
+        N = blk3[:, i1:i1 + h, i0:i1]
+        W = blk3[:, i0:i1, 0:h]
+        E = blk3[:, i0:i1, i1:i1 + h]
+        # E-ends of my S/N strips -> (ix+1)'s west corners, etc.
+        rx_w = lax.ppermute(jnp.stack([S[:, :, n - h:],
+                                       N[:, :, n - h:]]), ax_x, fwd)
+        rx_e = lax.ppermute(jnp.stack([S[:, :, :h],
+                                       N[:, :, :h]]), ax_x, bwd)
+        ry_s = lax.ppermute(jnp.stack([W[:, n - h:, :],
+                                       E[:, n - h:, :]]), ax_y, fwd)
+        ry_n = lax.ppermute(jnp.stack([W[:, :h, :],
+                                       E[:, :h, :]]), ax_y, bwd)
+
+        # Face-local averages (the oracle's cube-corner treatment; same
+        # formulas as ops.pallas.swe_cov._make_fill corners=True).
+        a_sw = 0.5 * (blk3[:, 0:h, i0:i0 + 1] + blk3[:, i0:i0 + 1, 0:h])
+        a_se = 0.5 * (blk3[:, 0:h, i1 - 1:i1] + blk3[:, i0:i0 + 1, i1:i1 + h])
+        a_nw = 0.5 * (blk3[:, i1:i1 + h, i0:i0 + 1] + blk3[:, i1 - 1:i1, 0:h])
+        a_ne = 0.5 * (blk3[:, i1:i1 + h, i1 - 1:i1]
+                      + blk3[:, i1 - 1:i1, i1:i1 + h])
+
+        cands = [
+            (0, slice(0, h), slice(0, h), rx_w[0], ry_s[0], a_sw),
+            (1, slice(0, h), slice(i1, i1 + h), rx_e[0], ry_s[1], a_se),
+            (2, slice(i1, i1 + h), slice(0, h), rx_w[1], ry_n[0], a_nw),
+            (3, slice(i1, i1 + h), slice(i1, i1 + h), rx_e[1], ry_n[1],
+             a_ne),
+        ]
+        for c, rs, cs, xv, yv, av in cands:
+            val = ux[c] * xv + uy[c] * yv + ua[c] * av
+            blk3 = blk3.at[:, rs, cs].set(val)
+        return blk3
+
+    return corner_fill
+
+
 def make_sharded_cov_block_stepper(model, setup, dt: float):
     """``step(state, t) -> state`` for the covariant model on (6, s, s).
 
     State is the usual interior pytree ``{"h": (6, n, n),
-    "u": (2, 6, n, n)}`` sharded over all three mesh axes.  Requires
-    ``nu4 == 0`` (use GSPMD for filtered runs on block meshes).
+    "u": (2, 6, n, n)}`` sharded over all three mesh axes.  ``nu4 > 0``
+    runs the exchange-lap-exchange-lap del^4 structure of the face tier
+    (shard_cov.py), with the Laplacians' corner ghosts delivered by
+    :func:`make_block_corner_fill` (neighbor strip end-patches; cube
+    corners averaged face-locally like the oracle).
     """
     grid = model.grid
     s = setup.sy
@@ -299,11 +392,6 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
         raise ValueError(
             f"covariant block path needs a (panel=6, s, s) mesh with "
             f"s >= 2; got panel={setup.panel}, y={setup.sy}, x={setup.sx}"
-        )
-    if getattr(model, "nu4", 0.0) != 0.0:
-        raise ValueError(
-            "the covariant block path does not apply hyperdiffusion "
-            "(nu4 > 0); use the GSPMD path (use_shard_map: false)"
         )
     mesh = setup.mesh
     halo = grid.halo
@@ -342,6 +430,12 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
         pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
         return jnp.pad(x, pad)
 
+    nu4 = float(getattr(model, "nu4", 0.0))
+    if nu4 != 0.0:
+        from ..ops.pallas.swe_cov import lap_core
+
+        corner_fill = make_block_corner_fill(program)
+
     def body(state, tabs, b_loc):
         fz = tabs["fz"].reshape(1, 1, 3)
         xr = tabs["xr"].reshape(1, m_loc)
@@ -356,6 +450,28 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
             h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
             dh, du = rhs_local(fz, xr, xfr, yc, yfc, h_e, u_e, b_e,
                                ssn, swe)
+            if nu4 != 0.0:
+                # del^4 = lap(lap(.)) with an exchanged refill between —
+                # the face tier's structure (shard_cov.py), per-block
+                # runtime coordinates, corners from the neighbor-patch
+                # pass (lap of a covariant pair IS a covariant pair, so
+                # the same exchange applies to l1).
+                def lap3(he, ue):
+                    blk3 = corner_fill(
+                        jnp.concatenate([he, ue[:, 0]], axis=0), tabs)
+                    lap = lambda a: lap_core(
+                        xr, xfr, yc, yfc, a, n=n_loc, halo=halo,
+                        d=float(grid.dalpha), radius=float(grid.radius))
+                    return (lap(blk3[0])[None],
+                            jnp.stack([lap(blk3[1])[None],
+                                       lap(blk3[2])[None]]))
+
+                l1h, l1u = lap3(h_e, u_e)
+                l1h_e, l1u_e, _, _ = exchange(embed(l1h), embed(l1u),
+                                              tabs)
+                l2h, l2u = lap3(l1h_e, l1u_e)
+                dh = dh - nu4 * l2h
+                du = du - nu4 * l2u
             return dh, du
 
         return ssprk3_sharded_body(f, state, dt)

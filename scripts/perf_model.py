@@ -35,19 +35,22 @@ BYTES_F32_PER_STEP = 27 * 6 * 384 * 384 * 4     # 27 field passes
 BYTES_HALVED_BY_BF16 = 13.5 * 6 * 384 * 384 * 4  # 27 passes -> 13.5
 
 # ---- hardware ratios (v5p / v5e) ---------------------------------------
+V5E_HBM_GBPS = 819.0
 COMPUTE_RATIO = 459.0 / 197.0   # peak TFLOPs ratio ~ VPU clockxcores
-HBM_RATIO = 2765.0 / 819.0
+HBM_RATIO = 2765.0 / V5E_HBM_GBPS
 V5P_TARGET_DAYS = 1000.0 / 256.0  # north star normalized per chip
 DT = 60.0
 
 
-def model():
+def model(step_f32_us=None, step_bf16_us=None):
+    step_f32_us = STEP_F32_US if step_f32_us is None else step_f32_us
+    step_bf16_us = STEP_BF16_US if step_bf16_us is None else step_bf16_us
     # E: exposed-DMA sensitivity from the bf16 experiment.  Halving
-    # BYTES_HALVED_BY_BF16 saved (STEP_F32_US - STEP_BF16_US), so the
+    # BYTES_HALVED_BY_BF16 saved (step_f32_us - step_bf16_us), so the
     # exposed fraction of raw DMA time is measured, not assumed.
     d_bytes = BYTES_HALVED_BY_BF16 / 2.0
-    raw_us_per_byte = 1.0 / 819e3          # us per byte at v5e HBM BW
-    saved_us = STEP_F32_US - STEP_BF16_US
+    raw_us_per_byte = 1.0 / (V5E_HBM_GBPS * 1e3)   # us/byte at v5e HBM BW
+    saved_us = step_f32_us - step_bf16_us
     exposure = saved_us / (d_bytes * raw_us_per_byte)
     E = BYTES_F32_PER_STEP * raw_us_per_byte * exposure
 
@@ -58,7 +61,7 @@ def model():
     C_hi = FLOPS_PER_STEP / 2.0e6
     C = 0.5 * (C_lo + C_hi)
 
-    F = STEP_F32_US - C - E
+    F = step_f32_us - C - E
     print(f"v5e decomposition (per step): C={C:.0f}us (VPU), "
           f"E={E:.0f}us (exposed DMA, exposure={exposure:.2f}), "
           f"F={F:.0f}us (fixed/glue; profiler: {STAGE_KERNEL_US:.0f}us "
@@ -108,11 +111,14 @@ def measure():
         rate, y = steady_state_rate(lambda y, k: run(y, k)[0], y)
         out[name] = 1e6 / rate
         print(f"measured {name}: {rate:.0f} steps/s ({out[name]:.0f} us)")
-    print(f"-> set STEP_F32_US={out['f32']:.0f}, "
+    print(f"-> measured STEP_F32_US={out['f32']:.0f}, "
           f"STEP_BF16_US={out['bf16']:.0f}")
+    return out
 
 
 if __name__ == "__main__":
     if "--measure" in sys.argv:
-        measure()
-    model()
+        m = measure()
+        model(m["f32"], m["bf16"])
+    else:
+        model()

@@ -69,4 +69,36 @@ for k in ("h", "u"):
     err = np.max(np.abs(b - a)) / scale
     assert err < 2e-4, (k, err)
 
+# ---- nu4 hyperdiffusion on the block tier --------------------------------
+# Same exchange-lap-exchange-lap structure as the face tier; Laplacian
+# corner ghosts delivered by the neighbor-strip end-patch pass
+# (make_block_corner_fill).  Oracle: the classic jnp stepper with nu4.
+from jaxstream.physics.initial_conditions import galewsky  # noqa: E402
+
+h_g, v_g = galewsky(grid, EARTH_GRAVITY, EARTH_OMEGA)
+nu4 = 1.0e15
+model4 = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                               omega=EARTH_OMEGA, nu4=nu4)
+s0 = model4.initial_state(h_g, v_g)
+dt4, nsteps4 = 300.0, 3
+
+ref = s0
+step_ref = jax.jit(model4.make_step(dt4))
+for _ in range(nsteps4):
+    ref = step_ref(ref, 0.0)
+
+ss = shard_state(setup, s0)
+step_sh4 = make_stepper_for(model4, setup, ss, dt4)
+out = ss
+for _ in range(nsteps4):
+    out = step_sh4(out, 0.0)
+
+for k in ("h", "u"):
+    a = np.asarray(ref[k], dtype=np.float64)
+    b = np.asarray(out[k], dtype=np.float64)
+    scale = np.max(np.abs(a)) + 1e-300
+    err = np.max(np.abs(b - a)) / scale
+    assert err < 2e-4, ("nu4", k, err)
+
+print("COV_BLOCK_NU4_OK", flush=True)
 print("COV_BLOCK_OK", flush=True)

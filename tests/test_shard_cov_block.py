@@ -21,7 +21,7 @@ def test_cov_block_24_devices_matches_oracle():
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     res = subprocess.run(
         [sys.executable, _WORKER], capture_output=True, text=True,
-        timeout=420, env=env,
+        timeout=900, env=env,
     )
     tail = "\n".join((res.stdout + res.stderr).splitlines()[-15:])
     assert res.returncode == 0, f"worker failed:\n{tail}"
