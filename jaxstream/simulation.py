@@ -194,7 +194,28 @@ class Simulation:
         step = self.checkpoints.latest_step()
         if step is None:
             return
-        self.state, self.t = self.checkpoints.restore(step, sharding_setup=self.setup)
+        state, self.t = self.checkpoints.restore(step, sharding_setup=None)
+        n_new = self.config.grid.n
+        # Infer the checkpoint's resolution from any spatial leaf (the
+        # state key differs per model family: h / q / T).
+        n_ckpts = {np.shape(v)[-1] for v in state.values()
+                   if len(np.shape(v)) >= 3}
+        n_ckpt = n_ckpts.pop() if len(n_ckpts) == 1 else n_new
+        if n_ckpt != n_new:
+            # Resolution-aware resume (SURVEY.md §5): conservative
+            # area-weighted regrid of every state field onto the run's
+            # grid (io/regrid.py), then shard for the run's mesh.
+            from .io.regrid import regrid_state
+
+            log.info("resuming across resolutions: checkpoint C%d -> "
+                     "run C%d (conservative regrid)", n_ckpt, n_new)
+            state = regrid_state(state, n_new,
+                                 dtype=self.grid.area.dtype)
+        if self.setup is not None and self.setup.mesh is not None:
+            from .parallel.mesh import shard_state
+
+            state = shard_state(self.setup, state)
+        self.state = state
         self.step_count = step
         log.info("resumed from checkpoint step %d (t=%.0f s)", step, self.t)
 

@@ -91,6 +91,83 @@ def test_history_and_checkpoint_resume(tmp_path):
     assert sim2.step_count == 6
 
 
+def test_regrid_operator_conserves_mass():
+    """Unit level: overlap rows partition, constants pass through, and
+    the area-weighted transfer conserves mass in the model's measure to
+    the midpoint-rule O(dalpha^2) (both directions)."""
+    import jax.numpy as jnp
+
+    from jaxstream.config import EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.io.regrid import overlap_matrix, regrid_state
+
+    W = overlap_matrix(24, 36)  # non-integer ratio
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, rtol=1e-12)
+
+    g24 = build_grid(24, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    g48 = build_grid(48, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    a24 = np.asarray(g24.interior(g24.area), np.float64)
+    a48 = np.asarray(g48.interior(g48.area), np.float64)
+
+    xyz = (np.asarray(g24.interior(g24.xyz), np.float64)
+           / EARTH_RADIUS)                               # unit sphere
+    h = 1000.0 + 100.0 * xyz[2] + 20.0 * xyz[0] * xyz[1]
+    state = {"h": jnp.asarray(h), "u": jnp.asarray(
+        np.stack([xyz[0], xyz[1]]))}
+
+    up = regrid_state(state, 48)
+    assert np.shape(up["h"]) == (6, 48, 48)
+    assert np.shape(up["u"]) == (2, 6, 48, 48)
+    m24 = np.sum(a24 * h)
+    m48 = np.sum(a48 * np.asarray(up["h"], np.float64))
+    assert abs(m48 - m24) / abs(m24) < 1e-12     # exact in model measure
+
+    down = regrid_state({"h": up["h"]}, 24)
+    m24b = np.sum(a24 * np.asarray(down["h"], np.float64))
+    assert abs(m24b - m24) / abs(m24) < 1e-12
+
+    # Constants pick up only the documented O(dalpha^2) area ripple.
+    const = regrid_state({"h": jnp.full((6, 24, 24), 7.5)}, 48)
+    np.testing.assert_allclose(np.asarray(const["h"]), 7.5, rtol=5e-4)
+
+
+def test_resume_across_resolutions(tmp_path):
+    """SURVEY.md §5: restart must be resolution-aware — a C12 checkpoint
+    resumes into a C24 run via the conservative regrid and keeps
+    integrating with mass preserved."""
+    cfg12 = _cfg(tmp_path)
+    sim = Simulation(cfg12)
+    sim.run()
+    m12 = sim.diagnostics()["mass"]
+
+    # Same checkpoint dir (the resume source); history gets its own
+    # store — snapshot shapes change with resolution.
+    cfg24 = _cfg(tmp_path, grid={"n": 24},
+                 io={"history_path": str(tmp_path / "hist24")})
+    sim2 = Simulation(cfg24)
+    assert sim2.step_count == 4            # resumed from the checkpoint
+    assert np.shape(sim2.state["h"]) == (6, 24, 24)
+    m24 = sim2.diagnostics()["mass"]
+    assert abs(m24 - m12) / abs(m12) < 1e-10
+    sim2.run(6)                            # and it keeps integrating
+    assert sim2.step_count == 6
+    assert np.all(np.isfinite(np.asarray(sim2.state["h"])))
+
+
+def test_resume_across_resolutions_non_swe_state(tmp_path):
+    """Resolution inference must not assume an 'h' key — advection
+    states carry 'q' (regression guard)."""
+    cfg = _cfg(tmp_path, model={"initial_condition": "tc1"})
+    Simulation(cfg).run()
+    sim2 = Simulation(_cfg(tmp_path, model={"initial_condition": "tc1"},
+                           grid={"n": 24},
+                           io={"history_path": str(tmp_path / "h24")}))
+    assert sim2.step_count == 4
+    assert np.shape(sim2.state["q"]) == (6, 24, 24)
+    sim2.run(6)
+    assert np.all(np.isfinite(np.asarray(sim2.state["q"])))
+
+
 @pytest.mark.slow
 def test_sharded_matches_single_device():
     ref = Simulation(_cfg())
