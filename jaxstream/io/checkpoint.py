@@ -45,15 +45,22 @@ class CheckpointManager:
 
     def restore(self, step: Optional[int] = None, sharding_setup=None):
         """Returns ``(state, t)``; shards leaves if a setup is given."""
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.path}")
-        out = self.mgr.restore(step)
-        state, t = out["state"], out["t"]
+        state, t = self.restore_host(step)
         if sharding_setup is not None and sharding_setup.mesh is not None:
             from ..parallel.mesh import shard_state
 
             state = shard_state(sharding_setup, state)
         else:
             state = jax.tree_util.tree_map(jax.numpy.asarray, state)
-        return state, float(np.asarray(t))
+        return state, t
+
+    def restore_host(self, step: Optional[int] = None):
+        """Returns ``(state, t)`` with leaves left as host arrays — for
+        callers that inspect/transform before any device placement (the
+        resolution-aware resume path: no full-array device-0 round trip
+        before sharding)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.path}")
+        out = self.mgr.restore(step)
+        return out["state"], float(np.asarray(out["t"]))

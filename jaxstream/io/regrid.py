@@ -36,7 +36,35 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["overlap_matrix", "regrid_state"]
+__all__ = ["overlap_matrix", "regrid_state", "infer_resolution"]
+
+
+def infer_resolution(state: Dict):
+    """The single per-panel resolution of a state pytree's spatial
+    leaves (ndim >= 3), or a ValueError naming the shapes if the leaves
+    disagree — shared by :func:`regrid_state` and the resume path."""
+    shapes = {k: np.shape(v) for k, v in state.items()}
+    ns = {s[-1] for s in shapes.values() if len(s) >= 3}
+    if len(ns) != 1:
+        raise ValueError(
+            f"could not infer a single per-panel resolution from field "
+            f"shapes {shapes}")
+    return ns.pop()
+
+
+def _areas_f64(n: int) -> np.ndarray:
+    """(6, n, n) interior cell areas on the unit sphere, pure numpy f64
+    (midpoint rule, identical to build_grid's) — independent of
+    jax_enable_x64, so the conservation guarantee holds under the
+    default f32 runtime too."""
+    from ..geometry.cubed_sphere import _basis_and_metric, extended_coords
+
+    ac, _, d = extended_coords(n, 0)
+    out = []
+    for f in range(6):
+        bb, aa = np.meshgrid(ac, ac, indexing="ij")
+        out.append(_basis_and_metric(f, aa, bb, 1.0)["sqrtg"] * d * d)
+    return np.stack(out)
 
 
 def overlap_matrix(n_old: int, n_new: int) -> np.ndarray:
@@ -61,25 +89,15 @@ def regrid_state(state: Dict, n_new: int, dtype=None) -> Dict:
     used internally."""
     import jax.numpy as jnp
 
-    from ..geometry.cubed_sphere import build_grid
-
-    shapes = {k: np.shape(v) for k, v in state.items()}
-    n_olds = {s[-1] for s in shapes.values() if len(s) >= 3}
-    if len(n_olds) != 1:
-        raise ValueError(
-            f"regrid_state: could not infer a single old resolution from "
-            f"field shapes {shapes}")
-    n_old = n_olds.pop()
+    n_old = infer_resolution(state)
     if n_old == n_new:
         return state
 
-    # f64 area model regardless of the run dtype — conservation is then
-    # exact in any f64 measure; a float32 run's own area measure can
-    # differ at its dtype's precision.
-    grid_old = build_grid(n_old, halo=2, radius=1.0, dtype=jnp.float64)
-    grid_new = build_grid(n_new, halo=2, radius=1.0, dtype=jnp.float64)
-    a1 = np.asarray(grid_old.interior(grid_old.area), np.float64)  # (6,n,n)
-    a2 = np.asarray(grid_new.interior(grid_new.area), np.float64)
+    # Pure-numpy f64 area model regardless of the run dtype (and of
+    # jax_enable_x64) — conservation is then exact in any f64 measure; a
+    # float32 run's own area measure can differ at its dtype's precision.
+    a1 = _areas_f64(n_old)                                         # (6,n,n)
+    a2 = _areas_f64(n_new)
     W = overlap_matrix(n_old, n_new)                               # (n2,n1)
     D = np.einsum("ai,fab,bj->fij", W, a2, W)      # W^T a2 W, (6,n1,n1)
 

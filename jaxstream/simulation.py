@@ -194,19 +194,18 @@ class Simulation:
         step = self.checkpoints.latest_step()
         if step is None:
             return
-        state, self.t = self.checkpoints.restore(step, sharding_setup=None)
+        # Host-side restore: inspect (and possibly regrid) before any
+        # device placement — a sharded-state restart must never
+        # materialize the full arrays on one device.
+        from .io.regrid import infer_resolution, regrid_state
+
+        state, self.t = self.checkpoints.restore_host(step)
         n_new = self.config.grid.n
-        # Infer the checkpoint's resolution from any spatial leaf (the
-        # state key differs per model family: h / q / T).
-        n_ckpts = {np.shape(v)[-1] for v in state.values()
-                   if len(np.shape(v)) >= 3}
-        n_ckpt = n_ckpts.pop() if len(n_ckpts) == 1 else n_new
+        n_ckpt = infer_resolution(state)   # raises clearly on ambiguity
         if n_ckpt != n_new:
             # Resolution-aware resume (SURVEY.md §5): conservative
             # area-weighted regrid of every state field onto the run's
             # grid (io/regrid.py), then shard for the run's mesh.
-            from .io.regrid import regrid_state
-
             log.info("resuming across resolutions: checkpoint C%d -> "
                      "run C%d (conservative regrid)", n_ckpt, n_new)
             state = regrid_state(state, n_new,
@@ -215,6 +214,8 @@ class Simulation:
             from .parallel.mesh import shard_state
 
             state = shard_state(self.setup, state)
+        else:
+            state = jax.tree_util.tree_map(jnp.asarray, state)
         self.state = state
         self.step_count = step
         log.info("resumed from checkpoint step %d (t=%.0f s)", step, self.t)

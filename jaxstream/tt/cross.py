@@ -1,0 +1,90 @@
+"""Cross / ACA low-rank approximation of implicitly-factored operands.
+
+The LANL route to TT-compressed nonlinear terms (Danis et al. 2024,
+arXiv:2408.03483 — deck p.14): instead of projecting the full operand
+(randomized sketch) or forming Gram matrices (exact rounding, one eigh/
+SVD per product), **adaptive cross approximation** builds a rank-k
+skeleton from k actual rows and columns of the operand, chosen by
+partial pivoting on the residual.  Everything is matvecs, slicing, and
+argmax — no factorization kernels at all — which matters because the
+N-independent eigh/SVD calls were measured to eat ~2/3 of the TT step
+at N=1024 (DESIGN.md "Tensor-Train numerics"): cross removes that floor
+from the quadratic-term roundings.
+
+``aca_lowrank(P, Q, k)`` approximates ``M = P @ Q`` (never formed, with
+``P (n, R)``, ``Q (R, m)`` — e.g. the Khatri-Rao factors of a product
+of two rank-r fields, R = r^2) by the classic partially-pivoted ACA:
+
+    for t < k:
+        c   = M[:, j] - U V[:, j]          (residual column at pivot j)
+        i   = argmax |c|   (excluding used rows)
+        r   = M[i, :] - U[i] V             (residual row at pivot i)
+        U[:, t] = c / r[j];  V[t] = r
+        j   = argmax |r|   (excluding used columns)
+
+After k steps ``U V ~ M`` with the standard ACA quasi-optimality (error
+~ the (k+1)-th singular value up to a k-dependent factor, tight for the
+smooth fields this layer carries).  All shapes static; pivot selection
+is data-dependent but jit-safe (argmax + dynamic slices in a fori_loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["aca_lowrank"]
+
+
+def aca_lowrank(P, Q, k: int):
+    """Rank-``k`` cross approximation ``(U, V)`` of ``M = P @ Q``.
+
+    ``P (n, R)``, ``Q (R, m)`` -> ``U (n, k)``, ``V (k, m)`` with
+    ``U @ V ~ P @ Q``.  O(k (n + m) (R + k)) flops, no eigh/SVD/QR.
+    The factors are balanced per direction (each ACA term is
+    ``c_t r_t / pivot``; we split the pivot as ``1/sqrt|pivot|`` on each
+    side to keep both factors at comparable scale — the same balancing
+    convention as ``solver._round_factored``).
+    """
+    n, R = P.shape
+    R2, m = Q.shape
+    assert R == R2, (P.shape, Q.shape)
+    dt = P.dtype
+
+    def body(t, carry):
+        U, V, j, used_r, used_c = carry
+        # Residual column at pivot column j.
+        c = P @ jax.lax.dynamic_slice_in_dim(Q, j, 1, axis=1)[:, 0] \
+            - U @ jax.lax.dynamic_slice_in_dim(V, j, 1, axis=1)[:, 0]
+        c_m = jnp.where(used_r, 0.0, jnp.abs(c))
+        i = jnp.argmax(c_m)
+        # Residual row at pivot row i.
+        r = jax.lax.dynamic_slice_in_dim(P, i, 1, axis=0)[0] @ Q \
+            - jax.lax.dynamic_slice_in_dim(U, i, 1, axis=0)[0] @ V
+        piv = r[j]
+        # Dead pivot (exactly-representable operand of lower rank):
+        # write zero vectors instead of dividing by ~0.
+        ok = jnp.abs(piv) > jnp.finfo(dt).tiny * 16
+        inv = jnp.where(ok, 1.0 / jnp.sqrt(jnp.abs(
+            jnp.where(ok, piv, 1.0))), 0.0)
+        sgn = jnp.where(piv < 0, -1.0, 1.0)
+        u_t = c * inv
+        v_t = r * (inv * sgn)
+        U = jax.lax.dynamic_update_slice_in_dim(U, u_t[:, None], t, axis=1)
+        V = jax.lax.dynamic_update_slice_in_dim(V, v_t[None, :], t, axis=0)
+        used_r = used_r.at[i].set(True)
+        used_c = used_c.at[j].set(True)
+        j_next = jnp.argmax(jnp.where(used_c, 0.0, jnp.abs(r)))
+        return U, V, j_next, used_r, used_c
+
+    U0 = jnp.zeros((n, k), dt)
+    V0 = jnp.zeros((k, m), dt)
+    # First pivot column: the one with the largest column of Q-energy
+    # proxy (cheap, deterministic): argmax of column norms of Q summed
+    # through P's column scales.
+    col_proxy = jnp.einsum("ij,j->i", jnp.abs(Q.T), jnp.sum(jnp.abs(P), 0))
+    j0 = jnp.argmax(col_proxy)
+    U, V, _, _, _ = jax.lax.fori_loop(
+        0, k, body,
+        (U0, V0, j0, jnp.zeros((n,), bool), jnp.zeros((m,), bool)))
+    return U, V

@@ -46,6 +46,17 @@ sw_factor = factor_field
 sw_unfactor = unfactor_field
 
 
+def kr_raw(x, y):
+    """Unrounded Khatri-Rao product pair: the exact factored form of the
+    elementwise product of two factored fields (rank r1*r2)."""
+    A1, B1 = x
+    A2, B2 = y
+    n = A1.shape[0]
+    m = B1.shape[1]
+    return ((A1[:, :, None] * A2[:, None, :]).reshape(n, -1),
+            (B1[:, None, :] * B2[None, :, :]).reshape(-1, m))
+
+
 def kr_product(x, y, rank: int, sketch=None):
     """Elementwise product of two factored fields, re-truncated to rank.
 
@@ -58,13 +69,15 @@ def kr_product(x, y, rank: int, sketch=None):
     dimensions first (O(N R k)), then Gram-round the small form — the
     standard randomized-SVD guarantee puts the extra truncation error at
     the sigma_{rank+1} level, i.e. at the rounding's own floor.
+    Passing ``sketch="cross"`` rounds by partially-pivoted ACA
+    (:func:`jaxstream.tt.cross.aca_lowrank`, the LANL route) — no
+    eigh/SVD at all.
     """
-    A1, B1 = x
-    A2, B2 = y
-    n = A1.shape[0]
-    m = B1.shape[1]
-    A = (A1[:, :, None] * A2[:, None, :]).reshape(n, -1)
-    B = (B1[:, None, :] * B2[None, :, :]).reshape(-1, m)
+    A, B = kr_raw(x, y)
+    if isinstance(sketch, str) and sketch == "cross":
+        from .cross import aca_lowrank
+
+        return aca_lowrank(A, B, rank)
     if sketch is None:
         return _round_factored(A, B, rank)
     # Randomized range finder (Halko-Martinsson-Tropp): Y = M @ sketch
@@ -103,11 +116,18 @@ def make_tt_swe_stepper(
     through a fixed randomized range finder — O(N r^2 k) instead of the
     exact O(N r^4) Gram rounding (``rounding='exact'``); the extra
     truncation error sits at the rounding's own sigma_{r+1} floor.
+    ``rounding='cross'`` uses partially-pivoted ACA (the LANL method,
+    deck p.14) for BOTH the quadratic products and the stage combines:
+    the entire step becomes matvecs + argmax — no eigh/SVD anywhere —
+    removing the N-independent factorization floor that dominates at
+    moderate N (see DESIGN.md).
     """
     cx = 0.5 / dx
     cy = 0.5 / dy
     vx = nu / (dx * dx)
     vy = nu / (dy * dy)
+    cross = rounding in ("cross", "cross_fused")
+    fused = rounding == "cross_fused"
     if rounding == "sketch":
         # float32 test matrix: promotion follows the state dtype, and the
         # range finder needs no more precision than the directions it
@@ -116,6 +136,8 @@ def make_tt_swe_stepper(
                                    (ny, rank + oversample), jnp.float32)
     elif rounding == "exact":
         sketch = None
+    elif cross:
+        sketch = "cross"
     else:
         raise ValueError(f"unknown rounding {rounding!r}")
 
@@ -143,20 +165,50 @@ def make_tt_swe_stepper(
     def combine(pairs, r):
         A = jnp.concatenate([p[0] for p in pairs], axis=1)
         B = jnp.concatenate([p[1] for p in pairs], axis=0)
+        if cross:
+            from .cross import aca_lowrank
+
+            return aca_lowrank(A, B, r)
         return _round_factored(A, B, r)
+
+    if cross and not fused:
+        from .cross import aca_lowrank
+
+        _aca6 = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
 
     def rhs_pairs(state, s):
         """Factor pairs of ``s * dt * RHS`` for each field (h, u, v)."""
         h, u, v = state
         sdt = s * dt
-        # Products re-truncated to `rank` before differentiation keeps
-        # every stacked pair at rank r (step-and-truncate's core move).
-        hu = kr_product(h, u, rank, sketch)
-        hv = kr_product(h, v, rank, sketch)
-        uux = kr_product(u, ddx(u), rank, sketch)
-        vuy = kr_product(v, ddy(u), rank, sketch)
-        uvx = kr_product(u, ddx(v), rank, sketch)
-        vvy = kr_product(v, ddy(v), rank, sketch)
+        if fused:
+            # Defer rounding to the stage combine (rank-r^2 pairs ride).
+            hu, hv, uux, vuy, uvx, vvy = (
+                kr_raw(h, u), kr_raw(h, v), kr_raw(u, ddx(u)),
+                kr_raw(v, ddy(u)), kr_raw(u, ddx(v)), kr_raw(v, ddy(v)))
+        elif cross:
+            # One BATCHED ACA for the stage's six quadratic products
+            # (identical shapes).  Measured ~neutral vs per-product
+            # calls on a single CPU core (the floor is the sequential
+            # per-iteration matvec, DESIGN.md), kept for dispatch
+            # hygiene and for batch-friendly backends.
+            raws = [kr_raw(h, u), kr_raw(h, v), kr_raw(u, ddx(u)),
+                    kr_raw(v, ddy(u)), kr_raw(u, ddx(v)),
+                    kr_raw(v, ddy(v))]
+            UA, VB = _aca6(jnp.stack([p[0] for p in raws]),
+                           jnp.stack([p[1] for p in raws]))
+            hu, hv, uux, vuy, uvx, vvy = [
+                (UA[i], VB[i]) for i in range(6)]
+        else:
+            # Products re-truncated to `rank` before differentiation
+            # keeps every stacked pair at rank r (step-and-truncate's
+            # core move).
+            prod = lambda x, y: kr_product(x, y, rank, sketch)
+            hu = prod(h, u)
+            hv = prod(h, v)
+            uux = prod(u, ddx(u))
+            vuy = prod(v, ddy(u))
+            uvx = prod(u, ddx(v))
+            vvy = prod(v, ddy(v))
 
         dh = [scale(ddx(hu), -sdt), scale(ddy(hv), -sdt)]
         du = [scale(uux, -sdt), scale(vuy, -sdt),
