@@ -60,11 +60,11 @@ def _areas_f64(n: int) -> np.ndarray:
     from ..geometry.cubed_sphere import _basis_and_metric, extended_coords
 
     ac, _, d = extended_coords(n, 0)
-    out = []
-    for f in range(6):
-        bb, aa = np.meshgrid(ac, ac, indexing="ij")
-        out.append(_basis_and_metric(f, aa, bb, 1.0)["sqrtg"] * d * d)
-    return np.stack(out)
+    bb, aa = np.meshgrid(ac, ac, indexing="ij")
+    # sqrtg is face-independent (the equiangular metric is a pure
+    # rotation of face 0), so one face broadcasts to all six.
+    a0 = _basis_and_metric(0, aa, bb, 1.0)["sqrtg"] * d * d
+    return np.broadcast_to(a0, (6,) + a0.shape).copy()
 
 
 def overlap_matrix(n_old: int, n_new: int) -> np.ndarray:
@@ -86,9 +86,9 @@ def regrid_state(state: Dict, n_new: int, dtype=None) -> Dict:
 
     Radius-invariant: both ``a1`` and ``D = W^T a2 W`` scale as
     ``radius**2`` and only their ratio enters, so the unit sphere is
-    used internally."""
-    import jax.numpy as jnp
-
+    used internally.  Leaves come back as HOST numpy arrays — callers
+    decide placement (a sharded resume must never materialize the full
+    arrays on one device)."""
     n_old = infer_resolution(state)
     if n_old == n_new:
         return state
@@ -108,5 +108,5 @@ def regrid_state(state: Dict, n_new: int, dtype=None) -> Dict:
             out[k] = v
             continue
         y = np.einsum("ai,...fij,bj->...fab", W, x * a1 / D, W)
-        out[k] = jnp.asarray(y, dtype=dtype or np.asarray(v).dtype)
+        out[k] = np.asarray(y, dtype=dtype or np.asarray(v).dtype)
     return out

@@ -68,16 +68,13 @@ def kr_product(x, y, rank: int, sketch=None):
     finding instead: project the R-dimensional bond space to k
     dimensions first (O(N R k)), then Gram-round the small form — the
     standard randomized-SVD guarantee puts the extra truncation error at
-    the sigma_{rank+1} level, i.e. at the rounding's own floor.
-    Passing ``sketch="cross"`` rounds by partially-pivoted ACA
-    (:func:`jaxstream.tt.cross.aca_lowrank`, the LANL route) — no
-    eigh/SVD at all.
+    the sigma_{rank+1} level, i.e. at the rounding's own floor.  (The
+    cross/ACA route lives in the stepper itself — ``rounding='cross'``
+    batches the six per-stage product ACAs; use
+    :func:`jaxstream.tt.cross.aca_lowrank` on ``kr_raw`` output
+    directly for one-off products.)
     """
     A, B = kr_raw(x, y)
-    if isinstance(sketch, str) and sketch == "cross":
-        from .cross import aca_lowrank
-
-        return aca_lowrank(A, B, rank)
     if sketch is None:
         return _round_factored(A, B, rank)
     # Randomized range finder (Halko-Martinsson-Tropp): Y = M @ sketch
@@ -137,7 +134,7 @@ def make_tt_swe_stepper(
     elif rounding == "exact":
         sketch = None
     elif cross:
-        sketch = "cross"
+        sketch = None               # unused: cross modes bypass kr_product
     else:
         raise ValueError(f"unknown rounding {rounding!r}")
 
