@@ -1,0 +1,6 @@
+"""Measured dead-end implementations, kept for the record.
+
+Each module here is a parity-tested negative experiment whose
+write-up lives in DESIGN.md ("Failed/negative experiments"); tests
+are opt-in (slow-marked).  Nothing imports from here at runtime.
+"""

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ...geometry.connectivity import (
+from ..geometry.connectivity import (
     EDGE_E,
     EDGE_N,
     EDGE_S,
@@ -41,15 +41,15 @@ from ...geometry.connectivity import (
     build_connectivity,
     edge_pairs,
 )
-from ...geometry.cubed_sphere import FACE_AXES
-from .swe_cov import (
+from ..geometry.cubed_sphere import FACE_AXES
+from ..ops.pallas.swe_cov import (
     _EORDER,
     _OUT_SIGN,
     _SLOT,
     _rotation_tables,
     rhs_core_cov,
 )
-from .swe_rhs import coord_rows, pick_recon
+from ..ops.pallas.swe_rhs import coord_rows, pick_recon
 
 __all__ = ["make_fused_ssprk3_cov_mega"]
 
@@ -141,7 +141,7 @@ def make_fused_ssprk3_cov_mega(
     Same carry and bitwise-identical results as the compact stepper
     (tested); the difference is purely where data lives between stages.
     """
-    from .swe_step import SSPRK3_COEFFS
+    from ..ops.pallas.swe_step import SSPRK3_COEFFS
 
     n, halo = grid.n, grid.halo
     h = halo

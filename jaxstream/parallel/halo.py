@@ -158,6 +158,23 @@ def _fill_corners(field, halo: int, n: int):
     return f
 
 
+def directed_copies(adj=None, schedule=None):
+    """Flatten the staged schedule to a static list of directed copies
+    ``(dst_face, dst_edge, src_face, src_edge, reversed)`` — shared
+    routing source of truth for the dense exchanger and the TT
+    factored-strip exchange (jaxstream.tt.sphere)."""
+    adj = adj or build_connectivity()
+    schedule = schedule or build_schedule(adj)
+    copies = []
+    for stage in schedule:
+        for link, back in stage:
+            copies.append((link.face, link.edge, link.nbr_face,
+                           link.nbr_edge, link.reversed_))
+            copies.append((back.face, back.edge, back.nbr_face,
+                           back.nbr_edge, back.reversed_))
+    return copies
+
+
 def make_halo_exchanger(
     n: int,
     halo: int,
@@ -173,16 +190,7 @@ def make_halo_exchanger(
     make races impossible — deck p.11 — the staging is kept as the
     documented communication schedule and for the shard_map path's benefit).
     """
-    adj = adj or build_connectivity()
-    schedule = schedule or build_schedule(adj)
-
-    # Flatten to a static list of directed copies: (dst_face, dst_edge,
-    # src_face, src_edge, reversed).
-    copies = []
-    for stage in schedule:
-        for link, back in stage:
-            copies.append((link.face, link.edge, link.nbr_face, link.nbr_edge, link.reversed_))
-            copies.append((back.face, back.edge, back.nbr_face, back.nbr_edge, back.reversed_))
+    copies = directed_copies(adj, schedule)
 
     m = n + 2 * halo
 

@@ -21,7 +21,7 @@ module runs the reference's flagship demo — cosine-bell advection
   ``Ca = sqrtg U^a``, ``Cb = sqrtg U^b`` (contravariant wind against
   the dual basis) and ``isg = 1/sqrtg`` — all smooth equiangular
   fields, factored once at build time to their numerical rank
-  (~1e-10 tolerance).  Products are Khatri-Rao pairs rounded by
+  (``coeff_tol``, default 1e-7).  Products are Khatri-Rao pairs rounded by
   cross/ACA (:mod:`jaxstream.tt.cross`) — no eigh/SVD in the step.
 * Discretization: 2nd-order centered flux differences on cell centers
   (the TT layer's own scheme; its dense twin
@@ -42,9 +42,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..geometry.connectivity import build_connectivity, build_schedule
-from ..parallel.halo import EDGE_E, EDGE_N, EDGE_S, EDGE_W
+from ..parallel.halo import (
+    EDGE_E,
+    EDGE_N,
+    EDGE_S,
+    EDGE_W,
+    directed_copies,
+)
 from .cross import aca_lowrank
+from .swe2d import kr_raw
 
 __all__ = [
     "factor_panels", "unfactor_panels", "tt_strip_ghosts",
@@ -74,21 +80,7 @@ def unfactor_panels(q) -> jnp.ndarray:
     return jnp.einsum("fnr,frm->fnm", A, B)
 
 
-def _copies():
-    """Static directed copy list [(dst_face, dst_edge, src_face,
-    src_edge, reversed)], same source of truth as the dense exchanger."""
-    adj = build_connectivity()
-    out = []
-    for stage in build_schedule(adj):
-        for link, back in stage:
-            out.append((link.face, link.edge, link.nbr_face,
-                        link.nbr_edge, link.reversed_))
-            out.append((back.face, back.edge, back.nbr_face,
-                        back.nbr_edge, back.reversed_))
-    return out
-
-
-_COPIES = _copies()
+_COPIES = directed_copies()
 
 
 def _read_strip_fact(A, B, face: int, edge: int, h: int):
@@ -200,13 +192,9 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
 
     aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
 
-    def kr_raw_f(x, y):
-        """Batched Khatri-Rao pair over faces."""
-        A1, B1 = x
-        A2, B2 = y
-        f, n_, r1 = A1.shape
-        return ((A1[:, :, :, None] * A2[:, :, None, :]).reshape(f, n_, -1),
-                (B1[:, :, None, :] * B2[:, None, :, :]).reshape(f, -1, n_))
+    # Batched-over-faces Khatri-Rao pair: same kernel (and column
+    # ordering convention) as the Cartesian layer's kr_raw.
+    kr_raw_f = jax.vmap(kr_raw)
 
     def rhs_pairs(q, scale):
         """Factor pairs (lists of (A (6,n,k), B (6,k,n))) of

@@ -375,7 +375,7 @@ def test_cov_fused_step_conserves_mass():
 def test_cov_nbr_step_parity():
     """Neighbor-read fused stepper (experimental) vs the jnp oracle."""
     from jaxstream.ops.fv import embed_interior
-    from jaxstream.ops.pallas.swe_cov import make_fused_ssprk3_cov_nbr
+    from jaxstream.experiments.swe_cov_nbr import make_fused_ssprk3_cov_nbr
 
     n = 12
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
@@ -490,7 +490,7 @@ def test_cov_mega_step_parity():
     VMEM-residency experiment).  h matches the compact stepper bitwise;
     all fields to ~ulp level (SMEM-loaded vs literal RK coefficients
     change constant folding; the drift compounds over steps)."""
-    from jaxstream.ops.pallas.swe_mega import make_fused_ssprk3_cov_mega
+    from jaxstream.experiments.swe_mega import make_fused_ssprk3_cov_mega
 
     n = 12
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)

@@ -111,7 +111,7 @@ def test_tpu_mega_step():
     import jax
     import jax.numpy as jnp
 
-    from jaxstream.ops.pallas.swe_mega import make_fused_ssprk3_cov_mega
+    from jaxstream.experiments.swe_mega import make_fused_ssprk3_cov_mega
 
     model, state = _tpu_model(96)
     step = make_fused_ssprk3_cov_mega(
