@@ -54,6 +54,7 @@ from .swe2d import kr_raw
 
 __all__ = [
     "factor_panels", "unfactor_panels", "tt_strip_ghosts",
+    "dense_strip_ghosts", "edge_resample", "resample_strip",
     "make_tt_sphere_advection", "make_dense_sphere_advection",
 ]
 
@@ -100,23 +101,31 @@ def _read_strip_fact(A, B, face: int, edge: int, h: int):
     raise ValueError(edge)
 
 
-def tt_strip_ghosts(q, h: int):
-    """Ghost strips for all faces from factored panels.
+def _read_strip_dense(q, face: int, edge: int, h: int):
+    """Dense twin of :func:`_read_strip_fact`: canonical (h, n) interior
+    boundary strip read straight from a ``(6, n, n)`` interior array."""
+    qf = q[face]
+    if edge == EDGE_S:
+        return qf[0:h, :]
+    if edge == EDGE_N:
+        return jnp.flip(qf[-h:, :], axis=-2)
+    if edge == EDGE_W:
+        return qf[:, 0:h].T
+    if edge == EDGE_E:
+        return jnp.flip(qf[:, -h:], axis=-1).T
+    raise ValueError(edge)
 
-    Returns ``(gS, gN, gW, gE)``: ``gS/gN (6, h, n)`` with depth index 0
-    = nearest the edge; ``gW/gE (6, n, h)`` likewise.  Exactly the
-    values the dense exchanger writes into the ghost ring (same
-    connectivity, canonicalization, and placement transforms), but no
-    extended array exists anywhere.
-    """
-    A, B = q
-    n = A.shape[1]
+
+def _route_strips(read_strip, h: int):
+    """Route canonical source strips through the connectivity table into
+    placed per-edge ghost blocks — the shared core of the factored and
+    dense strip exchanges.  ``read_strip(face, edge, h) -> (h, n)``."""
     gS = [None] * 6
     gN = [None] * 6
     gW = [None] * 6
     gE = [None] * 6
     for df, de, sf, se, rev in _COPIES:
-        s = _read_strip_fact(A, B, sf, se, h)
+        s = read_strip(sf, se, h)
         if rev:
             s = jnp.flip(s, axis=-1)
         # Place into the destination edge's ghost block with depth 0
@@ -130,6 +139,107 @@ def tt_strip_ghosts(q, h: int):
         elif de == EDGE_E:
             gE[df] = s.T
     return (jnp.stack(gS), jnp.stack(gN), jnp.stack(gW), jnp.stack(gE))
+
+
+def tt_strip_ghosts(q, h: int):
+    """Ghost strips for all faces from factored panels.
+
+    Returns ``(gS, gN, gW, gE)``: ``gS/gN (6, h, n)`` with depth index 0
+    = nearest the edge; ``gW/gE (6, n, h)`` likewise.  Exactly the
+    values the dense exchanger writes into the ghost ring (same
+    connectivity, canonicalization, and placement transforms), but no
+    extended array exists anywhere.
+    """
+    A, B = q
+    return _route_strips(lambda f, e, hh: _read_strip_fact(A, B, f, e, hh),
+                         h)
+
+
+def dense_strip_ghosts(q, h: int):
+    """Ghost strips for all faces from a dense ``(6, n, n)`` interior
+    array — same routing/placement as :func:`tt_strip_ghosts`, so dense
+    twins of factored operators can share stencil code exactly."""
+    return _route_strips(lambda f, e, hh: _read_strip_dense(q, f, e, hh), h)
+
+
+def edge_resample(n: int, d: float, depth: int = 1):
+    """Tangential resampling of a received ghost line onto the local
+    coordinate continuation — the collocation-scheme seam fix.
+
+    Geometry fact (verified to machine precision on all 24 edges in
+    tests/test_tt_sphere_diffusion.py): the neighbor cells feeding a
+    depth-``g`` ghost line lie **exactly on** the local continuation
+    line ``alpha = pi/4 + (g - 1/2) d`` — the gnomonic line is a great
+    circle in the plane mirror-symmetric through the cube edge — but at
+    tangential positions ``beta_src(k) = arctan(c * tan(beta'_k))``,
+    ``c = tan(pi/4 + (g - 1/2) d)``, fanned out by up to d/2 at the
+    edge ends.  Treating them as if at the uniform ``beta_j`` (what a
+    raw ghost copy does) is an O(d) value error — harmless to FV cell
+    averages, fatal to 1/d^2-weighted collocation stencils.
+
+    Returns ``(idx (n, 4) int32, wgt (n, 4))``: 4-point Lagrange
+    interpolation from the fanned source positions to the uniform
+    targets, O(d^4) on smooth fields; apply with
+    :func:`resample_strip`.  Static data — build once per operator.
+    """
+    if n < 4:
+        raise ValueError(f"edge_resample needs n >= 4 (got n={n}): the "
+                         "4-point Lagrange window cannot be formed")
+    b = -np.pi / 4 + (np.arange(n) + 0.5) * d
+    c = np.tan(np.pi / 4 + (depth - 0.5) * d)
+    src = np.arctan(c * np.tan(b))
+    lo = np.clip(np.searchsorted(src, b) - 2, 0, n - 4)
+    idx = lo[:, None] + np.arange(4)[None, :]             # (n, 4)
+    x = src[idx]                                          # (n, 4)
+    wgt = np.ones((n, 4))
+    for m in range(4):
+        for l in range(4):
+            if l != m:
+                wgt[:, m] *= (b - x[:, l]) / (x[:, m] - x[:, l])
+    return idx.astype(np.int32), wgt
+
+
+def resample_strip(s, idx, wgt):
+    """Apply :func:`edge_resample` along the last axis of ``s``
+    (``(..., n)`` ghost line) — a 4-tap gather, O(4 n)."""
+    return jnp.einsum("...nm,nm->...n", s[..., idx],
+                      jnp.asarray(wgt, s.dtype))
+
+
+def stack_pairs(pairs):
+    """Stack a list of factor pairs into one unrounded pair: the exact
+    factored form of the sum, rank = sum of ranks.  Single source of
+    truth for the (A on axis 2, B on axis 1) layout."""
+    return (jnp.concatenate([p[0] for p in pairs], axis=2),
+            jnp.concatenate([p[1] for p in pairs], axis=1))
+
+
+def _factored_stepper(rhs_pairs, aca, scheme: str) -> Callable:
+    """SSPRK3/Euler stepper over factored panel states, given
+    ``rhs_pairs(q, scale) -> (dA, dB)`` returning the rounded factor
+    pair of ``scale * dt * RHS(q)`` — shared by the advection and
+    diffusion factories."""
+
+    def combine(pairs):
+        return tuple(aca(*stack_pairs(pairs)))
+
+    def stage(y0, a, yc, b):
+        dA, dB = rhs_pairs(yc, b)
+        pairs = ([(a * y0[0], y0[1])] if a != 0.0 else []) \
+            + [(b * yc[0], yc[1]), (dA, dB)]
+        return combine(pairs)
+
+    def step(q):
+        if scheme == "euler":
+            dA, dB = rhs_pairs(q, 1.0)
+            return combine([(q[0], q[1]), (dA, dB)])
+        if scheme != "ssprk3":
+            raise ValueError(f"unknown scheme {scheme!r}")
+        y1 = stage(None, 0.0, q, 1.0)
+        y2 = stage(q, 0.75, y1, 0.25)
+        return stage(q, 1.0 / 3.0, y2, 2.0 / 3.0)
+
+    return step
 
 
 def _diff_last(x, inv2d):
@@ -186,6 +296,8 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
     CbS = jnp.asarray(Cb_e[:, h - 1, sl])
     CbN = jnp.asarray(Cb_e[:, h + n, sl])
 
+    ridx, rwgt = edge_resample(n, d)
+
     dtype = Ca_tt[0].dtype
     e0 = jnp.zeros((1, n), dtype).at[0, 0].set(1.0)
     eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
@@ -203,11 +315,14 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
         # Flux pairs F = C (.) q, rank r * r_c.
         Fa = kr_raw_f(Ca_tt, q)
         Fb = kr_raw_f(Cb_tt, q)
-        # Dense ghost values of the fluxes at the nearest ring.
-        FaW = CaW * gW[:, :, 0]                           # (6, n)
-        FaE = CaE * gE[:, :, 0]
-        FbS = CbS * gS[:, 0, :]
-        FbN = CbN * gN[:, 0, :]
+        # Dense ghost values of the fluxes at the nearest ring — ghost q
+        # resampled onto the local continuation positions (the seam fix,
+        # :func:`edge_resample`) where the static coefficients live.
+        rs = lambda v: resample_strip(v, ridx, rwgt)
+        FaW = CaW * rs(gW[:, :, 0])                       # (6, n)
+        FaE = CaE * rs(gE[:, :, 0])
+        FbS = CbS * rs(gS[:, 0, :])
+        FbN = CbN * rs(gN[:, 0, :])
         ones = jnp.ones((6, 1, 1), dtype)
         # D_a F: columns (axis -1): shifted-slice difference on the B
         # factor (O(n r), no (n, n) matrix) + rank-1 ghost corrections
@@ -228,34 +343,11 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
         # product's Khatri-Rao rank at r * r_c instead of
         # r_c * (2 r r_c + 4)), then multiply by isg and scale; the
         # stage combine performs the final rounding.
-        Astk = jnp.concatenate([p[0] for p in da + db], axis=2)
-        Bstk = jnp.concatenate([p[1] for p in da + db], axis=1)
-        dA, dB = aca(Astk, Bstk)
+        dA, dB = aca(*stack_pairs(da + db))
         Ai, Bi = kr_raw_f(isg_tt, (dA, dB))
         return (-(scale * dt)) * Ai, Bi
 
-    def combine(pairs):
-        Astk = jnp.concatenate([p[0] for p in pairs], axis=2)
-        Bstk = jnp.concatenate([p[1] for p in pairs], axis=1)
-        return tuple(aca(Astk, Bstk))
-
-    def stage(y0, a, yc, b):
-        dA, dB = rhs_pairs(yc, b)
-        pairs = ([(a * y0[0], y0[1])] if a != 0.0 else []) \
-            + [(b * yc[0], yc[1]), (dA, dB)]
-        return combine(pairs)
-
-    def step(q):
-        if scheme == "euler":
-            dA, dB = rhs_pairs(q, 1.0)
-            return combine([(q[0], q[1]), (dA, dB)])
-        if scheme != "ssprk3":
-            raise ValueError(f"unknown scheme {scheme!r}")
-        y1 = stage(None, 0.0, q, 1.0)
-        y2 = stage(q, 0.75, y1, 0.25)
-        return stage(q, 1.0 / 3.0, y2, 2.0 / 3.0)
-
-    return step
+    return _factored_stepper(rhs_pairs, aca, scheme)
 
 
 def make_dense_sphere_advection(grid, wind_ext, dt: float,
@@ -280,10 +372,18 @@ def make_dense_sphere_advection(grid, wind_ext, dt: float,
     isg = jnp.asarray(1.0 / sg[:, sl, sl])
     exchange = make_halo_exchanger(n, h, fill_corners=False)
     m = n + 2 * h
+    ridx, rwgt = edge_resample(n, d)
 
     def rhs(q):
         ext = jnp.zeros((6, m, m), q.dtype).at[:, sl, sl].set(q)
         ext = exchange(ext)
+        # Resample the depth-1 ghost lines (all the centered stencil
+        # reads) onto the continuation positions — same seam fix as the
+        # factored path, keeping the two twins the same discretization.
+        rs = lambda v: resample_strip(v, ridx, rwgt)
+        for line in ((slice(None), sl, h - 1), (slice(None), sl, h + n),
+                     (slice(None), h - 1, sl), (slice(None), h + n, sl)):
+            ext = ext.at[line].set(rs(ext[line]))
         F_a = Ca * ext
         F_b = Cb * ext
         da = (F_a[:, sl, h + 1:h + n + 1] - F_a[:, sl, h - 1:h + n - 1])

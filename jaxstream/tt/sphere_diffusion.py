@@ -1,0 +1,280 @@
+"""TT-compressed scalar diffusion on the cubed sphere — factored panels.
+
+Completes the deck's first demo (thermal diffusion of the checkerboard
+"Lima flag", pdf p.12/17) in rank-r factored form: the Laplace-Beltrami
+operator on each equiangular panel, stepped without ever materializing
+an ``(n, n)`` field.  Same machinery as :mod:`jaxstream.tt.sphere`
+(reconstructed-strip halo exchange, factored smooth coefficients,
+Khatri-Rao products rounded by cross/ACA) extended to second
+derivatives, whose cross term is the new design point.
+
+Discretization (the TT layer's own scheme; the dense twin
+:func:`make_dense_sphere_diffusion` shares the exact stencils and is
+the parity oracle):
+
+* Expanded non-conservative form — on a panel with metric ``g``,
+
+      lap q = g^aa D_aa q + 2 g^ab D_ab q + g^bb D_bb q
+              + L^a D_a q + L^b D_b q,
+      L^j   = (1/sqrtg) [ D_a(sqrtg g^aj) + D_b(sqrtg g^bj) ]
+
+  with all five coefficient fields (``g^aa, g^ab, g^bb, L^a, L^b``)
+  smooth equiangular functions, evaluated analytically in f64 at build
+  time and factored to their numerical rank.  Unlike the advection
+  flux form, no coefficient ghost values are needed: coefficients
+  multiply interior derivative fields pointwise.
+* Centered 2nd-order stencils with zero closure; ghost contributions
+  re-enter as **rank-1 correction pairs** built from the depth-1
+  reconstructed strips (a ghost column times a stencil selector row).
+* The cross derivative ``D_ab`` at panel-edge cells needs ghost values
+  displaced *along* the edge — including, at the four panel corners,
+  the cube-corner ghost where three panels meet and no 4th neighbor
+  exists (SURVEY.md "hard parts": corner treatment must be designed).
+  Design: each corner ghost is estimated once as the mean of the two
+  quadratic extrapolations along the adjacent received strips (FV3-style
+  one-sided closure), the **column** corrections own the corner terms
+  (their strips are corner-extended), and the **row** corrections use
+  zero-extended strips — so every stencil term is counted exactly once.
+
+State and conventions match :mod:`jaxstream.tt.sphere`: ``(A, B)`` with
+``q[f] = A[f] @ B[f]``, axis -2 = beta (rows), axis -1 = alpha (cols).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .cross import aca_lowrank
+from .swe2d import kr_raw
+from .sphere import (
+    _factored_stepper,
+    _numerical_rank,
+    dense_strip_ghosts,
+    edge_resample,
+    factor_panels,
+    resample_strip,
+    stack_pairs,
+    tt_strip_ghosts,
+)
+
+__all__ = ["make_tt_sphere_diffusion", "make_dense_sphere_diffusion"]
+
+
+def _diffusion_coeffs(grid):
+    """Interior f64 coefficient fields ``(Gaa, Gab, Gbb, La, Lb)`` of the
+    expanded Laplace-Beltrami operator, from the grid's dual basis
+    (``g^ij = a^i . a^j``) — evaluated on the extended grid so the
+    first-derivative coefficients ``L^j`` difference cleanly."""
+    n, h = grid.n, grid.halo
+    d = float(grid.dalpha)
+    sl = slice(h, h + n)
+    sg = np.asarray(grid.sqrtg, np.float64)               # (6, M, M)
+    aa = np.asarray(grid.a_a, np.float64)                 # (3, 6, M, M)
+    ab = np.asarray(grid.a_b, np.float64)
+    Gaa = np.einsum("cfij,cfij->fij", aa, aa)
+    Gab = np.einsum("cfij,cfij->fij", aa, ab)
+    Gbb = np.einsum("cfij,cfij->fij", ab, ab)
+    # L^j on the extended grid via centered differences (alpha = axis -1,
+    # beta = axis -2; np.gradient is centered everywhere but the outer
+    # ring, which lies outside the interior slice for halo >= 1).
+    da = lambda x: np.gradient(x, d, axis=-1)
+    db = lambda x: np.gradient(x, d, axis=-2)
+    isg = 1.0 / sg
+    La = isg * (da(sg * Gaa) + db(sg * Gab))
+    Lb = isg * (da(sg * Gab) + db(sg * Gbb))
+    return (Gaa[:, sl, sl], Gab[:, sl, sl], Gbb[:, sl, sl],
+            La[:, sl, sl], Lb[:, sl, sl])
+
+
+def _resampled_lines(ghosts, idx, wgt):
+    """Depth-1 ghost lines from placed strip blocks, tangentially
+    resampled onto the local continuation positions (the collocation
+    seam fix — :func:`jaxstream.tt.sphere.edge_resample`)."""
+    gS, gN, gW, gE = ghosts
+    rs = lambda v: resample_strip(v, idx, wgt)
+    return rs(gS[:, 0, :]), rs(gN[:, 0, :]), rs(gW[:, :, 0]), rs(gE[:, :, 0])
+
+
+def _corner_ghosts(gS0, gN0, gW0, gE0):
+    """The four cube-corner ghost estimates per face, each the mean of
+    the quadratic extrapolations along the two adjacent depth-1 strips.
+    Strips are placed layout: gS0/gN0 ``(6, n)`` indexed by column,
+    gW0/gE0 ``(6, n)`` indexed by row."""
+    # Quadratic extrapolation one spacing past the strip end: O(d^3)
+    # value error, so the corner cells' cross-derivative correction
+    # (1/d^2 weight) stays O(d) — linear extrapolation measurably
+    # plateaus the corner error at O(1).
+    ex0 = lambda v: 3.0 * (v[:, 0] - v[:, 1]) + v[:, 2]
+    exN = lambda v: 3.0 * (v[:, -1] - v[:, -2]) + v[:, -3]
+    sw = 0.5 * (ex0(gW0) + ex0(gS0))              # q[-1, -1]
+    se = 0.5 * (ex0(gE0) + exN(gS0))              # q[-1,  n]
+    nw = 0.5 * (exN(gW0) + ex0(gN0))              # q[ n, -1]
+    ne = 0.5 * (exN(gE0) + exN(gN0))              # q[ n,  n]
+    return sw, se, nw, ne
+
+
+def _edge_cdiff(core, lo, hi):
+    """Centered difference ``(v[i+1] - v[i-1]) / 2`` along a ghost line
+    ``[lo, core..., hi]`` — (6, n) from (6, n) core and (6,) end values
+    (spacing folded into the caller's scale)."""
+    ext = jnp.concatenate([lo[:, None], core, hi[:, None]], axis=1)
+    return 0.5 * (ext[:, 2:] - ext[:, :-2])
+
+
+def make_tt_sphere_diffusion(grid, kappa: float, dt: float, rank: int,
+                             coeff_tol: float = 1e-7,
+                             scheme: str = "ssprk3") -> Callable:
+    """Jit-able factored-panel diffusion step ``dq/dt = kappa * lap q``.
+
+    Coefficients are factored once at their own numerical rank
+    (equiangular ``g^ij`` / ``L^j`` are nearly exact low rank).  The
+    returned ``step((A, B)) -> (A, B)`` never materializes a panel.
+    """
+    n = grid.n
+    d = float(grid.dalpha)
+    inv2d = 1.0 / (2.0 * d)
+    invd2 = 1.0 / (d * d)
+
+    cfs = _diffusion_coeffs(grid)
+    Gaa_tt, Gab_tt, Gbb_tt, La_tt, Lb_tt = (
+        factor_panels(c, _numerical_rank(c, coeff_tol, 16)) for c in cfs)
+
+    ridx, rwgt = edge_resample(n, d)
+
+    dtype = Gaa_tt[0].dtype
+    e0 = jnp.zeros((1, n), dtype).at[0, 0].set(1.0)
+    eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
+    ones = jnp.ones((6, 1, 1), dtype)
+
+    aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
+    kr_raw_f = jax.vmap(kr_raw)
+    stack = stack_pairs
+
+    def rhs_pairs(q, scale):
+        A, B = q
+        gS0, gN0, gW0, gE0 = _resampled_lines(
+            tt_strip_ghosts(q, 1), ridx, rwgt)
+        sw, se, nw, ne = _corner_ghosts(gS0, gN0, gW0, gE0)
+
+        # First derivatives: factor-local shifted-slice diffs (zero
+        # closure) + rank-1 ghost corrections at the boundary lines.
+        dB = inv2d * (jnp.pad(B[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
+                      - jnp.pad(B[:, :, :-1], ((0, 0), (0, 0), (1, 0))))
+        dA = inv2d * (jnp.pad(A[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
+                      - jnp.pad(A[:, :-1, :], ((0, 0), (1, 0), (0, 0))))
+        Da = [(A, dB),
+              (gW0[:, :, None] * (-inv2d), ones * e0[None]),
+              (gE0[:, :, None] * inv2d, ones * eN[None])]
+        Db = [(dA, B),
+              (e0.T[None] * ones, gS0[:, None, :] * (-inv2d)),
+              (eN.T[None] * ones, gN0[:, None, :] * inv2d)]
+
+        # Second derivatives: 3-point zero-closure diff + ghost value
+        # re-entering with weight +1/d^2 at the boundary line.
+        d2B = invd2 * (jnp.pad(B[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
+                       + jnp.pad(B[:, :, :-1], ((0, 0), (0, 0), (1, 0)))
+                       - 2.0 * B)
+        d2A = invd2 * (jnp.pad(A[:, 1:, :], ((0, 0), (0, 1), (0, 0)))
+                       + jnp.pad(A[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
+                       - 2.0 * A)
+        Daa = [(A, d2B),
+               (gW0[:, :, None] * invd2, ones * e0[None]),
+               (gE0[:, :, None] * invd2, ones * eN[None])]
+        Dbb = [(d2A, B),
+               (e0.T[None] * ones, gS0[:, None, :] * invd2),
+               (eN.T[None] * ones, gN0[:, None, :] * invd2)]
+
+        # Cross derivative: both factors differenced (zero closure);
+        # boundary-line corrections are strip derivatives along the
+        # edge.  Column corrections use corner-extended strips (they own
+        # the corner terms); row corrections use zero-extended strips.
+        zero = jnp.zeros((6,), dtype)
+        cW = -inv2d * inv2d * _edge_cdiff(gW0, sw, nw) * 2.0
+        cE = inv2d * inv2d * _edge_cdiff(gE0, se, ne) * 2.0
+        rS = -inv2d * inv2d * _edge_cdiff(gS0, zero, zero) * 2.0
+        rN = inv2d * inv2d * _edge_cdiff(gN0, zero, zero) * 2.0
+        Dab = [(dA, dB),
+               (cW[:, :, None], ones * e0[None]),
+               (cE[:, :, None], ones * eN[None]),
+               (e0.T[None] * ones, rS[:, None, :]),
+               (eN.T[None] * ones, rN[:, None, :])]
+
+        terms = [kr_raw_f(Gaa_tt, stack(Daa)),
+                 kr_raw_f(Gbb_tt, stack(Dbb)),
+                 kr_raw_f(Gab_tt, stack([(2.0 * a, b) for a, b in Dab])),
+                 kr_raw_f(La_tt, stack(Da)),
+                 kr_raw_f(Lb_tt, stack(Db))]
+        Astk, Bstk = stack(terms)
+        dAo, dBo = aca(Astk, Bstk)
+        return (scale * dt * kappa) * dAo, dBo
+
+    return _factored_stepper(rhs_pairs, aca, scheme)
+
+
+def make_dense_sphere_diffusion(grid, kappa: float, dt: float,
+                                scheme: str = "ssprk3") -> Callable:
+    """Dense twin of :func:`make_tt_sphere_diffusion` — identical
+    stencils (zero-closure diffs + the same strip/corner corrections),
+    coefficients, and exchange; the parity oracle and speed baseline.
+    ``step(q (6, n, n)) -> (6, n, n)``."""
+    n = grid.n
+    d = float(grid.dalpha)
+    inv2d = 1.0 / (2.0 * d)
+    invd2 = 1.0 / (d * d)
+
+    Gaa, Gab, Gbb, La, Lb = (jnp.asarray(c, grid.sqrtg.dtype)
+                             for c in _diffusion_coeffs(grid))
+    ridx, rwgt = edge_resample(n, d)
+
+    def rhs(q):
+        dtype = q.dtype
+        gS0, gN0, gW0, gE0 = _resampled_lines(
+            dense_strip_ghosts(q, 1), ridx, rwgt)
+        sw, se, nw, ne = _corner_ghosts(gS0, gN0, gW0, gE0)
+
+        pad = lambda x, axis, side: jnp.pad(
+            x, [(0, 0) if a != axis % 3 else side for a in range(3)])
+        qe = pad(q[:, :, 1:], 2, (0, 1))      # shift left  (j+1)
+        qw = pad(q[:, :, :-1], 2, (1, 0))     # shift right (j-1)
+        qn = pad(q[:, 1:, :], 1, (0, 1))
+        qs = pad(q[:, :-1, :], 1, (1, 0))
+
+        Da = inv2d * (qe - qw)
+        Da = Da.at[:, :, 0].add(-inv2d * gW0).at[:, :, -1].add(inv2d * gE0)
+        Db = inv2d * (qn - qs)
+        Db = Db.at[:, 0, :].add(-inv2d * gS0).at[:, -1, :].add(inv2d * gN0)
+
+        Daa = invd2 * (qe + qw - 2.0 * q)
+        Daa = Daa.at[:, :, 0].add(invd2 * gW0).at[:, :, -1].add(invd2 * gE0)
+        Dbb = invd2 * (qn + qs - 2.0 * q)
+        Dbb = Dbb.at[:, 0, :].add(invd2 * gS0).at[:, -1, :].add(invd2 * gN0)
+
+        dj = inv2d * (qe - qw)
+        Dab = inv2d * (pad(dj[:, 1:, :], 1, (0, 1))
+                       - pad(dj[:, :-1, :], 1, (1, 0)))
+        zero = jnp.zeros((6,), dtype)
+        cW = -inv2d * inv2d * _edge_cdiff(gW0, sw, nw) * 2.0
+        cE = inv2d * inv2d * _edge_cdiff(gE0, se, ne) * 2.0
+        rS = -inv2d * inv2d * _edge_cdiff(gS0, zero, zero) * 2.0
+        rN = inv2d * inv2d * _edge_cdiff(gN0, zero, zero) * 2.0
+        Dab = (Dab.at[:, :, 0].add(cW).at[:, :, -1].add(cE)
+               .at[:, 0, :].add(rS).at[:, -1, :].add(rN))
+
+        return kappa * (Gaa * Daa + 2.0 * Gab * Dab + Gbb * Dbb
+                        + La * Da + Lb * Db)
+
+    def step(q):
+        if scheme == "euler":
+            return q + dt * rhs(q)
+        if scheme != "ssprk3":
+            raise ValueError(f"unknown scheme {scheme!r}")
+        y1 = q + dt * rhs(q)
+        y2 = 0.75 * q + 0.25 * (y1 + dt * rhs(y1))
+        return q / 3.0 + (2.0 / 3.0) * (y2 + dt * rhs(y2))
+
+    return step
