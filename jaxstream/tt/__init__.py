@@ -4,8 +4,13 @@ Deck p.3/p.5/p.19: TT compression of panel fields, the compressed-
 algebra layer (:mod:`.tensor_train`), operator-level TT stepping with a
 jit-able static-rank fast path (:mod:`.solver`), and the full nonlinear
 2-D SWE in factored form (:mod:`.swe2d`) — the LANL problem the deck
-cites, one step past its roadmap.  TT-compressed history output plugs
-into the pipeline via ``io.history_tt_rank``.
+cites, one step past its roadmap.  On the cubed sphere itself:
+factored-panel advection (:mod:`.sphere`), Laplace-Beltrami diffusion
+(:mod:`.sphere_diffusion`), and the full nonlinear SWE
+(:mod:`.sphere_swe`), all with reconstructed-strip halo exchange.
+Factored diagnostics live in :mod:`.diagnostics`, TT-compressed
+checkpoint payloads in :mod:`.store`; TT-compressed history output
+plugs into the pipeline via ``io.history_tt_rank``.
 """
 
 from .tensor_train import (

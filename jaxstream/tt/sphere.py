@@ -206,6 +206,17 @@ def resample_strip(s, idx, wgt):
                       jnp.asarray(wgt, s.dtype))
 
 
+def resampled_ghost_lines(ghosts, idx, wgt):
+    """Depth-1 ghost lines from placed strip blocks ``(gS, gN, gW,
+    gE)``, tangentially resampled onto the continuation points — the
+    shared seam-fix step of every collocation operator.  Returns a dict
+    ``'S'/'N'/'W'/'E' -> (6, n)``."""
+    gS, gN, gW, gE = ghosts
+    rs = lambda v: resample_strip(v, idx, wgt)
+    return {"S": rs(gS[:, 0, :]), "N": rs(gN[:, 0, :]),
+            "W": rs(gW[:, :, 0]), "E": rs(gE[:, :, 0])}
+
+
 def stack_pairs(pairs):
     """Stack a list of factor pairs into one unrounded pair: the exact
     factored form of the sum, rank = sum of ranks.  Single source of
@@ -214,25 +225,30 @@ def stack_pairs(pairs):
             jnp.concatenate([p[1] for p in pairs], axis=1))
 
 
-def _factored_stepper(rhs_pairs, aca, scheme: str) -> Callable:
-    """SSPRK3/Euler stepper over factored panel states, given
-    ``rhs_pairs(q, scale) -> (dA, dB)`` returning the rounded factor
-    pair of ``scale * dt * RHS(q)`` — shared by the advection and
-    diffusion factories."""
+def _factored_stepper_multi(rhs_pairs, aca, scheme: str) -> Callable:
+    """SSPRK3/Euler stepper over a TUPLE of factored panel fields.
+
+    ``rhs_pairs(state, scale)`` returns, per field, the (possibly
+    stacked, unrounded) factor pair of ``scale * dt * RHS(state)``;
+    each stage combine rounds per field.  Single source of the scheme
+    coefficients for every factored factory (advection, diffusion,
+    SWE)."""
 
     def combine(pairs):
         return tuple(aca(*stack_pairs(pairs)))
 
     def stage(y0, a, yc, b):
-        dA, dB = rhs_pairs(yc, b)
-        pairs = ([(a * y0[0], y0[1])] if a != 0.0 else []) \
-            + [(b * yc[0], yc[1]), (dA, dB)]
-        return combine(pairs)
+        ds = rhs_pairs(yc, b)
+        return tuple(
+            combine(([(a * y0[k][0], y0[k][1])] if a != 0.0 else [])
+                    + [(b * yc[k][0], yc[k][1]), ds[k]])
+            for k in range(len(ds)))
 
     def step(q):
         if scheme == "euler":
-            dA, dB = rhs_pairs(q, 1.0)
-            return combine([(q[0], q[1]), (dA, dB)])
+            ds = rhs_pairs(q, 1.0)
+            return tuple(combine([(q[k][0], q[k][1]), ds[k]])
+                         for k in range(len(ds)))
         if scheme != "ssprk3":
             raise ValueError(f"unknown scheme {scheme!r}")
         y1 = stage(None, 0.0, q, 1.0)
@@ -240,6 +256,14 @@ def _factored_stepper(rhs_pairs, aca, scheme: str) -> Callable:
         return stage(q, 1.0 / 3.0, y2, 2.0 / 3.0)
 
     return step
+
+
+def _factored_stepper(rhs_pairs, aca, scheme: str) -> Callable:
+    """Single-field convenience wrapper over
+    :func:`_factored_stepper_multi` (state is one ``(A, B)`` pair)."""
+    multi = _factored_stepper_multi(
+        lambda s, scale: (rhs_pairs(s[0], scale),), aca, scheme)
+    return lambda q: multi((q,))[0]
 
 
 def _diff_last(x, inv2d):

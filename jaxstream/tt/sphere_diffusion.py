@@ -57,7 +57,7 @@ from .sphere import (
     dense_strip_ghosts,
     edge_resample,
     factor_panels,
-    resample_strip,
+    resampled_ghost_lines,
     stack_pairs,
     tt_strip_ghosts,
 )
@@ -92,12 +92,10 @@ def _diffusion_coeffs(grid):
 
 
 def _resampled_lines(ghosts, idx, wgt):
-    """Depth-1 ghost lines from placed strip blocks, tangentially
-    resampled onto the local continuation positions (the collocation
-    seam fix — :func:`jaxstream.tt.sphere.edge_resample`)."""
-    gS, gN, gW, gE = ghosts
-    rs = lambda v: resample_strip(v, idx, wgt)
-    return rs(gS[:, 0, :]), rs(gN[:, 0, :]), rs(gW[:, :, 0]), rs(gE[:, :, 0])
+    """Depth-1 resampled ghost lines as an (S, N, W, E) tuple — thin
+    adapter over :func:`jaxstream.tt.sphere.resampled_ghost_lines`."""
+    L = resampled_ghost_lines(ghosts, idx, wgt)
+    return L["S"], L["N"], L["W"], L["E"]
 
 
 def _corner_ghosts(gS0, gN0, gW0, gE0):

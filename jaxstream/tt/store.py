@@ -1,0 +1,69 @@
+"""TT-compressed checkpointing of dense states.
+
+Two directions, both riding the existing Orbax
+:class:`jaxstream.io.checkpoint.CheckpointManager` unchanged (it
+accepts any pytree, so a *factored run's* state — pairs of thin factors
+— already checkpoints compressed with no code here):
+
+* ``compress_state``: factor each compressible ``(6, n, n)`` leaf of a
+  *dense* state to rank r before saving — an O(n/r)-smaller restart
+  artifact with SVD-truncation (lossy, bounded, reported) error;
+* ``decompress_state``: reconstruct on restore.
+
+Non-2D / non-float leaves and panels needing full rank pass through
+unchanged (marked raw), so the round trip is always well-defined.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .sphere import factor_panels, unfactor_panels
+
+__all__ = ["compress_state", "decompress_state"]
+
+
+def _compressible(v) -> bool:
+    a = np.asarray(v)
+    return (a.ndim == 3 and a.dtype.kind == "f"
+            and a.shape[1] == a.shape[2] and a.shape[2] > 0)
+
+
+def compress_state(state: Dict[str, Any], rank: int) -> Dict[str, Any]:
+    """Dense state dict -> TT-compressed checkpoint payload.
+
+    Each compressible leaf ``name`` becomes ``name__ttA`` /
+    ``name__ttB`` (balanced SVD factors, rank ``min(rank, n)``); other
+    leaves pass through.  Inverse: :func:`decompress_state`.
+    """
+    out: Dict[str, Any] = {"__tt_rank__": int(rank)}
+    for k, v in state.items():
+        n = np.asarray(v).shape[-1] if _compressible(v) else 0
+        # Factor only when the factors are actually smaller (2 r n <
+        # n^2); a panel needing full-ish rank passes through raw.
+        if _compressible(v) and 2 * min(rank, n) * n < n * n:
+            A, B = factor_panels(np.asarray(v), min(rank, n))
+            out[k + "__ttA"] = A
+            out[k + "__ttB"] = B
+        else:
+            out[k] = v
+    return out
+
+
+def decompress_state(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`compress_state` (idempotent on raw states)."""
+    out: Dict[str, Any] = {}
+    for k, v in payload.items():
+        if k == "__tt_rank__" or k.endswith("__ttB"):
+            continue
+        if k.endswith("__ttA"):
+            name = k[: -len("__ttA")]
+            out[name] = unfactor_panels((jnp.asarray(v),
+                                         jnp.asarray(payload[name + "__ttB"])))
+        else:
+            out[k] = v
+    return out

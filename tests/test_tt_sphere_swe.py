@@ -1,0 +1,92 @@
+"""TT shallow-water on the cubed sphere: TC2 steadiness/convergence of
+the dense twin, TT/dense parity, and factored-physics tracking."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.physics import initial_conditions as ics
+from jaxstream.tt.sphere import factor_panels, unfactor_panels
+from jaxstream.tt.sphere_swe import (
+    covariant_from_cartesian,
+    make_dense_sphere_swe,
+    make_tt_sphere_swe,
+)
+
+
+def _tc2(n):
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    h_ext, v_ext = ics.williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    h0 = np.asarray(grid.interior(h_ext))
+    ua0, ub0 = covariant_from_cartesian(grid, v_ext)
+    return grid, h0, ua0, ub0
+
+
+def _dense_tc2_error(n, T, dt):
+    grid, h0, ua0, ub0 = _tc2(n)
+    step = jax.jit(make_dense_sphere_swe(grid, dt))
+    s = (jnp.asarray(h0), jnp.asarray(ua0), jnp.asarray(ub0))
+    for _ in range(int(T / dt)):
+        s = step(s)
+    return (np.linalg.norm(np.asarray(s[0]) - h0)
+            / np.linalg.norm(h0))
+
+
+def test_tc2_steady():
+    """TC2 is an exact steady state: the discrete solution must hold it
+    to truncation over 6 sim-hours."""
+    assert _dense_tc2_error(24, 6 * 3600.0, 300.0) < 4e-4
+
+
+@pytest.mark.slow
+def test_tc2_second_order():
+    """The TC2 truncation shrinks at 2nd order under refinement
+    (measured ratio 4.01 at 6 h, C24 -> C48)."""
+    T = 6 * 3600.0
+    e24 = _dense_tc2_error(24, T, 300.0)
+    e48 = _dense_tc2_error(48, T, 150.0)
+    assert e48 < e24 / 3.2, (e24, e48)
+
+
+@pytest.mark.slow
+def test_tt_swe_matches_dense_twin():
+    """Full-ish rank + tight coefficient tolerance -> the factored SWE
+    step is the same discretization as its dense twin to rounding."""
+    n = 16
+    grid, h0, ua0, ub0 = _tc2(n)
+    # Euler: same rhs/combine code paths as ssprk3 at 1/3 the compile
+    # (the factored step is compile-heavy on CPU: ~36 vmapped ACA loops
+    # per ssprk3 step).
+    dense = jax.jit(make_dense_sphere_swe(grid, 400.0, scheme="euler"))
+    tt = jax.jit(make_tt_sphere_swe(grid, 400.0, rank=n,
+                                    coeff_tol=1e-13, scheme="euler"))
+    s = (jnp.asarray(h0), jnp.asarray(ua0), jnp.asarray(ub0))
+    p = tuple(factor_panels(x, n) for x in (h0, ua0, ub0))
+    for _ in range(5):
+        s = dense(s)
+        p = tt(p)
+    for i in range(3):
+        err = (np.max(np.abs(np.asarray(unfactor_panels(p[i]))
+                             - np.asarray(s[i])))
+               / np.max(np.abs(np.asarray(s[i]))))
+        assert err < 1e-8, (i, err)
+
+
+@pytest.mark.slow
+def test_tt_swe_tc2_physics_low_rank():
+    """At practical low rank the factored TC2 run must stay near the
+    steady state (TC2's fields are low-rank: h is rank<=3 exactly)."""
+    n = 24
+    grid, h0, ua0, ub0 = _tc2(n)
+    rank = 8
+    tt = jax.jit(make_tt_sphere_swe(grid, 300.0, rank=rank))
+    p = tuple(factor_panels(x, rank) for x in (h0, ua0, ub0))
+    for _ in range(72):                       # 6 sim-hours
+        p = tt(p)
+    hN = np.asarray(unfactor_panels(p[0]))
+    err = np.linalg.norm(hN - h0) / np.linalg.norm(h0)
+    assert err < 1e-3, err
