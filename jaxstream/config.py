@@ -70,6 +70,11 @@ class ModelConfig:
     limiter: str = "mc"              # 'minmod' | 'mc' | 'vanleer' | 'none'
     backend: str = "jnp"             # 'jnp' | 'pallas' RHS stencils
     ic_angle: float = 0.0            # flow-orientation angle (TC1/TC2 alpha)
+    # The deck's "Numerics (TT)" pipeline stage (pdf p.7): 'tt' runs the
+    # factored-panel solver tier (jaxstream.tt.sphere*) — every panel
+    # field a rank-`tt_rank` factor pair, nothing (n, n) materialized.
+    numerics: str = "dense"          # 'dense' | 'tt'
+    tt_rank: int = 16                # factored-state rank when numerics='tt'
 
 
 @dataclasses.dataclass(frozen=True)

@@ -80,18 +80,31 @@ class Simulation:
             cfg.grid.n, halo=halo, radius=cfg.grid.radius, dtype=dtype,
             metrics=cfg.grid.metrics,
         )
-        self.model, self.state = self._build_model_and_state()
+        # The deck's "Numerics (TT)" tier (pdf p.7): factored-panel
+        # solvers behind the same config/IO surface.
+        self._tt_keys = None
+        self._tt_hs = None
         self.t = 0.0
         self.step_count = 0
-
-        par = cfg.parallelization
         self.setup = None
-        if par.num_devices > 1:
-            self.setup = setup_sharding(cfg)
-            self.state = shard_state(self.setup, self.state)
-        self._step = make_stepper_for(
-            self.model, self.setup, self.state, cfg.time.dt, cfg.time.scheme
-        )
+        if cfg.model.numerics == "tt":
+            self.model = None
+            self.state, self._step = self._build_tt()
+        elif cfg.model.numerics != "dense":
+            raise ValueError(
+                f"model.numerics={cfg.model.numerics!r}; valid: 'dense' "
+                "(production solvers) or 'tt' (factored-panel tier)")
+        else:
+            self.model, self.state = self._build_model_and_state()
+
+            par = cfg.parallelization
+            if par.num_devices > 1:
+                self.setup = setup_sharding(cfg)
+                self.state = shard_state(self.setup, self.state)
+            self._step = make_stepper_for(
+                self.model, self.setup, self.state, cfg.time.dt,
+                cfg.time.scheme
+            )
         # Single-device Pallas SWE runs use the fused extended-state
         # SSPRK3 stepper (the bench flagship): extend/restrict happen once
         # per compiled segment, so the strip carry stays on device between
@@ -132,10 +145,16 @@ class Simulation:
         self.checkpoints: Optional[CheckpointManager] = None
         if io.history_stride > 0:
             save_geometry(io.history_path + ".geometry", self.grid)
+            hist_rank = io.history_tt_rank or None
+            if self._tt_keys is not None and hist_rank:
+                log.info("numerics='tt': state snapshots are already "
+                         "factored; ignoring io.history_tt_rank")
+                hist_rank = None
             self.history = HistoryWriter(
                 io.history_path,
-                attrs={"model": mcfg.name, "ic": mcfg.initial_condition},
-                tt_rank=io.history_tt_rank or None,
+                attrs={"model": mcfg.name, "ic": mcfg.initial_condition,
+                       "numerics": mcfg.numerics},
+                tt_rank=hist_rank,
             )
         if io.checkpoint_stride > 0:
             self.checkpoints = CheckpointManager(io.checkpoint_path)
@@ -189,6 +208,117 @@ class Simulation:
         )
         return model, model.initial_state(h, v)
 
+    def _build_tt(self):
+        """The factored-panel ("Numerics (TT)", pdf p.7) solver tier.
+
+        Single-device research numerics: every prognostic is a rank-r
+        factor pair stored in the state dict as ``name__ttA`` /
+        ``name__ttB`` (the same naming the TT history/checkpoint layers
+        use), so history snapshots and Orbax checkpoints are compressed
+        for free.  Returns ``(state, step)`` with ``step(y, t) -> y``
+        over the flat dict.
+        """
+        from .tt.sphere import factor_panels, make_tt_sphere_advection
+        from .tt.sphere_diffusion import make_tt_sphere_diffusion
+        from .tt.sphere_swe import (
+            covariant_from_cartesian, make_tt_sphere_swe,
+        )
+
+        cfg = self.config
+        m, p, g, tc = cfg.model, cfg.physics, self.grid, cfg.time
+        par = cfg.parallelization
+        if par.num_devices > 1 or par.use_shard_map:
+            raise ValueError(
+                "model.numerics='tt' is a single-device tier; set "
+                "parallelization.num_devices: 1 and use_shard_map: false "
+                "(the factored state is O(n r) per panel — sharding it "
+                "is not supported)")
+        if tc.scheme not in ("ssprk3", "euler"):
+            raise ValueError(
+                f"model.numerics='tt' supports time.scheme 'ssprk3' or "
+                f"'euler', not {tc.scheme!r}")
+        if p.hyperdiffusion != 0.0:
+            raise ValueError(
+                "model.numerics='tt' has no nu4 hyperdiffusion; set "
+                "physics.hyperdiffusion: 0 (or run numerics: dense)")
+        rank = m.tt_rank
+        name = m.initial_condition
+        family = IC_FAMILY.get(name)
+        if family is None:
+            raise ValueError(
+                f"unknown initial_condition {name!r}; valid: "
+                f"{sorted(IC_FAMILY)}")
+        if m.name not in ("auto", family):
+            raise ValueError(
+                f"model.name={m.name!r} is incompatible with "
+                f"initial_condition={name!r} (which drives {family!r}; "
+                "the TT tier has no model-name variants — use 'auto')")
+        if (m.scheme, m.limiter, m.backend) != ("plr", "mc", "jnp"):
+            log.info("numerics='tt' uses its own centered factored "
+                     "discretization; model.scheme/limiter/backend are "
+                     "ignored")
+        fac = lambda q: factor_panels(np.asarray(q, np.float64), rank)
+
+        if family == "advection":
+            u0 = 2 * math.pi * g.radius / (12 * 86400.0)
+            wind = ics.solid_body_wind(g, u0, alpha_rot=m.ic_angle)
+            tt_step = make_tt_sphere_advection(g, wind, tc.dt, rank,
+                                               scheme=tc.scheme)
+            keys = ("q",)
+            pairs = (fac(g.interior(ics.cosine_bell(g))),)
+            single = True
+        elif family == "diffusion":
+            tt_step = make_tt_sphere_diffusion(g, p.diffusivity, tc.dt,
+                                               rank, scheme=tc.scheme)
+            keys = ("T",)
+            pairs = (fac(g.interior(ics.checkerboard(g))),)
+            single = True
+        else:
+            b_ext = None
+            if name == "tc2":
+                h, v = ics.williamson_tc2(g, p.gravity, p.omega,
+                                          alpha_rot=m.ic_angle)
+            elif name == "tc5":
+                h, v, b_ext = ics.williamson_tc5(g, p.gravity, p.omega)
+            elif name == "tc6":
+                h, v = ics.williamson_tc6(g, p.gravity, p.omega)
+            else:
+                h, v = ics.galewsky(g, p.gravity, p.omega)
+            tt_step = make_tt_sphere_swe(
+                g, tc.dt, rank, hs=b_ext, omega=p.omega,
+                gravity=p.gravity, scheme=tc.scheme)
+            ua, ub = covariant_from_cartesian(g, v)
+            keys = ("h", "ua", "ub")
+            pairs = (fac(g.interior(h)), fac(ua), fac(ub))
+            single = False
+            self._tt_hs = b_ext
+        self._tt_keys = keys
+        log.info("using factored (TT) %s tier, rank %d", family, rank)
+
+        state = {}
+        for k, (A, B) in zip(keys, pairs):
+            state[k + "__ttA"] = A
+            state[k + "__ttB"] = B
+
+        def step(y, t):
+            del t
+            ps = tuple((y[k + "__ttA"], y[k + "__ttB"]) for k in keys)
+            out = tt_step(ps[0]) if single else tt_step(ps)
+            if single:
+                out = (out,)
+            return {kk + s: pair[i]
+                    for kk, pair in zip(keys, out)
+                    for i, s in ((0, "__ttA"), (1, "__ttB"))}
+
+        return state, step
+
+    def _tt_dense(self, key: str):
+        """Reconstruct one factored prognostic to a dense (6, n, n)."""
+        from .tt.sphere import unfactor_panels
+
+        return unfactor_panels((self.state[key + "__ttA"],
+                                self.state[key + "__ttB"]))
+
     # ---------------------------------------------------------------- running
     def _maybe_resume(self):
         step = self.checkpoints.latest_step()
@@ -201,6 +331,43 @@ class Simulation:
 
         state, self.t = self.checkpoints.restore_host(step)
         n_new = self.config.grid.n
+        ckpt_tt = any(k.endswith("__ttA") for k in state)
+        run_tt = self._tt_keys is not None
+        if ckpt_tt != run_tt:
+            raise ValueError(
+                "checkpoint/run numerics mismatch: the checkpoint is "
+                f"{'factored (TT)' if ckpt_tt else 'dense'} but the run is "
+                f"{'factored (TT)' if run_tt else 'dense'}; set "
+                "model.numerics to match, or convert with "
+                "jaxstream.tt.store.compress_state/decompress_state")
+        if run_tt:
+            want = {k + s for k in self._tt_keys
+                    for s in ("__ttA", "__ttB")}
+            if set(state) != want:
+                raise ValueError(
+                    f"TT checkpoint prognostics {sorted(state)} do not "
+                    f"match this run's {sorted(want)}: the checkpoint "
+                    "was written by a different model family — point "
+                    "io.checkpoint_path somewhere else")
+            n_ckpt = next(np.asarray(v).shape[1] for k, v in state.items()
+                          if k.endswith("__ttA"))
+            r_ckpt = next(np.asarray(v).shape[2] for k, v in state.items()
+                          if k.endswith("__ttA"))
+            if n_ckpt != n_new:
+                raise ValueError(
+                    f"TT checkpoint is C{n_ckpt} but the run is C{n_new}: "
+                    "cross-resolution resume is dense-only — restart "
+                    "dense, or decompress_state + regrid manually")
+            if r_ckpt != self.config.model.tt_rank:
+                raise ValueError(
+                    f"TT checkpoint rank {r_ckpt} != run tt_rank "
+                    f"{self.config.model.tt_rank}: set model.tt_rank: "
+                    f"{r_ckpt}, or re-factor the state manually")
+            self.state = jax.tree_util.tree_map(jnp.asarray, state)
+            self.step_count = step
+            log.info("resumed factored (TT) state from checkpoint step %d "
+                     "(t=%.0f s)", step, self.t)
+            return
         n_ckpt = infer_resolution(state)   # raises clearly on ambiguity
         if n_ckpt != n_new:
             # Resolution-aware resume (SURVEY.md §5): conservative
@@ -256,6 +423,30 @@ class Simulation:
         """Scalar invariants for the current state (model-appropriate)."""
         g, s = self.grid, self.state
         out: Dict[str, float] = {}
+        if self._tt_keys is not None:
+            from .tt.diagnostics import tt_total_mass
+
+            pair = lambda k: (s[k + "__ttA"], s[k + "__ttB"])
+            if self._tt_keys == ("q",):
+                out["tracer_mass"] = float(tt_total_mass(g, pair("q")))
+                out["tracer_max"] = float(jnp.max(self._tt_dense("q")))
+            elif self._tt_keys == ("T",):
+                out["heat"] = float(tt_total_mass(g, pair("T")))
+            else:
+                h = self._tt_dense("h")
+                ua = self._tt_dense("ua")
+                ub = self._tt_dense("ub")
+                out["mass"] = float(diag.total_mass(g, h))
+                sl = slice(g.halo, g.halo + g.n)
+                aa = jnp.asarray(g.a_a)[:, :, sl, sl]
+                ab = jnp.asarray(g.a_b)[:, :, sl, sl]
+                v = aa * ua[None] + ab * ub[None]
+                b_int = (g.interior(jnp.asarray(self._tt_hs))
+                         if self._tt_hs is not None else 0.0)
+                p = self.config.physics
+                out["energy"] = float(
+                    diag.total_energy(g, h, v, p.gravity, b_int))
+            return out
         if "h" in s:
             p = self.config.physics
             out["mass"] = float(diag.total_mass(g, s["h"]))
