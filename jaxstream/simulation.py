@@ -178,35 +178,50 @@ class Simulation:
                 f"model.name={m.name!r} is incompatible with "
                 f"initial_condition={name!r} (which drives {family!r})"
             )
+        fields = self._ic_fields(name, family)
         if family == "advection":
-            u0 = 2 * math.pi * g.radius / (12 * 86400.0)
-            wind = ics.solid_body_wind(g, u0, alpha_rot=m.ic_angle)
-            model = TracerAdvection(g, wind, scheme=m.scheme, limiter=m.limiter)
-            q = ics.cosine_bell(g)
-            return model, model.initial_state(q)
+            model = TracerAdvection(g, fields["wind"], scheme=m.scheme,
+                                    limiter=m.limiter)
+            return model, model.initial_state(fields["q"])
         if family == "diffusion":
             model = ThermalDiffusion(g, kappa=p.diffusivity)
-            return model, model.initial_state(ics.checkerboard(g))
-        b_ext = None
-        if name == "tc2":
-            h, v = ics.williamson_tc2(g, p.gravity, p.omega, alpha_rot=m.ic_angle)
-        elif name == "tc5":
-            h, v, b_ext = ics.williamson_tc5(g, p.gravity, p.omega)
-        elif name == "tc6":
-            h, v = ics.williamson_tc6(g, p.gravity, p.omega)
-        else:
-            h, v = ics.galewsky(g, p.gravity, p.omega)
+            return model, model.initial_state(fields["T"])
         cls = ShallowWater
         if m.name == "shallow_water_cov":
             from .models.shallow_water_cov import CovariantShallowWater
 
             cls = CovariantShallowWater
         model = cls(
-            g, gravity=p.gravity, omega=p.omega, b_ext=b_ext,
+            g, gravity=p.gravity, omega=p.omega, b_ext=fields["b_ext"],
             scheme=m.scheme, limiter=m.limiter, nu4=p.hyperdiffusion,
             backend=m.backend,
         )
-        return model, model.initial_state(h, v)
+        return model, model.initial_state(fields["h"], fields["v"])
+
+    def _ic_fields(self, name: str, family: str):
+        """The extended IC fields for one IC-family — the single
+        dispatch shared by the dense and TT tiers, so their initial
+        states can never drift apart (the dense twin is the TT parity
+        oracle)."""
+        cfg = self.config
+        m, p, g = cfg.model, cfg.physics, self.grid
+        if family == "advection":
+            u0 = 2 * math.pi * g.radius / (12 * 86400.0)
+            return {"wind": ics.solid_body_wind(g, u0, alpha_rot=m.ic_angle),
+                    "q": ics.cosine_bell(g)}
+        if family == "diffusion":
+            return {"T": ics.checkerboard(g)}
+        b_ext = None
+        if name == "tc2":
+            h, v = ics.williamson_tc2(g, p.gravity, p.omega,
+                                      alpha_rot=m.ic_angle)
+        elif name == "tc5":
+            h, v, b_ext = ics.williamson_tc5(g, p.gravity, p.omega)
+        elif name == "tc6":
+            h, v = ics.williamson_tc6(g, p.gravity, p.omega)
+        else:
+            h, v = ics.galewsky(g, p.gravity, p.omega)
+        return {"h": h, "v": v, "b_ext": b_ext}
 
     def _build_tt(self):
         """The factored-panel ("Numerics (TT)", pdf p.7) solver tier.
@@ -242,6 +257,12 @@ class Simulation:
                 "model.numerics='tt' has no nu4 hyperdiffusion; set "
                 "physics.hyperdiffusion: 0 (or run numerics: dense)")
         rank = m.tt_rank
+        if not 0 < rank <= g.n:
+            raise ValueError(
+                f"model.tt_rank={rank} must be in [1, grid.n={g.n}] "
+                "(the SVD factors cap at bond dim n, but the step's "
+                "rounding rank is exactly tt_rank — a larger value "
+                "would break the integration carry shapes)")
         name = m.initial_condition
         family = IC_FAMILY.get(name)
         if family is None:
@@ -258,38 +279,28 @@ class Simulation:
                      "discretization; model.scheme/limiter/backend are "
                      "ignored")
         fac = lambda q: factor_panels(np.asarray(q, np.float64), rank)
+        fields = self._ic_fields(name, family)
 
         if family == "advection":
-            u0 = 2 * math.pi * g.radius / (12 * 86400.0)
-            wind = ics.solid_body_wind(g, u0, alpha_rot=m.ic_angle)
-            tt_step = make_tt_sphere_advection(g, wind, tc.dt, rank,
-                                               scheme=tc.scheme)
+            tt_step = make_tt_sphere_advection(g, fields["wind"], tc.dt,
+                                               rank, scheme=tc.scheme)
             keys = ("q",)
-            pairs = (fac(g.interior(ics.cosine_bell(g))),)
+            pairs = (fac(g.interior(fields["q"])),)
             single = True
         elif family == "diffusion":
             tt_step = make_tt_sphere_diffusion(g, p.diffusivity, tc.dt,
                                                rank, scheme=tc.scheme)
             keys = ("T",)
-            pairs = (fac(g.interior(ics.checkerboard(g))),)
+            pairs = (fac(g.interior(fields["T"])),)
             single = True
         else:
-            b_ext = None
-            if name == "tc2":
-                h, v = ics.williamson_tc2(g, p.gravity, p.omega,
-                                          alpha_rot=m.ic_angle)
-            elif name == "tc5":
-                h, v, b_ext = ics.williamson_tc5(g, p.gravity, p.omega)
-            elif name == "tc6":
-                h, v = ics.williamson_tc6(g, p.gravity, p.omega)
-            else:
-                h, v = ics.galewsky(g, p.gravity, p.omega)
+            b_ext = fields["b_ext"]
             tt_step = make_tt_sphere_swe(
                 g, tc.dt, rank, hs=b_ext, omega=p.omega,
                 gravity=p.gravity, scheme=tc.scheme)
-            ua, ub = covariant_from_cartesian(g, v)
+            ua, ub = covariant_from_cartesian(g, fields["v"])
             keys = ("h", "ua", "ub")
-            pairs = (fac(g.interior(h)), fac(ua), fac(ub))
+            pairs = (fac(g.interior(fields["h"])), fac(ua), fac(ub))
             single = False
             self._tt_hs = b_ext
         self._tt_keys = keys

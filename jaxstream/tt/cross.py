@@ -33,7 +33,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["aca_lowrank"]
+__all__ = ["aca_lowrank", "aca_lowrank_many"]
 
 
 def aca_lowrank(P, Q, k: int):
@@ -88,3 +88,38 @@ def aca_lowrank(P, Q, k: int):
         0, k, body,
         (U0, V0, j0, jnp.zeros((n,), bool), jnp.zeros((m,), bool)))
     return U, V
+
+
+def aca_lowrank_many(ops, k: int):
+    """Round MANY independent face-batched operands in ONE ACA sweep.
+
+    ``ops``: list of stacked factor pairs ``(A (F, n, R_i), B (F, R_i,
+    n))`` with differing bond ranks ``R_i``.  Zero-pads every operand to
+    ``max R_i`` (zero bond columns leave ``P @ Q`` unchanged, so the
+    rounding is identical), stacks to one ``(len(ops) * F, ...)`` batch,
+    and runs a single vmapped :func:`aca_lowrank`.  Returns the list of
+    rounded ``(U (F, n, k), V (k, n))`` pairs.
+
+    This is the TT analogue of kernel-launch batching: on TPU the
+    factored SWE step was measured latency-bound on its ~36 *sequential*
+    vmapped ACA loops (DESIGN.md "Round 2 (cont.)"); independent
+    roundings grouped here run as one fori_loop instead of one per
+    operand.
+    """
+    if not ops:
+        return []
+    R = max(A.shape[-1] for A, _ in ops)
+    F = ops[0][0].shape[0]
+    if any(A.shape[0] != F or B.shape[0] != F for A, B in ops):
+        raise ValueError(
+            "aca_lowrank_many needs a common face/batch count; got "
+            f"{[(A.shape[0], B.shape[0]) for A, B in ops]}")
+    padded_A = [jnp.pad(A, ((0, 0), (0, 0), (0, R - A.shape[-1])))
+                for A, _ in ops]
+    padded_B = [jnp.pad(B, ((0, 0), (0, R - B.shape[-2]), (0, 0)))
+                for _, B in ops]
+    As = jnp.concatenate(padded_A, axis=0)
+    Bs = jnp.concatenate(padded_B, axis=0)
+    U, V = jax.vmap(lambda a, b: aca_lowrank(a, b, k))(As, Bs)
+    return [(U[i * F:(i + 1) * F], V[i * F:(i + 1) * F])
+            for i in range(len(ops))]

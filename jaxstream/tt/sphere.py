@@ -225,30 +225,32 @@ def stack_pairs(pairs):
             jnp.concatenate([p[1] for p in pairs], axis=1))
 
 
-def _factored_stepper_multi(rhs_pairs, aca, scheme: str) -> Callable:
+def _factored_stepper_multi(rhs_pairs, rnd_many, scheme: str) -> Callable:
     """SSPRK3/Euler stepper over a TUPLE of factored panel fields.
 
     ``rhs_pairs(state, scale)`` returns, per field, the (possibly
     stacked, unrounded) factor pair of ``scale * dt * RHS(state)``;
-    each stage combine rounds per field.  Single source of the scheme
-    coefficients for every factored factory (advection, diffusion,
-    SWE)."""
+    ``rnd_many(list of stacked pairs) -> list of rounded pairs`` rounds
+    every field's stage combine in ONE batched sweep (sequential-ACA
+    latency is the TPU wall — see cross.aca_lowrank_many).  Single
+    source of the scheme coefficients for every factored factory
+    (advection, diffusion, SWE)."""
 
-    def combine(pairs):
-        return tuple(aca(*stack_pairs(pairs)))
+    def combines(per_field_pairs):
+        return tuple(rnd_many([stack_pairs(p) for p in per_field_pairs]))
 
     def stage(y0, a, yc, b):
         ds = rhs_pairs(yc, b)
-        return tuple(
-            combine(([(a * y0[k][0], y0[k][1])] if a != 0.0 else [])
-                    + [(b * yc[k][0], yc[k][1]), ds[k]])
-            for k in range(len(ds)))
+        return combines([
+            ([(a * y0[k][0], y0[k][1])] if a != 0.0 else [])
+            + [(b * yc[k][0], yc[k][1]), ds[k]]
+            for k in range(len(ds))])
 
     def step(q):
         if scheme == "euler":
             ds = rhs_pairs(q, 1.0)
-            return tuple(combine([(q[k][0], q[k][1]), ds[k]])
-                         for k in range(len(ds)))
+            return combines([[(q[k][0], q[k][1]), ds[k]]
+                             for k in range(len(ds))])
         if scheme != "ssprk3":
             raise ValueError(f"unknown scheme {scheme!r}")
         y1 = stage(None, 0.0, q, 1.0)
@@ -260,9 +262,11 @@ def _factored_stepper_multi(rhs_pairs, aca, scheme: str) -> Callable:
 
 def _factored_stepper(rhs_pairs, aca, scheme: str) -> Callable:
     """Single-field convenience wrapper over
-    :func:`_factored_stepper_multi` (state is one ``(A, B)`` pair)."""
+    :func:`_factored_stepper_multi` (state is one ``(A, B)`` pair;
+    ``aca`` is the face-vmapped rounding fn)."""
+    rnd_many = lambda ops: [tuple(aca(*p)) for p in ops]
     multi = _factored_stepper_multi(
-        lambda s, scale: (rhs_pairs(s[0], scale),), aca, scheme)
+        lambda s, scale: (rhs_pairs(s[0], scale),), rnd_many, scheme)
     return lambda q: multi((q,))[0]
 
 

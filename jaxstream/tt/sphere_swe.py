@@ -56,7 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import EARTH_GRAVITY, EARTH_OMEGA
-from .cross import aca_lowrank
+from .cross import aca_lowrank, aca_lowrank_many
 from .swe2d import kr_raw
 from .sphere import (
     _diff_last,
@@ -159,7 +159,8 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                        coeff_tol: float = 1e-7,
                        omega: float = EARTH_OMEGA,
                        gravity: float = EARTH_GRAVITY,
-                       scheme: str = "ssprk3") -> Callable:
+                       scheme: str = "ssprk3",
+                       batch_rounding=None) -> Callable:
     """Jit-able factored-panel SWE step.
 
     State: ``((hA, hB), (uaA, uaB), (ubA, ubB))`` — rank-``rank``
@@ -186,9 +187,19 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
     eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
     ones = jnp.ones((6, 1, 1), dtype)
 
-    aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
     kr = jax.vmap(kr_raw)
-    rnd = lambda pairs: tuple(aca(*stack_pairs(pairs)))
+    if batch_rounding is None:
+        # Measured trade (DESIGN.md): batching the independent ACA
+        # sweeps wins on accelerators (dispatch-latency-bound, -14..23%
+        # on v5e) and loses on CPU (the zero-padding to the largest
+        # operand's bond rank adds real memory traffic, up to 1.8x at
+        # C1536).
+        batch_rounding = jax.default_backend() != "cpu"
+    if batch_rounding:
+        rnd_many = lambda ops: aca_lowrank_many(ops, rank)
+    else:
+        aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
+        rnd_many = lambda ops: [tuple(aca(*p)) for p in ops]
 
     def da_pairs(pair, W, E):
         """Factor pairs of D_a(pair) with ghost-line corrections."""
@@ -215,34 +226,40 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                 vl[X].append(lc[X])
         G = _ghost_composites(hl, vl, ES, gravity)
 
-        # --- interior factored intermediates (each rounded to rank) ---
-        uua = rnd([kr(gaa_tt, uap), kr(gab_tt, ubp)])       # u^a
-        uub = rnd([kr(gab_tt, uap), kr(gbb_tt, ubp)])       # u^b
-        sgh = rnd([kr(sg_tt, hp)])                          # sqrtg h
-        mau = rnd([kr(sg_tt, uua)])                         # sqrtg u^a
-        mbu = rnd([kr(sg_tt, uub)])                         # sqrtg u^b
+        # --- interior factored intermediates, rounded in TWO batched
+        # sweeps (sequential ACA latency is the TPU wall; the operands
+        # within each sweep are independent — cross.aca_lowrank_many).
+        stk = stack_pairs
+        # Sweep 1: u^a, u^b, sqrtg h, and the curl (needs only
+        # primitives + ghost lines).
+        curl_ops = (da_pairs(ubp, G["W"]["ub"], G["E"]["ub"])
+                    + [(-a, b) for a, b in
+                       db_pairs(uap, G["S"]["ua"], G["N"]["ua"])])
+        uua, uub, sgh, curl = rnd_many([
+            stk([kr(gaa_tt, uap), kr(gab_tt, ubp)]),
+            stk([kr(gab_tt, uap), kr(gbb_tt, ubp)]),
+            stk([kr(sg_tt, hp)]),
+            stk(curl_ops),
+        ])
 
-        # --- continuity ---
-        div = rnd(da_pairs(kr(sgh, uua), G["W"]["Fa"], G["E"]["Fa"])
-                  + db_pairs(kr(sgh, uub), G["S"]["Fb"], G["N"]["Fb"]))
-        dh = kr(isg_tt, div)
-        dh = ((-scale * dt) * dh[0], dh[1])
-
-        # --- K + Phi (rounded) ---
+        # Sweep 2: everything needing sweep 1 — flux divergence, K+Phi,
+        # absolute vorticity, sqrtg u^i.
         kp_pairs = [(0.5 * a, b) for a, b in
                     (kr(uap, uua), kr(ubp, uub))]
         kp_pairs.append((gravity * hp[0], hp[1]))
         if hs_tt is not None:
             kp_pairs.append((gravity * hs_tt[0], hs_tt[1]))
-        KP = rnd(kp_pairs)
+        div, KP, zeta, mau, mbu = rnd_many([
+            stk(da_pairs(kr(sgh, uua), G["W"]["Fa"], G["E"]["Fa"])
+                + db_pairs(kr(sgh, uub), G["S"]["Fb"], G["N"]["Fb"])),
+            stk(kp_pairs),
+            stk([kr(isg_tt, curl), f_tt]),
+            stk([kr(sg_tt, uua)]),
+            stk([kr(sg_tt, uub)]),
+        ])
 
-        # --- absolute vorticity (rounded) ---
-        curl = rnd(da_pairs(ubp, G["W"]["ub"], G["E"]["ub"])
-                   + [(-a, b) for a, b in
-                      db_pairs(uap, G["S"]["ua"], G["N"]["ua"])])
-        zeta = rnd([kr(isg_tt, curl), f_tt])
-
-        # --- momentum ---
+        dh = kr(isg_tt, div)
+        dh = ((-scale * dt) * dh[0], dh[1])
         dua = [kr(zeta, mbu)] + [(-a, b) for a, b in
                                  da_pairs(KP, G["W"]["KP"], G["E"]["KP"])]
         dub = [(-a, b) for a, b in ([kr(zeta, mau)]
@@ -251,7 +268,7 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
             [((scale * dt) * a, b) for a, b in pairs])
         return dh, sc(dua), sc(dub)
 
-    return _factored_stepper_multi(rhs3, aca, scheme)
+    return _factored_stepper_multi(rhs3, rnd_many, scheme)
 
 
 def make_dense_sphere_swe(grid, dt: float,
