@@ -72,7 +72,10 @@ def factor_panels(q, rank: int):
 def _numerical_rank(q, tol: float, cap: int) -> int:
     """Smallest rank covering every face to ``tol`` relative (<= cap)."""
     s = np.linalg.svd(np.asarray(q, np.float64), compute_uv=False)
-    need = int(np.max((s / s[:, :1] > tol).sum(axis=1)))
+    # Identically-zero faces (e.g. a localized topography away from its
+    # panel) have s[0] = 0: they need rank 0, not a 0/0 warning.
+    lead = np.where(s[:, :1] > 0.0, s[:, :1], 1.0)
+    need = int(np.max((s / lead > tol).sum(axis=1)))
     return max(1, min(cap, need))
 
 

@@ -52,28 +52,56 @@ def test_tc2_second_order():
     assert e48 < e24 / 3.2, (e24, e48)
 
 
-@pytest.mark.slow
-def test_tt_swe_matches_dense_twin():
-    """Full-ish rank + tight coefficient tolerance -> the factored SWE
-    step is the same discretization as its dense twin to rounding."""
-    n = 16
-    grid, h0, ua0, ub0 = _tc2(n)
-    # Euler: same rhs/combine code paths as ssprk3 at 1/3 the compile
-    # (the factored step is compile-heavy on CPU: ~36 vmapped ACA loops
-    # per ssprk3 step).
-    dense = jax.jit(make_dense_sphere_swe(grid, 400.0, scheme="euler"))
-    tt = jax.jit(make_tt_sphere_swe(grid, 400.0, rank=n,
+def _parity_run(grid, h0, ua0, ub0, dt, steps, hs=None, tol=1e-8):
+    """Run the dense twin and the full-rank/tight-tol factored step side
+    by side (Euler: same rhs/combine code paths as ssprk3 at 1/3 the
+    compile), assert per-field parity, return the dense final state."""
+    n = grid.n
+    dense = jax.jit(make_dense_sphere_swe(grid, dt, hs=hs,
+                                          scheme="euler"))
+    tt = jax.jit(make_tt_sphere_swe(grid, dt, rank=n, hs=hs,
                                     coeff_tol=1e-13, scheme="euler"))
     s = (jnp.asarray(h0), jnp.asarray(ua0), jnp.asarray(ub0))
     p = tuple(factor_panels(x, n) for x in (h0, ua0, ub0))
-    for _ in range(5):
+    for _ in range(steps):
         s = dense(s)
         p = tt(p)
     for i in range(3):
         err = (np.max(np.abs(np.asarray(unfactor_panels(p[i]))
                              - np.asarray(s[i])))
                / np.max(np.abs(np.asarray(s[i]))))
-        assert err < 1e-8, (i, err)
+        assert err < tol, (i, err)
+    return s
+
+
+@pytest.mark.slow
+def test_tt_swe_matches_dense_twin():
+    """Full-ish rank + tight coefficient tolerance -> the factored SWE
+    step is the same discretization as its dense twin to rounding."""
+    grid, h0, ua0, ub0 = _tc2(16)
+    _parity_run(grid, h0, ua0, ub0, dt=400.0, steps=5)
+
+
+@pytest.mark.slow
+def test_tt_swe_tc5_topography_matches_dense():
+    """The hs (bottom topography) path: TC5's mountain enters K+Phi and
+    the ghost composites; full-ish rank factored vs dense twin, and the
+    mountain measurably deflects the flow vs an hs=None run."""
+    n = 16
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    h_ext, v_ext, b_ext = ics.williamson_tc5(grid, EARTH_GRAVITY,
+                                             EARTH_OMEGA)
+    h0 = np.asarray(grid.interior(h_ext))
+    ua0, ub0 = covariant_from_cartesian(grid, v_ext)
+    s = _parity_run(grid, h0, ua0, ub0, dt=300.0, steps=5, hs=b_ext)
+    # hs is actually plumbed through: the same run WITHOUT the mountain
+    # must differ by much more than truncation drift.
+    flat = jax.jit(make_dense_sphere_swe(grid, 300.0, scheme="euler"))
+    sf = (jnp.asarray(h0), jnp.asarray(ua0), jnp.asarray(ub0))
+    for _ in range(5):
+        sf = flat(sf)
+    dh = np.max(np.abs(np.asarray(s[0]) - np.asarray(sf[0])))
+    assert dh > 1.0, dh     # meters; mountain-scale, not roundoff
 
 
 @pytest.mark.slow
