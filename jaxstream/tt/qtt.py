@@ -1,0 +1,347 @@
+"""QTT (order-d quantized TT) operator numerics — jit-able, O(log N).
+
+The deck's compression claim is "N x N -> O(d N r^2)" (p.3); the
+*quantized* TT form goes further: reshape the (N, N) field into base-b
+digits (``tensor_train.quantize_shape``) and a smooth field's state is
+``O(d b^2 r^2)`` with ``d = 2 log_b N`` — **sublinear in N**.  Round 1/2
+built the compression layer (:mod:`.tensor_train`) and order-2 factored
+*solvers*; this module closes the order-d gap: linear operators as
+**TT-matrices** over the digit chain and a **static-rank two-sweep
+rounding**, so an entire PDE step — matvec, add, round — runs inside
+``jax.jit`` on cores whose shapes never depend on data.
+
+Layout: the (N, N) field (index ``[y, x]``) becomes the order-2k tensor
+``[y_0, x_0, y_1, x_1, ...]`` — digits most-significant first,
+interleaved for locality (same digit convention as
+``tensor_train.tt_compress_field``, but unmerged so each core owns ONE
+digit of ONE axis, which is what makes per-axis operators cheap).
+
+Operators: the periodic shift-by-one on a k-digit base-b index is an
+exact TT-matrix of bond 2 — the bond carries the "carry" bit of the
+increment; an axis operator threads that bond unchanged through the
+other axis' digit cores.  The 5-point periodic Laplacian is then
+``Sx + Sx' + Sy + Sy' - 4 I`` by block-diagonal TT-matrix addition
+(bond 9, exact — no operator rounding needed).
+
+References: Oseledets 2011 (TT), Kazeev & Khoromskij 2012 (explicit
+QTT ranks of the 1-D Laplacian); deck p.3/5/19 for the thesis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .tensor_train import (
+    TTTensor,
+    _block_diag_cores,
+    quantize_shape,
+    tt_decompose,
+    tt_reconstruct,
+)
+
+__all__ = [
+    "interleaved_digits", "qtt_compress", "qtt_compress_separable",
+    "qtt_decompress",
+    "shift_ttm", "identity_ttm", "ttm_add", "ttm_scale", "ttm_matvec",
+    "laplacian_ttm", "tt_round_static", "make_qtt_diffusion_stepper",
+]
+
+
+# --------------------------------------------------------------- layout
+
+def interleaved_digits(N: int, base: int = 4) -> List[int]:
+    """Digit dims of the interleaved order-2k layout for an (N, N)
+    field: ``[b, b, ..., b]`` of length ``2k`` with ``N = b^k``."""
+    dy = quantize_shape(N, base)
+    if any(v != base for v in dy):
+        raise ValueError(f"N={N} is not a power of base={base}")
+    return [base] * (2 * len(dy))
+
+
+def _to_digit_tensor(q, base: int):
+    """(N, N) -> interleaved digit tensor [y0, x0, y1, x1, ...]."""
+    k = len(quantize_shape(q.shape[0], base))
+    perm = [i for pair in zip(range(k), range(k, 2 * k)) for i in pair]
+    return jnp.transpose(jnp.asarray(q).reshape((base,) * (2 * k)), perm)
+
+
+def _from_digit_tensor(t, base: int):
+    k = t.ndim // 2
+    inv = [2 * i for i in range(k)] + [2 * i + 1 for i in range(k)]
+    N = base ** k
+    return jnp.transpose(t, inv).reshape(N, N)
+
+
+def _pad_bond(c, r0: int, r1: int):
+    """Zero-pad a core's bond dims up to (r0, n, r1)."""
+    return jnp.pad(c, ((0, r0 - c.shape[0]), (0, 0),
+                       (0, r1 - c.shape[2])))
+
+
+def qtt_compress(q, rank: int, base: int = 4) -> List[jnp.ndarray]:
+    """(N, N) -> static-rank core list (every bond exactly ``rank``,
+    zero-padded past the field's numerical rank) in the interleaved
+    digit layout.  Eager (TT-SVD); the stepper itself is jit-able."""
+    t = _to_digit_tensor(np.asarray(q, np.float64), base)
+    tt = tt_decompose(t, max_rank=rank)
+    d = len(tt.cores)
+    return [_pad_bond(c,
+                      1 if j == 0 else rank,
+                      1 if j == d - 1 else rank)
+            for j, c in enumerate(tt.cores)]
+
+
+def qtt_decompress(cores: Sequence[jnp.ndarray], base: int = 4):
+    """Core list -> dense (N, N)."""
+    return _from_digit_tensor(tt_reconstruct(TTTensor(list(cores))), base)
+
+
+def qtt_compress_separable(rows, cols, rank: int,
+                           base: int = 4) -> List[jnp.ndarray]:
+    """Static-rank QTT cores of ``sum_k outer(rows[k], cols[k])``
+    WITHOUT ever forming the (N, N) field — O(K N) work, so state prep
+    stays feasible at N far beyond dense-array reach (N = 65536 is a
+    128 MB field per f64 copy; its QTT state is a few kB).
+
+    Each 1-D factor is TT-decomposed over its own digits (cheap); a
+    term's interleaved 2-D cores are the factor cores Kronecker-threaded
+    past the other axis' bond; terms sum block-diagonally and one
+    static-rank rounding brings the result to ``rank``.
+    """
+    rows = np.asarray(rows, np.float64)
+    cols = np.asarray(cols, np.float64)
+    if rows.ndim == 1:
+        rows, cols = rows[None], cols[None]
+    K, N = rows.shape
+    k = len(quantize_shape(N, base))
+    terms = []
+    for t in range(K):
+        vy = tt_decompose(rows[t].reshape((base,) * k)).cores
+        vx = tt_decompose(cols[t].reshape((base,) * k)).cores
+        cores = []
+        for j in range(k):
+            ry0, _, ry1 = vy[j].shape
+            rx0, _, rx1 = vx[j].shape
+            # y_j: act on the y digit, thread the x bond (dim rx0).
+            eye_x = jnp.eye(rx0)
+            cores.append(jnp.einsum("anb,cd->acnbd", vy[j], eye_x)
+                         .reshape(ry0 * rx0, base, ry1 * rx0))
+            # x_j: act on the x digit, thread the (new) y bond — bond
+            # index order is y-major on both sides, matching the y_j
+            # cores' (ry, rx) flattening.
+            eye_y = jnp.eye(ry1)
+            cores.append(jnp.einsum("ef,anb->eanfb", eye_y, vx[j])
+                         .reshape(ry1 * rx0, base, ry1 * rx1))
+        terms.append(cores)
+    # Block-diagonal sum of the K terms, then one fixed-rank rounding.
+    d = 2 * k
+    summed = terms[0]
+    for term in terms[1:]:
+        summed = [_block_diag_cores(a, b, j == 0, j == d - 1)
+                  for j, (a, b) in enumerate(zip(summed, term))]
+    out = tt_round_static(summed, rank)
+    return [_pad_bond(c,
+                      1 if j == 0 else rank,
+                      1 if j == d - 1 else rank)
+            for j, c in enumerate(out)]
+
+
+# ---------------------------------------------------- TT-matrix algebra
+# A TT-matrix is a list of cores (r, n_out, n_in, r').
+
+def _carry_core(b: int, sign: int) -> np.ndarray:
+    """The (2, b, b, 2) core of periodic shift-by-(+-1): left bond =
+    carry OUT toward the more significant digit, right bond = carry IN
+    from the less significant side.  ``core[c, d', d, cin] = 1`` iff
+    ``d' = (d + sign*cin) mod b`` and ``c = 1`` exactly when the
+    addition wrapped."""
+    core = np.zeros((2, b, b, 2))
+    for d in range(b):
+        for cin in (0, 1):
+            v = d + sign * cin
+            core[1 if (v < 0 or v >= b) else 0, v % b, d, cin] = 1.0
+    return core
+
+
+def _pass_core(b: int) -> np.ndarray:
+    """Identity on the digit, bond (2) threaded through unchanged."""
+    core = np.zeros((2, b, b, 2))
+    for c in (0, 1):
+        for d in range(b):
+            core[c, d, d, c] = 1.0
+    return core
+
+
+def shift_ttm(N: int, axis: int, sign: int,
+              base: int = 4) -> List[jnp.ndarray]:
+    """TT-matrix of the periodic shift ``q[..., i, ...] -> q[..., i+s,
+    ...]`` along ``axis`` (0 = y, 1 = x) of the (N, N) field, on the
+    interleaved digit chain.  Exact, bond 2.
+
+    ``sign=+1`` gives the matrix with ``M[i', i] = 1`` iff ``i' = i + 1
+    mod N``, i.e. ``(M q)[i] = q[i - 1]`` — values move forward.  The
+    Laplacian uses both signs, so either convention closes it.
+    """
+    dims = interleaved_digits(N, base)
+    cy = _carry_core(base, sign)
+    pas = _pass_core(base)
+    cores = []
+    for j, b in enumerate(dims):
+        is_axis = (j % 2) == axis
+        cores.append(jnp.asarray(cy if is_axis else pas))
+    # Boundary closure: the chain's right end injects carry = 1 (the
+    # "+1"); the left end sums both carry states (mod-N wrap).  The
+    # digits run most-significant-first, the axis' LAST digit core is
+    # its least significant — but non-axis cores pass the bond through,
+    # so closing at the chain ends is equivalent.
+    left = jnp.asarray(np.ones((1, 2)))       # sum over final carry
+    right = jnp.asarray(np.array([[0.0], [1.0]]))  # inject carry=1
+    cores[0] = jnp.einsum("ab,bxyc->axyc", left, cores[0])
+    cores[-1] = jnp.einsum("axyb,bc->axyc", cores[-1], right)
+    return cores
+
+
+def identity_ttm(N: int, base: int = 4) -> List[jnp.ndarray]:
+    return [jnp.eye(b)[None, :, :, None]
+            for b in interleaved_digits(N, base)]
+
+
+def ttm_scale(op: Sequence[jnp.ndarray], s: float) -> List[jnp.ndarray]:
+    out = list(op)
+    out[0] = out[0] * s
+    return out
+
+
+def ttm_add(*ops: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Block-diagonal TT-matrix sum (bonds add)."""
+    d = len(ops[0])
+    out = []
+    for j in range(d):
+        cs = [op[j] for op in ops]
+        n_out, n_in = cs[0].shape[1], cs[0].shape[2]
+        if j == 0:
+            out.append(jnp.concatenate(cs, axis=3))
+        elif j == d - 1:
+            out.append(jnp.concatenate(cs, axis=0))
+        else:
+            r0 = sum(c.shape[0] for c in cs)
+            r1 = sum(c.shape[3] for c in cs)
+            blk = jnp.zeros((r0, n_out, n_in, r1), cs[0].dtype)
+            a = b = 0
+            for c in cs:
+                blk = blk.at[a:a + c.shape[0], :, :,
+                             b:b + c.shape[3]].set(c)
+                a += c.shape[0]
+                b += c.shape[3]
+            out.append(blk)
+    return out
+
+
+def ttm_matvec(op: Sequence[jnp.ndarray],
+               x: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Apply a TT-matrix to a TT-vector core-by-core (bonds multiply)."""
+    out = []
+    for co, cx in zip(op, x):
+        c = jnp.einsum("aijb,cjd->acibd", co, cx)
+        out.append(c.reshape(co.shape[0] * cx.shape[0], co.shape[1],
+                             co.shape[3] * cx.shape[2]))
+    return out
+
+
+def laplacian_ttm(N: int, base: int = 4) -> List[jnp.ndarray]:
+    """The 5-point periodic Laplacian (unit spacing) as an exact
+    TT-matrix (bond 9) on the interleaved digit chain."""
+    ops = [shift_ttm(N, a, s, base) for a in (0, 1) for s in (1, -1)]
+    ops.append(ttm_scale(identity_ttm(N, base), -4.0))
+    return ttm_add(*ops)
+
+
+# ------------------------------------------------- static-rank rounding
+
+def tt_round_static(cores: Sequence[jnp.ndarray],
+                    rank: int) -> List[jnp.ndarray]:
+    """Two-sweep TT rounding at a FIXED output rank — fully jit-able.
+
+    Right-to-left QR sweep orthogonalizes; the left-to-right truncation
+    sweep QRs the (tall, possibly exactly rank-deficient) unfolding
+    first — Householder QR is robust to zero columns — and SVDs only
+    the small triangular factor, the same small-square-SVD shape class
+    as the production ``solver._round_factored`` coupling core (runs
+    NaN-free under jit where XLA's SVD of *tall rank-deficient
+    unfoldings* is the documented eager-only failure mode,
+    tensor_train.py).  Every bond truncates to ``min(rank, bond)`` and
+    zero-pads back to exactly ``rank`` (interior bonds), so output
+    shapes are static regardless of the input's (static) bond dims;
+    padded directions are exact zeros.
+    """
+    d = len(cores)
+    cs = list(cores)
+    # Right-to-left orthogonalization (row-orthonormal right cores).
+    for j in range(d - 1, 0, -1):
+        r0, n, r1 = cs[j].shape
+        q, r = jnp.linalg.qr(cs[j].reshape(r0, n * r1).T)
+        k = q.shape[1]                       # min(r0, n*r1), static
+        cs[j] = q.T.reshape(k, n, r1)
+        cs[j - 1] = jnp.einsum("anb,cb->anc", cs[j - 1], r)
+    # Left-to-right truncation sweep (QR + small-core SVD).
+    for j in range(d - 1):
+        r0, n, r1 = cs[j].shape
+        q2, r2 = jnp.linalg.qr(cs[j].reshape(r0 * n, r1))
+        u, s, vt = jnp.linalg.svd(r2)        # (min(m,r1), r1): small
+        k = min(rank, s.shape[0])
+        Q = q2 @ u[:, :k]
+        R = s[:k, None] * vt[:k, :]
+        if k < rank:
+            Q = jnp.pad(Q, ((0, 0), (0, rank - k)))
+            R = jnp.pad(R, ((0, rank - k), (0, 0)))
+        cs[j] = Q.reshape(r0, n, rank)
+        cs[j + 1] = jnp.einsum("ab,bnc->anc", R, cs[j + 1])
+    return cs
+
+
+# ------------------------------------------------------------- stepper
+
+def make_qtt_diffusion_stepper(N: int, kappa: float, dx: float,
+                               dt: float, rank: int, base: int = 4,
+                               scheme: str = "ssprk3") -> Callable:
+    """Jit-able QTT step for 2-D periodic diffusion ``q_t = kappa lap q``.
+
+    The state is the static-rank core list of :func:`qtt_compress`; the
+    step is matvec (bond-9 operator), axpy, and two-sweep rounding —
+    every shape static, cost independent of N (O(d) small SVDs).
+    """
+    # Default real dtype (f64 under jax_enable_x64, else f32) — the
+    # operator entries are exact small integers times kappa/dx^2.
+    dtype = jnp.zeros(()).dtype
+    L = [jnp.asarray(c, dtype)
+         for c in ttm_scale(laplacian_ttm(N, base), kappa / (dx * dx))]
+
+    def axpy(a, x, y):
+        """a*x + y at static rank (block-diag add, then round)."""
+        d = len(x)
+        out = [_block_diag_cores(x[j] * (a if j == 0 else 1.0), y[j],
+                                 j == 0, j == d - 1)
+               for j in range(d)]
+        return tt_round_static(out, rank)
+
+    def rhs_step(y, scale):
+        return axpy(scale * dt, ttm_matvec(L, y), y)
+
+    def step(y):
+        if scheme == "euler":
+            return rhs_step(y, 1.0)
+        if scheme != "ssprk3":
+            raise ValueError(f"unknown scheme {scheme!r}")
+        scale0 = lambda ys, a: [c * (a if j == 0 else 1.0)
+                                for j, c in enumerate(ys)]
+        y1 = rhs_step(y, 1.0)
+        # y2 = 3/4 y + 1/4 (y1 + dt L y1)
+        y2 = axpy(0.25, rhs_step(y1, 1.0), scale0(y, 0.75))
+        # y' = 1/3 y + 2/3 (y2 + dt L y2)
+        return axpy(2.0 / 3.0, rhs_step(y2, 1.0), scale0(y, 1.0 / 3.0))
+
+    return step
