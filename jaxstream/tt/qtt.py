@@ -33,21 +33,23 @@ from typing import Callable, List, Sequence
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from .tensor_train import (
     TTTensor,
     _block_diag_cores,
     quantize_shape,
-    tt_decompose,
     tt_reconstruct,
 )
 
 __all__ = [
     "interleaved_digits", "qtt_compress", "qtt_compress_separable",
     "qtt_decompress",
-    "shift_ttm", "identity_ttm", "ttm_add", "ttm_scale", "ttm_matvec",
-    "laplacian_ttm", "tt_round_static", "make_qtt_diffusion_stepper",
+    "shift_ttm", "identity_ttm", "diag_ttm", "ttm_add", "ttm_scale",
+    "ttm_matvec", "ttm_matmat",
+    "laplacian_ttm", "variable_diffusion_ttm", "tt_round_static",
+    "ttm_round_static", "make_qtt_diffusion_stepper",
 ]
 
 
@@ -62,46 +64,84 @@ def interleaved_digits(N: int, base: int = 4) -> List[int]:
     return [base] * (2 * len(dy))
 
 
+def _ns(*arrays):
+    """Namespace dispatch: the ENTIRE eager build/compress layer runs
+    in numpy f64 (an operator built through f32 jnp math — what
+    jax_enable_x64=False forces — was measured 96% wrong: the shift
+    algebra's +1/-1 cancellations do not survive f32 build rounding);
+    the runtime path (jit tracers / device arrays) uses jnp."""
+    return np if all(isinstance(a, np.ndarray) for a in arrays) else jnp
+
+
 def _to_digit_tensor(q, base: int):
     """(N, N) -> interleaved digit tensor [y0, x0, y1, x1, ...]."""
     k = len(quantize_shape(q.shape[0], base))
     perm = [i for pair in zip(range(k), range(k, 2 * k)) for i in pair]
-    return jnp.transpose(jnp.asarray(q).reshape((base,) * (2 * k)), perm)
+    xp = _ns(q)
+    return xp.transpose(q.reshape((base,) * (2 * k)), perm)
 
 
 def _from_digit_tensor(t, base: int):
     k = t.ndim // 2
     inv = [2 * i for i in range(k)] + [2 * i + 1 for i in range(k)]
     N = base ** k
-    return jnp.transpose(t, inv).reshape(N, N)
+    return _ns(t).transpose(t, inv).reshape(N, N)
 
 
 def _pad_bond(c, r0: int, r1: int):
     """Zero-pad a core's bond dims up to (r0, n, r1)."""
-    return jnp.pad(c, ((0, r0 - c.shape[0]), (0, 0),
-                       (0, r1 - c.shape[2])))
+    return _ns(c).pad(c, ((0, r0 - c.shape[0]), (0, 0),
+                          (0, r1 - c.shape[2])))
 
 
-def qtt_compress(q, rank: int, base: int = 4) -> List[jnp.ndarray]:
+def _decompose_np(t, max_rank: int) -> List[np.ndarray]:
+    """Numpy-f64 TT-SVD (build-time twin of ``tensor_train.
+    tt_decompose``, which runs through jnp and therefore f32 when
+    jax_enable_x64 is off — not enough for operator construction)."""
+    dims = t.shape
+    d = len(dims)
+    cores = []
+    r_prev = 1
+    mat = t.reshape(r_prev * dims[0], -1)
+    for k in range(d - 1):
+        u, s, vt = np.linalg.svd(mat, full_matrices=False)
+        floor = (s[0] if s.size else 0.0) * 32 * np.finfo(t.dtype).eps
+        r = max(1, min(max_rank, int((s > floor).sum())))
+        cores.append(u[:, :r].reshape(r_prev, dims[k], r))
+        mat = s[:r, None] * vt[:r, :]
+        r_prev = r
+        if k < d - 2:
+            mat = mat.reshape(r_prev * dims[k + 1], -1)
+    cores.append(mat.reshape(r_prev, dims[-1], 1))
+    return cores
+
+
+def qtt_compress(q, rank: int, base: int = 4) -> List[np.ndarray]:
     """(N, N) -> static-rank core list (every bond exactly ``rank``,
     zero-padded past the field's numerical rank) in the interleaved
-    digit layout.  Eager (TT-SVD); the stepper itself is jit-able."""
+    digit layout.  Eager numpy f64; cast the cores to the runtime dtype
+    before feeding the jit-able stepper."""
     t = _to_digit_tensor(np.asarray(q, np.float64), base)
-    tt = tt_decompose(t, max_rank=rank)
-    d = len(tt.cores)
+    cores = _decompose_np(t, rank)
+    d = len(cores)
     return [_pad_bond(c,
                       1 if j == 0 else rank,
                       1 if j == d - 1 else rank)
-            for j, c in enumerate(tt.cores)]
+            for j, c in enumerate(cores)]
 
 
-def qtt_decompress(cores: Sequence[jnp.ndarray], base: int = 4):
-    """Core list -> dense (N, N)."""
+def qtt_decompress(cores: Sequence, base: int = 4):
+    """Core list -> dense (N, N) (numpy path stays f64)."""
+    if isinstance(cores[0], np.ndarray):
+        out = cores[0]
+        for c in cores[1:]:
+            out = np.einsum("...a,abc->...bc", out, c)
+        return _from_digit_tensor(out[0, ..., 0], base)
     return _from_digit_tensor(tt_reconstruct(TTTensor(list(cores))), base)
 
 
 def qtt_compress_separable(rows, cols, rank: int,
-                           base: int = 4) -> List[jnp.ndarray]:
+                           base: int = 4) -> List[np.ndarray]:
     """Static-rank QTT cores of ``sum_k outer(rows[k], cols[k])``
     WITHOUT ever forming the (N, N) field — O(K N) work, so state prep
     stays feasible at N far beyond dense-array reach (N = 65536 is a
@@ -120,21 +160,19 @@ def qtt_compress_separable(rows, cols, rank: int,
     k = len(quantize_shape(N, base))
     terms = []
     for t in range(K):
-        vy = tt_decompose(rows[t].reshape((base,) * k)).cores
-        vx = tt_decompose(cols[t].reshape((base,) * k)).cores
+        vy = _decompose_np(rows[t].reshape((base,) * k), N)
+        vx = _decompose_np(cols[t].reshape((base,) * k), N)
         cores = []
         for j in range(k):
             ry0, _, ry1 = vy[j].shape
             rx0, _, rx1 = vx[j].shape
             # y_j: act on the y digit, thread the x bond (dim rx0).
-            eye_x = jnp.eye(rx0)
-            cores.append(jnp.einsum("anb,cd->acnbd", vy[j], eye_x)
+            cores.append(np.einsum("anb,cd->acnbd", vy[j], np.eye(rx0))
                          .reshape(ry0 * rx0, base, ry1 * rx0))
             # x_j: act on the x digit, thread the (new) y bond — bond
             # index order is y-major on both sides, matching the y_j
             # cores' (ry, rx) flattening.
-            eye_y = jnp.eye(ry1)
-            cores.append(jnp.einsum("ef,anb->eanfb", eye_y, vx[j])
+            cores.append(np.einsum("ef,anb->eanfb", np.eye(ry1), vx[j])
                          .reshape(ry1 * rx0, base, ry1 * rx1))
         terms.append(cores)
     # Block-diagonal sum of the K terms, then one fixed-rank rounding.
@@ -177,7 +215,7 @@ def _pass_core(b: int) -> np.ndarray:
 
 
 def shift_ttm(N: int, axis: int, sign: int,
-              base: int = 4) -> List[jnp.ndarray]:
+              base: int = 4) -> List[np.ndarray]:
     """TT-matrix of the periodic shift ``q[..., i, ...] -> q[..., i+s,
     ...]`` along ``axis`` (0 = y, 1 = x) of the (N, N) field, on the
     interleaved digit chain.  Exact, bond 2.
@@ -189,70 +227,84 @@ def shift_ttm(N: int, axis: int, sign: int,
     dims = interleaved_digits(N, base)
     cy = _carry_core(base, sign)
     pas = _pass_core(base)
-    cores = []
-    for j, b in enumerate(dims):
-        is_axis = (j % 2) == axis
-        cores.append(jnp.asarray(cy if is_axis else pas))
+    cores = [np.array(cy if (j % 2) == axis else pas)
+             for j in range(len(dims))]
     # Boundary closure: the chain's right end injects carry = 1 (the
     # "+1"); the left end sums both carry states (mod-N wrap).  The
     # digits run most-significant-first, the axis' LAST digit core is
     # its least significant — but non-axis cores pass the bond through,
     # so closing at the chain ends is equivalent.
-    left = jnp.asarray(np.ones((1, 2)))       # sum over final carry
-    right = jnp.asarray(np.array([[0.0], [1.0]]))  # inject carry=1
-    cores[0] = jnp.einsum("ab,bxyc->axyc", left, cores[0])
-    cores[-1] = jnp.einsum("axyb,bc->axyc", cores[-1], right)
+    left = np.ones((1, 2))                    # sum over final carry
+    right = np.array([[0.0], [1.0]])          # inject carry=1
+    cores[0] = np.einsum("ab,bxyc->axyc", left, cores[0])
+    cores[-1] = np.einsum("axyb,bc->axyc", cores[-1], right)
     return cores
 
 
-def identity_ttm(N: int, base: int = 4) -> List[jnp.ndarray]:
-    return [jnp.eye(b)[None, :, :, None]
+def identity_ttm(N: int, base: int = 4) -> List[np.ndarray]:
+    return [np.eye(b)[None, :, :, None]
             for b in interleaved_digits(N, base)]
 
 
-def ttm_scale(op: Sequence[jnp.ndarray], s: float) -> List[jnp.ndarray]:
+def ttm_scale(op: Sequence, s: float) -> List:
     out = list(op)
     out[0] = out[0] * s
     return out
 
 
-def ttm_add(*ops: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+def ttm_add(*ops: Sequence) -> List:
     """Block-diagonal TT-matrix sum (bonds add)."""
     d = len(ops[0])
     out = []
     for j in range(d):
         cs = [op[j] for op in ops]
         n_out, n_in = cs[0].shape[1], cs[0].shape[2]
+        xp = _ns(*cs)
         if j == 0:
-            out.append(jnp.concatenate(cs, axis=3))
+            out.append(xp.concatenate(cs, axis=3))
         elif j == d - 1:
-            out.append(jnp.concatenate(cs, axis=0))
+            out.append(xp.concatenate(cs, axis=0))
         else:
             r0 = sum(c.shape[0] for c in cs)
             r1 = sum(c.shape[3] for c in cs)
-            blk = jnp.zeros((r0, n_out, n_in, r1), cs[0].dtype)
-            a = b = 0
-            for c in cs:
-                blk = blk.at[a:a + c.shape[0], :, :,
-                             b:b + c.shape[3]].set(c)
-                a += c.shape[0]
-                b += c.shape[3]
+            if xp is np:
+                blk = np.zeros((r0, n_out, n_in, r1), cs[0].dtype)
+                a = b = 0
+                for c in cs:
+                    blk[a:a + c.shape[0], :, :, b:b + c.shape[3]] = c
+                    a += c.shape[0]
+                    b += c.shape[3]
+            else:
+                blk = jnp.zeros((r0, n_out, n_in, r1), cs[0].dtype)
+                a = b = 0
+                for c in cs:
+                    blk = blk.at[a:a + c.shape[0], :, :,
+                                 b:b + c.shape[3]].set(c)
+                    a += c.shape[0]
+                    b += c.shape[3]
             out.append(blk)
     return out
 
 
-def ttm_matvec(op: Sequence[jnp.ndarray],
-               x: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+def ttm_matvec(op: Sequence, x: Sequence) -> List:
     """Apply a TT-matrix to a TT-vector core-by-core (bonds multiply)."""
     out = []
     for co, cx in zip(op, x):
-        c = jnp.einsum("aijb,cjd->acibd", co, cx)
+        xp = _ns(co, cx)
+        if xp is np:
+            c = np.einsum("aijb,cjd->acibd", co, cx)
+        else:
+            # TPU f32 einsum defaults to bf16 accumulation — fatal to
+            # difference operators (O(1) operands cancelling to O(h^2)
+            # results); pin full precision at the op level.
+            c = jnp.einsum("aijb,cjd->acibd", co, cx,
+                           precision=jax.lax.Precision.HIGHEST)
         out.append(c.reshape(co.shape[0] * cx.shape[0], co.shape[1],
                              co.shape[3] * cx.shape[2]))
     return out
 
 
-def laplacian_ttm(N: int, base: int = 4) -> List[jnp.ndarray]:
+def laplacian_ttm(N: int, base: int = 4) -> List[np.ndarray]:
     """The 5-point periodic Laplacian (unit spacing) as an exact
     TT-matrix (bond 9) on the interleaved digit chain."""
     ops = [shift_ttm(N, a, s, base) for a in (0, 1) for s in (1, -1)]
@@ -260,10 +312,77 @@ def laplacian_ttm(N: int, base: int = 4) -> List[jnp.ndarray]:
     return ttm_add(*ops)
 
 
+def diag_ttm(field_cores: Sequence) -> List:
+    """Lift a QTT *field* to the diagonal TT-matrix ``diag(C)`` —
+    multiplication by a variable coefficient.  Bond = the field's bond:
+    each vector core ``(r, n, r')`` becomes the matrix core whose
+    ``(n_out, n_in)`` slice is diagonal in the digit."""
+    out = []
+    for c in field_cores:
+        xp = _ns(c)
+        eye = xp.eye(c.shape[1], dtype=c.dtype)
+        out.append(xp.einsum("anb,nm->anmb", c, eye))
+    return out
+
+
+def ttm_matmat(A: Sequence, B: Sequence) -> List:
+    """TT-matrix product ``A @ B`` core-by-core (bonds multiply)."""
+    out = []
+    for ca, cb in zip(A, B):
+        c = _ns(ca, cb).einsum("aikb,ckjd->acijbd", ca, cb)
+        out.append(c.reshape(ca.shape[0] * cb.shape[0], ca.shape[1],
+                             cb.shape[2], ca.shape[3] * cb.shape[3]))
+    return out
+
+
+def ttm_round_static(op: Sequence, rank: int) -> List:
+    """Fixed-rank rounding of a TT-matrix: fold each core's
+    ``(n_out, n_in)`` into one physical index and reuse
+    :func:`tt_round_static`."""
+    folded = [c.reshape(c.shape[0], c.shape[1] * c.shape[2], c.shape[3])
+              for c in op]
+    out = tt_round_static(folded, rank)
+    return [o.reshape(o.shape[0], c.shape[1], c.shape[2], o.shape[2])
+            for o, c in zip(out, op)]
+
+
+def variable_diffusion_ttm(C, N: int, coeff_rank: int = 8,
+                           base: int = 4) -> List[np.ndarray]:
+    """Flux-form variable-coefficient diffusion ``div(C grad q)``
+    (periodic, unit spacing) as a TT-matrix.
+
+    Per axis: ``D_-(C_half (.) D_+)`` with ``D_+ = S_+ - I`` (forward
+    difference to the half point), ``C_half`` the face-averaged
+    coefficient ``(C + S_+ C)/2`` lifted by :func:`diag_ttm`, and
+    ``D_- = I - S_-`` closing the flux difference — the standard
+    conservative 2nd-order stencil, exactly, at bond
+    ``~2 * 3 * r_C * 3`` per axis.  ``C``: the (N, N) coefficient field
+    (any array) or a prebuilt QTT core list.
+    """
+    cs = (list(C) if isinstance(C, (list, tuple))
+          else qtt_compress(np.asarray(C, np.float64), coeff_rank, base))
+    I = identity_ttm(N, base)
+    d = len(cs)
+    terms = []
+    for axis in (0, 1):
+        Sp = shift_ttm(N, axis, -1, base)   # (Sp q)[i] = q[i+1]
+        Sm = shift_ttm(N, axis, +1, base)   # (Sm q)[i] = q[i-1]
+        Dp = ttm_add(Sp, ttm_scale(I, -1.0))            # q[i+1] - q[i]
+        Dm = ttm_add(I, ttm_scale(Sm, -1.0))            # f[i] - f[i-1]
+        # Face coefficient at i+1/2: (C + Sp C)/2 as a field — exact
+        # block-diag sum (the operator is built once; its bond is a
+        # build-time cost, so no rounding here).
+        half = lambda f, j: f * (0.5 if j == 0 else 1.0)
+        CSp = ttm_matvec(Sp, cs)
+        Ch = [_block_diag_cores(half(cs[j], j), half(CSp[j], j),
+                                j == 0, j == d - 1) for j in range(d)]
+        terms.append(ttm_matmat(Dm, ttm_matmat(diag_ttm(Ch), Dp)))
+    return ttm_add(*terms)
+
+
 # ------------------------------------------------- static-rank rounding
 
-def tt_round_static(cores: Sequence[jnp.ndarray],
-                    rank: int) -> List[jnp.ndarray]:
+def tt_round_static(cores: Sequence, rank: int) -> List:
     """Two-sweep TT rounding at a FIXED output rank — fully jit-able.
 
     Right-to-left QR sweep orthogonalizes; the left-to-right truncation
@@ -280,26 +399,41 @@ def tt_round_static(cores: Sequence[jnp.ndarray],
     """
     d = len(cores)
     cs = list(cores)
+    xp = _ns(*cs)           # numpy-f64 eager build path / jnp runtime
+    if xp is jnp:
+        # Pin full matmul precision for the whole sweep (QR/SVD
+        # internals included): bf16 accumulation wrecks the
+        # orthogonality the truncation relies on (measured 4 orders
+        # of magnitude on TPU f32).
+        ctx = jax.default_matmul_precision("highest")
+    else:
+        import contextlib
+        ctx = contextlib.nullcontext()
+    with ctx:
+        return _round_sweeps(cs, d, rank, xp)
+
+
+def _round_sweeps(cs, d, rank, xp):
     # Right-to-left orthogonalization (row-orthonormal right cores).
     for j in range(d - 1, 0, -1):
         r0, n, r1 = cs[j].shape
-        q, r = jnp.linalg.qr(cs[j].reshape(r0, n * r1).T)
+        q, r = xp.linalg.qr(cs[j].reshape(r0, n * r1).T)
         k = q.shape[1]                       # min(r0, n*r1), static
         cs[j] = q.T.reshape(k, n, r1)
-        cs[j - 1] = jnp.einsum("anb,cb->anc", cs[j - 1], r)
+        cs[j - 1] = xp.einsum("anb,cb->anc", cs[j - 1], r)
     # Left-to-right truncation sweep (QR + small-core SVD).
     for j in range(d - 1):
         r0, n, r1 = cs[j].shape
-        q2, r2 = jnp.linalg.qr(cs[j].reshape(r0 * n, r1))
-        u, s, vt = jnp.linalg.svd(r2)        # (min(m,r1), r1): small
+        q2, r2 = xp.linalg.qr(cs[j].reshape(r0 * n, r1))
+        u, s, vt = xp.linalg.svd(r2)         # (min(m,r1), r1): small
         k = min(rank, s.shape[0])
         Q = q2 @ u[:, :k]
         R = s[:k, None] * vt[:k, :]
         if k < rank:
-            Q = jnp.pad(Q, ((0, 0), (0, rank - k)))
-            R = jnp.pad(R, ((0, rank - k), (0, 0)))
+            Q = xp.pad(Q, ((0, 0), (0, rank - k)))
+            R = xp.pad(R, ((0, rank - k), (0, 0)))
         cs[j] = Q.reshape(r0, n, rank)
-        cs[j + 1] = jnp.einsum("ab,bnc->anc", R, cs[j + 1])
+        cs[j + 1] = xp.einsum("ab,bnc->anc", R, cs[j + 1])
     return cs
 
 
@@ -320,28 +454,34 @@ def make_qtt_diffusion_stepper(N: int, kappa: float, dx: float,
     L = [jnp.asarray(c, dtype)
          for c in ttm_scale(laplacian_ttm(N, base), kappa / (dx * dx))]
 
-    def axpy(a, x, y):
-        """a*x + y at static rank (block-diag add, then round)."""
-        d = len(x)
-        out = [_block_diag_cores(x[j] * (a if j == 0 else 1.0), y[j],
-                                 j == 0, j == d - 1)
-               for j in range(d)]
-        return tt_round_static(out, rank)
-
-    def rhs_step(y, scale):
-        return axpy(scale * dt, ttm_matvec(L, y), y)
+    def combine(parts):
+        """``sum_i coef_i * cores_i`` at static rank: ONE chained
+        block-diag sum, ONE two-sweep rounding — the rounding sweeps
+        dominate the step, so each RK stage must round exactly once
+        (folding the stage's 3 terms here instead of nesting two
+        rounded axpys cut the step ~40%)."""
+        d = len(parts[0][1])
+        acc = [c * (parts[0][0] if j == 0 else 1.0)
+               for j, c in enumerate(parts[0][1])]
+        for coef, cores in parts[1:]:
+            sc = [c * (coef if j == 0 else 1.0)
+                  for j, c in enumerate(cores)]
+            acc = [_block_diag_cores(acc[j], sc[j], j == 0, j == d - 1)
+                   for j in range(d)]
+        return tt_round_static(acc, rank)
 
     def step(y):
+        Ly = ttm_matvec(L, y)
         if scheme == "euler":
-            return rhs_step(y, 1.0)
+            return combine([(dt, Ly), (1.0, y)])
         if scheme != "ssprk3":
             raise ValueError(f"unknown scheme {scheme!r}")
-        scale0 = lambda ys, a: [c * (a if j == 0 else 1.0)
-                                for j, c in enumerate(ys)]
-        y1 = rhs_step(y, 1.0)
-        # y2 = 3/4 y + 1/4 (y1 + dt L y1)
-        y2 = axpy(0.25, rhs_step(y1, 1.0), scale0(y, 0.75))
-        # y' = 1/3 y + 2/3 (y2 + dt L y2)
-        return axpy(2.0 / 3.0, rhs_step(y2, 1.0), scale0(y, 1.0 / 3.0))
+        y1 = combine([(dt, Ly), (1.0, y)])
+        # y2 = 3/4 y + 1/4 y1 + 1/4 dt L y1
+        y2 = combine([(0.25 * dt, ttm_matvec(L, y1)), (0.25, y1),
+                      (0.75, y)])
+        # y' = 1/3 y + 2/3 y2 + 2/3 dt L y2
+        return combine([((2.0 / 3.0) * dt, ttm_matvec(L, y2)),
+                        (2.0 / 3.0, y2), (1.0 / 3.0, y)])
 
     return step

@@ -175,10 +175,21 @@ def _join_meta(x: TTTensor, y: TTTensor) -> Optional[Tuple]:
     return x.qtt_meta if x.qtt_meta is not None else y.qtt_meta
 
 
-def _block_diag_cores(a: jnp.ndarray, b: jnp.ndarray, first: bool,
-                      last: bool) -> jnp.ndarray:
+def _block_diag_cores(a, b, first: bool, last: bool):
+    """Block-diagonal stack of two TT cores.  Dispatches on array kind:
+    numpy inputs stay numpy (the eager f64 build path — see
+    qtt.py), jax inputs use jnp (trace-safe)."""
     ra0, n, ra1 = a.shape
     rb0, _, rb1 = b.shape
+    if isinstance(a, np.ndarray) and isinstance(b, np.ndarray):
+        if first:
+            return np.concatenate([a, b], axis=2)
+        if last:
+            return np.concatenate([a, b], axis=0)
+        out = np.zeros((ra0 + rb0, n, ra1 + rb1), dtype=a.dtype)
+        out[:ra0, :, :ra1] = a
+        out[ra0:, :, ra1:] = b
+        return out
     if first:
         return jnp.concatenate([a, b], axis=2)
     if last:
