@@ -48,8 +48,9 @@ __all__ = [
     "qtt_decompress",
     "shift_ttm", "identity_ttm", "diag_ttm", "ttm_add", "ttm_scale",
     "ttm_matvec", "ttm_matmat",
-    "laplacian_ttm", "variable_diffusion_ttm", "tt_round_static",
-    "ttm_round_static", "make_qtt_diffusion_stepper",
+    "laplacian_ttm", "variable_diffusion_ttm", "advection_ttm",
+    "tt_round_static", "ttm_round_static",
+    "make_qtt_diffusion_stepper", "make_qtt_operator_stepper",
 ]
 
 
@@ -329,7 +330,14 @@ def ttm_matmat(A: Sequence, B: Sequence) -> List:
     """TT-matrix product ``A @ B`` core-by-core (bonds multiply)."""
     out = []
     for ca, cb in zip(A, B):
-        c = _ns(ca, cb).einsum("aikb,ckjd->acijbd", ca, cb)
+        xp = _ns(ca, cb)
+        if xp is np:
+            c = np.einsum("aikb,ckjd->acijbd", ca, cb)
+        else:
+            # Same bf16-accumulation hazard as ttm_matvec: operator
+            # compositions cancel O(1) entries down to O(h^2).
+            c = jnp.einsum("aikb,ckjd->acijbd", ca, cb,
+                           precision=jax.lax.Precision.HIGHEST)
         out.append(c.reshape(ca.shape[0] * cb.shape[0], ca.shape[1],
                              cb.shape[2], ca.shape[3] * cb.shape[3]))
     return out
@@ -359,8 +367,13 @@ def variable_diffusion_ttm(C, N: int, coeff_rank: int = 8,
     ``~2 * 3 * r_C * 3`` per axis.  ``C``: the (N, N) coefficient field
     (any array) or a prebuilt QTT core list.
     """
-    cs = (list(C) if isinstance(C, (list, tuple))
-          else qtt_compress(np.asarray(C, np.float64), coeff_rank, base))
+    if isinstance(C, (list, tuple)):
+        # Operator construction MUST run in f64 numpy (see _ns): a
+        # prebuilt jnp/f32 core list would silently rebuild the
+        # measured-96%-wrong operator.
+        cs = [np.asarray(c, np.float64) for c in C]
+    else:
+        cs = qtt_compress(np.asarray(C, np.float64), coeff_rank, base)
     I = identity_ttm(N, base)
     d = len(cs)
     terms = []
@@ -405,12 +418,50 @@ def tt_round_static(cores: Sequence, rank: int) -> List:
         # internals included): bf16 accumulation wrecks the
         # orthogonality the truncation relies on (measured 4 orders
         # of magnitude on TPU f32).
-        ctx = jax.default_matmul_precision("highest")
-    else:
-        import contextlib
-        ctx = contextlib.nullcontext()
-    with ctx:
-        return _round_sweeps(cs, d, rank, xp)
+        with jax.default_matmul_precision("highest"):
+            if cs[0].dtype == jnp.float32:
+                # TPU f32 jnp.linalg.qr LOSES ORTHOGONALITY
+                # catastrophically (measured |Q'Q - I| up to 1.5e5) on
+                # the heavily rank-deficient structured matrices this
+                # sweep produces; eigh stays orthonormal to 1e-6 on the
+                # same operands.  The f32 path therefore rounds via
+                # masked Gram eigh on BOTH sweeps (sqrt-eps precision
+                # loss ~3e-4 — below the f32 matvec error).
+                return _round_sweeps_gram(cs, d, rank)
+            return _round_sweeps(cs, d, rank, xp)
+    return _round_sweeps(cs, d, rank, xp)
+
+
+def _round_sweeps_gram(cs, d, rank):
+    """f32 two-sweep rounding via masked Gram eigh (no QR/SVD)."""
+    fi = jnp.finfo(cs[0].dtype)
+    for j in range(d - 1, 0, -1):
+        r0, n, r1 = cs[j].shape
+        M = cs[j].reshape(r0, n * r1)
+        lam, E = jnp.linalg.eigh(M @ M.T)          # ascending
+        keep = lam > fi.eps * lam[-1] + fi.tiny
+        s = jnp.sqrt(jnp.where(keep, lam, 1.0))
+        inv_s = jnp.where(keep, 1.0 / s, 0.0)
+        cs[j] = (inv_s[:, None] * (E.T @ M)).reshape(r0, n, r1)
+        R = E * jnp.where(keep, s, 0.0)[None, :]   # M = R @ rows(cs[j])
+        cs[j - 1] = jnp.einsum("anb,bc->anc", cs[j - 1], R)
+    for j in range(d - 1):
+        r0, n, r1 = cs[j].shape
+        M = cs[j].reshape(r0 * n, r1)
+        lam, E = jnp.linalg.eigh(M.T @ M)
+        lam, E = lam[::-1], E[:, ::-1]
+        k = min(rank, r1)
+        keep = lam[:k] > fi.eps * lam[0] + fi.tiny
+        s = jnp.sqrt(jnp.where(keep, lam[:k], 1.0))
+        inv_s = jnp.where(keep, 1.0 / s, 0.0)
+        Q = M @ (E[:, :k] * inv_s[None, :])
+        R = jnp.where(keep, s, 0.0)[:, None] * E[:, :k].T
+        if k < rank:
+            Q = jnp.pad(Q, ((0, 0), (0, rank - k)))
+            R = jnp.pad(R, ((0, rank - k), (0, 0)))
+        cs[j] = Q.reshape(r0, n, rank)
+        cs[j + 1] = jnp.einsum("ab,bnc->anc", R, cs[j + 1])
+    return _balance(cs, jnp)
 
 
 def _round_sweeps(cs, d, rank, xp):
@@ -434,25 +485,64 @@ def _round_sweeps(cs, d, rank, xp):
             R = xp.pad(R, ((0, rank - k), (0, 0)))
         cs[j] = Q.reshape(r0, n, rank)
         cs[j + 1] = xp.einsum("ab,bnc->anc", R, cs[j + 1])
-    return cs
+    return _balance(cs, xp)
+
+
+def _balance(cs, xp):
+    """Equalize core Frobenius norms (product of scales = 1, value
+    unchanged).  Load-bearing for f32: the truncation sweep concentrates
+    the WHOLE tensor norm in the last core (e.g. 1.5e5 with a 1/dx-
+    scaled operator), and f32 QR absorptions through that scale destroy
+    O(1) values that emerge by cancellation — the chain form of the
+    'balance the factors' lesson in solver._round_factored."""
+    norms = [xp.linalg.norm(c.reshape(-1)) for c in cs]
+    if xp is np:
+        logs = [np.log(max(float(v), np.finfo(np.float64).tiny))
+                for v in norms]
+        g = np.exp(np.mean(logs))
+        return [c * (g / v if float(v) > 0 else 1.0)
+                for c, v in zip(cs, norms)]
+    safe = [jnp.maximum(v, jnp.finfo(cs[0].dtype).tiny) for v in norms]
+    g = jnp.exp(sum(jnp.log(v) for v in safe) / len(cs))
+    # Guard on the RAW norm: a zero core must scale by 1 (g/tiny would
+    # overflow to inf and 0*inf -> NaN).
+    return [c * jnp.where(v > 0, g / s, 1.0)
+            for c, v, s in zip(cs, norms, safe)]
+
+
+def advection_ttm(vx, vy, N: int, coeff_rank: int = 8,
+                  base: int = 4) -> List[np.ndarray]:
+    """Centered variable-wind advection ``-(vx D_x + vy D_y) q``
+    (periodic, unit spacing; scale by 1/dx outside) as a TT-matrix —
+    the deck's cosine-bell transport (p.13/18) in operator form.
+
+    ``vx``/``vy``: (N, N) wind component fields (y is axis 0).  The
+    centered difference is ``(S_+ - S_-)/2`` per axis, each lifted wind
+    a :func:`diag_ttm` factor.
+    """
+    ops = []
+    for axis, v in ((0, vy), (1, vx)):
+        Sp = shift_ttm(N, axis, -1, base)   # (Sp q)[i] = q[i+1]
+        Sm = shift_ttm(N, axis, +1, base)
+        Dc = ttm_add(ttm_scale(Sp, 0.5), ttm_scale(Sm, -0.5))
+        Dv = diag_ttm(qtt_compress(np.asarray(v, np.float64),
+                                   coeff_rank, base))
+        ops.append(ttm_matmat(Dv, Dc))
+    return ttm_scale(ttm_add(*ops), -1.0)
 
 
 # ------------------------------------------------------------- stepper
 
-def make_qtt_diffusion_stepper(N: int, kappa: float, dx: float,
-                               dt: float, rank: int, base: int = 4,
-                               scheme: str = "ssprk3") -> Callable:
-    """Jit-able QTT step for 2-D periodic diffusion ``q_t = kappa lap q``.
-
-    The state is the static-rank core list of :func:`qtt_compress`; the
-    step is matvec (bond-9 operator), axpy, and two-sweep rounding —
-    every shape static, cost independent of N (O(d) small SVDs).
-    """
-    # Default real dtype (f64 under jax_enable_x64, else f32) — the
-    # operator entries are exact small integers times kappa/dx^2.
+def make_qtt_operator_stepper(L, dt: float, rank: int,
+                              scheme: str = "ssprk3") -> Callable:
+    """Jit-able SSPRK3/Euler step of ``q_t = L q`` for ANY linear
+    TT-matrix ``L``.  The state is a static-rank core list; each RK
+    stage is one matvec, one chained block-diag combine, and one
+    two-sweep rounding — every shape static, cost independent of N
+    (O(d) small QR/SVDs)."""
+    # Default real dtype (f64 under jax_enable_x64, else f32).
     dtype = jnp.zeros(()).dtype
-    L = [jnp.asarray(c, dtype)
-         for c in ttm_scale(laplacian_ttm(N, base), kappa / (dx * dx))]
+    L = [jnp.asarray(c, dtype) for c in L]
 
     def combine(parts):
         """``sum_i coef_i * cores_i`` at static rank: ONE chained
@@ -485,3 +575,13 @@ def make_qtt_diffusion_stepper(N: int, kappa: float, dx: float,
                         (2.0 / 3.0, y2), (1.0 / 3.0, y)])
 
     return step
+
+
+def make_qtt_diffusion_stepper(N: int, kappa: float, dx: float,
+                               dt: float, rank: int, base: int = 4,
+                               scheme: str = "ssprk3") -> Callable:
+    """Jit-able QTT step for 2-D periodic diffusion ``q_t = kappa lap
+    q`` — :func:`make_qtt_operator_stepper` over the bond-9 Laplacian."""
+    return make_qtt_operator_stepper(
+        ttm_scale(laplacian_ttm(N, base), kappa / (dx * dx)), dt, rank,
+        scheme=scheme)
