@@ -50,6 +50,9 @@ def aca_lowrank(P, Q, k: int):
     R2, m = Q.shape
     assert R == R2, (P.shape, Q.shape)
     dt = P.dtype
+    # Factor-accumulation strategy, resolved at trace time (same
+    # backend-gating convention as sphere_swe's batch_rounding).
+    onehot = jax.default_backend() != "cpu"
 
     def body(t, carry):
         U, V, j, used_r, used_c = carry
@@ -70,8 +73,22 @@ def aca_lowrank(P, Q, k: int):
         sgn = jnp.where(piv < 0, -1.0, 1.0)
         u_t = c * inv
         v_t = r * (inv * sgn)
-        U = jax.lax.dynamic_update_slice_in_dim(U, u_t[:, None], t, axis=1)
-        V = jax.lax.dynamic_update_slice_in_dim(V, v_t[None, :], t, axis=0)
+        if onehot:
+            # One-hot outer-product accumulation: bitwise-identical to
+            # the DUS (each column/row is written exactly once onto
+            # zeros), measured 1.8x faster per vmapped call on TPU —
+            # the 17.5 us/iteration DUS was the largest op family in
+            # the batched factored-SWE step's device trace.  On CPU the
+            # k-fold extra factor traffic measures 9-16% SLOWER, hence
+            # the backend gate.
+            oh = (jnp.arange(k, dtype=jnp.int32) == t).astype(dt)
+            U = U + u_t[:, None] * oh[None, :]
+            V = V + oh[:, None] * v_t[None, :]
+        else:
+            U = jax.lax.dynamic_update_slice_in_dim(U, u_t[:, None], t,
+                                                    axis=1)
+            V = jax.lax.dynamic_update_slice_in_dim(V, v_t[None, :], t,
+                                                    axis=0)
         used_r = used_r.at[i].set(True)
         used_c = used_c.at[j].set(True)
         j_next = jnp.argmax(jnp.where(used_c, 0.0, jnp.abs(r)))
