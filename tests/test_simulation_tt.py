@@ -30,9 +30,17 @@ def test_tt_swe_run_with_history_and_checkpoint(tmp_path):
     assert abs(d1["mass"] - d0["mass"]) / abs(d0["mass"]) < 1e-3
     assert abs(d1["energy"] - d0["energy"]) / abs(d0["energy"]) < 1e-3
 
-    # History holds the factors, not (6, n, n) fields.
+    # History holds the factors, not (6, n, n) fields — and the reader
+    # reconstructs dense snapshots from them transparently (the
+    # analysis/viz entry point for factored runs).
     arr = sim.history.read("h__ttA")
     assert arr.shape[1:] == (6, 16, 8), arr.shape
+    dense = sim.history.read("h")
+    assert dense.shape[1:] == (6, 16, 16), dense.shape
+    from jaxstream.tt.sphere import unfactor_panels
+    last = np.asarray(unfactor_panels((sim.state["h__ttA"],
+                                       sim.state["h__ttB"])))
+    assert np.allclose(dense[-1], last, atol=1e-10)
 
     # Resume: same config picks up the factored checkpoint.
     sim2 = Simulation(_cfg(tmp_path, initial_condition="tc2"))
