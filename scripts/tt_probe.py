@@ -1,0 +1,146 @@
+"""Re-runnable TT/QTT perf probes — the DESIGN.md tables, one command.
+
+Reproduces the measured tables in docs/DESIGN.md ("Tensor-Train
+numerics" round-2 sections) with the same methodology: quiet host
+(nothing else running — a concurrent test suite inflated a dense
+baseline 2x once, see the benchmark-discipline note), median of reps,
+compile excluded.
+
+Usage::
+
+    python scripts/tt_probe.py sphere [n ...]     # factored SWE vs dense twin
+    python scripts/tt_probe.py qtt   [N ...]      # QTT diffusion vs dense
+    python scripts/tt_probe.py tpu   [n ...]      # factored SWE on the
+                                                  # default (device) backend
+
+``sphere``/``qtt`` force CPU f64 (the recorded tables); ``tpu`` keeps
+the default backend and f32 (the v5e numbers).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+_MODE = sys.argv[1] if len(sys.argv) > 1 else "sphere"
+if _MODE in ("sphere", "qtt"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _median_rate(fn, arg, iters, reps=5):
+    """Median-of-reps rate over pipelined dispatch windows.
+
+    Methodology note: this is deliberately the loop the DESIGN.md TT
+    tables were measured with — a Python loop of ASYNC dispatches with
+    ONE block at the window end (the chained step outputs feed the next
+    step, so device work pipelines and the per-dispatch tunnel latency
+    is paid once per window, not per step).  It differs from bench.py's
+    jit'd-fori methodology, which is required for the production
+    stepper's much shorter (~100 us) steps; the TT steps measured here
+    are 5-2000 ms, so a window of a few steps is already multi-second.
+    """
+    out = fn(arg)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        a = arg
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            a = fn(a)
+        jax.block_until_ready(a)
+        ts.append((time.perf_counter() - t0) / iters)
+    return sorted(ts)[len(ts) // 2]
+
+
+def sphere(sizes, dtype, rank=12):
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.physics import initial_conditions as ics
+    from jaxstream.tt.sphere import factor_panels
+    from jaxstream.tt.sphere_swe import (
+        covariant_from_cartesian,
+        make_dense_sphere_swe,
+        make_tt_sphere_swe,
+    )
+
+    for n in sizes:
+        grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=dtype)
+        h_ext, v_ext = ics.williamson_tc2(grid, EARTH_GRAVITY,
+                                          EARTH_OMEGA)
+        h0 = np.asarray(grid.interior(h_ext), np.float64)
+        ua0, ub0 = covariant_from_cartesian(grid, v_ext)
+        dt = 30.0 * 256 / n
+        dense = jax.jit(make_dense_sphere_swe(grid, dt))
+        tt = jax.jit(make_tt_sphere_swe(grid, dt, rank=rank))
+        s = tuple(jnp.asarray(np.asarray(x, dtype))
+                  for x in (h0, ua0, ub0))
+        p = tuple(factor_panels(x, rank) for x in (h0, ua0, ub0))
+        iters = max(4, 4096 // n)
+        td = _median_rate(dense, s, iters)
+        tq = _median_rate(tt, p, iters)
+        print(f"C{n} rank{rank}: dense {td * 1e3:8.2f} ms/step   "
+              f"tt {tq * 1e3:8.2f} ms/step   speedup {td / tq:.2f}x",
+              flush=True)
+
+
+def qtt(sizes, rank=12):
+    from jaxstream.tt.qtt import (
+        make_qtt_diffusion_stepper,
+        qtt_compress,
+        qtt_compress_separable,
+    )
+
+    for N in sizes:
+        dx = 1.0 / N
+        dt = 0.1 * dx * dx
+        step = jax.jit(make_qtt_diffusion_stepper(N, 1.0, dx, dt, rank))
+        x = np.arange(N) / N
+        rows = np.stack([np.sin(2 * np.pi * x), np.cos(2 * np.pi * x)])
+        cols = np.stack([np.cos(4 * np.pi * x), np.ones(N)])
+        if N <= 4096:
+            q0 = sum(np.outer(rows[k], cols[k]) for k in range(2))
+            y = [jnp.asarray(c) for c in qtt_compress(q0, rank)]
+        else:
+            y = [jnp.asarray(c)
+                 for c in qtt_compress_separable(rows, cols, rank)]
+        tq = _median_rate(step, y, 10)
+        msg = f"N={N:6d}: qtt {tq * 1e3:8.2f} ms/step"
+        if N <= 4096:
+            qd = jnp.asarray(q0)
+
+            def dstep(q, _dx=dx, _dt=dt):
+                def lap(v):
+                    return (jnp.roll(v, 1, 0) + jnp.roll(v, -1, 0)
+                            + jnp.roll(v, 1, 1) + jnp.roll(v, -1, 1)
+                            - 4 * v) / (_dx * _dx)
+                k1 = q + _dt * lap(q)
+                y2 = 0.75 * q + 0.25 * (k1 + _dt * lap(k1))
+                return q / 3 + (2.0 / 3.0) * (y2 + _dt * lap(y2))
+
+            td = _median_rate(jax.jit(dstep), qd, 10)
+            msg += (f"   dense {td * 1e3:8.2f} ms/step   "
+                    f"speedup {td / tq:.2f}x")
+        print(msg, flush=True)
+
+
+def main():
+    args = [int(a) for a in sys.argv[2:] if a.isdigit()]
+    if _MODE == "sphere":
+        sphere(args or [384, 768, 1536], jnp.float64)
+    elif _MODE == "qtt":
+        qtt(args or [256, 1024, 4096, 16384, 65536])
+    elif _MODE == "tpu":
+        sphere(args or [256, 512], jnp.float32)
+    else:
+        sys.exit(f"unknown mode {_MODE!r}; use sphere | qtt | tpu")
+
+
+if __name__ == "__main__":
+    main()
