@@ -131,7 +131,11 @@ def qtt(sizes, rank=12):
 
 
 def main():
-    args = [int(a) for a in sys.argv[2:] if a.isdigit()]
+    bad = [a for a in sys.argv[2:] if not a.isdigit()]
+    if bad:
+        sys.exit(f"unparseable size argument(s) {bad}; sizes must be "
+                 "plain integers")
+    args = [int(a) for a in sys.argv[2:]]
     if _MODE == "sphere":
         sphere(args or [384, 768, 1536], jnp.float64)
     elif _MODE == "qtt":
