@@ -47,8 +47,10 @@ def _cmd_info(args):
             print(f"mesh: panel={setup.panel} y={setup.sy} x={setup.sx}")
         except ValueError as e:
             print(f"mesh: unavailable here ({e})")
+    tt = (f" numerics=tt(rank={cfg.model.tt_rank})"
+          if cfg.model.numerics == "tt" else "")
     print(f"model: {cfg.model.initial_condition} scheme={cfg.model.scheme} "
-          f"backend={cfg.model.backend}; dt={cfg.time.dt}s "
+          f"backend={cfg.model.backend}{tt}; dt={cfg.time.dt}s "
           f"duration={cfg.time.duration_days}d")
 
 

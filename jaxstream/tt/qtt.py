@@ -49,7 +49,7 @@ __all__ = [
     "shift_ttm", "identity_ttm", "diag_ttm", "ttm_add", "ttm_scale",
     "ttm_matvec", "ttm_matmat",
     "laplacian_ttm", "variable_diffusion_ttm", "advection_ttm",
-    "tt_round_static", "ttm_round_static", "qtt_hadamard",
+    "tt_round_static", "ttm_round_static", "ttm_compress_np", "qtt_hadamard",
     "make_qtt_diffusion_stepper", "make_qtt_operator_stepper",
     "make_qtt_burgers_stepper",
 ]
@@ -610,6 +610,69 @@ def qtt_hadamard(a: Sequence, b: Sequence) -> List:
     return out
 
 
+def _ttm_fro2(op: Sequence[np.ndarray]) -> float:
+    """Squared Frobenius norm of a TT-matrix by chain contraction."""
+    env = np.ones((1, 1))
+    for c in op:
+        env = np.einsum("ac,aijb,cijd->bd", env, c, c)
+    return float(env[0, 0])
+
+
+def ttm_compress_np(op: Sequence[np.ndarray],
+                    rtol: float = 1e-13) -> List[np.ndarray]:
+    """Build-time TT-matrix compression to TRUE numerical bond ranks
+    (eager numpy f64 only — shapes shrink dynamically; the jit-able
+    :func:`ttm_round_static` pads every bond back to its cap, so it
+    cannot shrink an operator).  Two-sweep with tolerance truncation,
+    then a Frobenius self-check: if the compressed operator differs
+    relatively by more than ``10 * rtol * sqrt(d)``, the original is
+    returned unchanged."""
+    cs = [np.asarray(c, np.float64) for c in op]
+    shapes = [(c.shape[1], c.shape[2]) for c in cs]
+    folded = [c.reshape(c.shape[0], -1, c.shape[3]) for c in cs]
+    d = len(folded)
+    for j in range(d - 1, 0, -1):
+        r0, n, r1 = folded[j].shape
+        q, r = np.linalg.qr(folded[j].reshape(r0, n * r1).T)
+        folded[j] = q.T.reshape(-1, n, r1)
+        folded[j - 1] = np.einsum("anb,cb->anc", folded[j - 1], r)
+    for j in range(d - 1):
+        r0, n, r1 = folded[j].shape
+        u, sv, vt = np.linalg.svd(folded[j].reshape(r0 * n, r1),
+                                  full_matrices=False)
+        k = max(1, int((sv > rtol * (sv[0] if sv.size else 1.0)).sum()))
+        folded[j] = u[:, :k].reshape(r0, n, k)
+        folded[j + 1] = np.einsum("ab,bnc->anc",
+                                  sv[:k, None] * vt[:k, :],
+                                  folded[j + 1])
+    out = [c.reshape(c.shape[0], no, ni, c.shape[2])
+           for c, (no, ni) in zip(folded, shapes)]
+    # Verified-or-identity: never silently return a lossy operator.
+    diff = []
+    for j, (a, b) in enumerate(zip(op, out)):
+        a = np.asarray(a, np.float64)
+        if j == 0:
+            diff.append(np.concatenate([a, -b], axis=-1))
+        elif j == d - 1:
+            diff.append(np.concatenate([a, b], axis=0))
+        else:
+            blk = np.zeros((a.shape[0] + b.shape[0],) + a.shape[1:3]
+                           + (a.shape[3] + b.shape[3],))
+            blk[:a.shape[0], ..., :a.shape[3]] = a
+            blk[a.shape[0]:, ..., a.shape[3]:] = b
+            diff.append(blk)
+    err2 = max(_ttm_fro2(diff), 0.0)
+    ref2 = _ttm_fro2([np.asarray(c, np.float64) for c in op])
+    # The Frobenius-difference contraction computes ||A - A'||^2 by
+    # cancellation, so its own roundoff floor is ~eps * ||A||^2 — it
+    # can only certify relative error down to ~1e-8.  That is far
+    # tighter than any lossy trim would land (dropped directions carry
+    # >= rtol-level mass), and far looser than the contraction noise.
+    if err2 > 1e-14 * max(ref2, 1e-300):
+        return [np.asarray(c, np.float64) for c in op]
+    return out
+
+
 def make_qtt_burgers_stepper(N: int, nu: float, dx: float, dt: float,
                              rank: int, base: int = 4,
                              scheme: str = "ssprk3") -> Callable:
@@ -625,9 +688,10 @@ def make_qtt_burgers_stepper(N: int, nu: float, dx: float, dt: float,
     Dc = ttm_add(*[op for axis in (0, 1) for op in
                    (ttm_scale(shift_ttm(N, axis, -1, base), 0.5),
                     ttm_scale(shift_ttm(N, axis, +1, base), -0.5))])
-    # Compress the raw bond-8 sum to its exact rank at build time —
-    # every step's Hadamard/rounding cost scales with this bond.
-    Dc = ttm_round_static(Dc, 8)
+    # Compress the raw bond-8 sum to its true numerical bond ranks at
+    # build time (verified-or-identity) — every step's Hadamard and
+    # rounding cost scales with this bond.
+    Dc = ttm_compress_np(Dc)
     Dc = [jnp.asarray(c / dx, dtype) if j == 0 else jnp.asarray(c, dtype)
           for j, c in enumerate(Dc)]
     L = [jnp.asarray(c, dtype)
