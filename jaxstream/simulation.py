@@ -248,6 +248,12 @@ class Simulation:
                 "parallelization.num_devices: 1 and use_shard_map: false "
                 "(the factored state is O(n r) per panel — sharding it "
                 "is not supported)")
+        if g.halo < 1:
+            raise ValueError(
+                "model.numerics='tt' needs grid.halo >= 1 (the factored "
+                "edge statics read the innermost ghost cell at index "
+                f"halo-1; with halo={g.halo} that wraps to the opposite "
+                "panel edge); set grid.halo: 1 or higher")
         if tc.scheme not in ("ssprk3", "euler"):
             raise ValueError(
                 f"model.numerics='tt' supports time.scheme 'ssprk3' or "

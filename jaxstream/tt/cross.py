@@ -115,7 +115,7 @@ def aca_lowrank_many(ops, k: int):
     ``max R_i`` (zero bond columns leave ``P @ Q`` unchanged, so the
     rounding is identical), stacks to one ``(len(ops) * F, ...)`` batch,
     and runs a single vmapped :func:`aca_lowrank`.  Returns the list of
-    rounded ``(U (F, n, k), V (k, n))`` pairs.
+    rounded ``(U (F, n, k), V (F, k, n))`` pairs.
 
     This is the TT analogue of kernel-launch batching: on TPU the
     factored SWE step was measured latency-bound on its ~36 *sequential*

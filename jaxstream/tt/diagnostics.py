@@ -50,11 +50,13 @@ def make_area_pair(grid, tol: float = 1e-12):
     sl = slice(h, h + n)
     area = np.asarray(grid.area, np.float64)[:, sl, sl]
     pair = factor_panels(area, _numerical_rank(area, tol, 32))
-    _AREA_CACHE[key] = pair
     try:
         weakref.finalize(grid, _AREA_CACHE.pop, key, None)
     except TypeError:
-        pass                      # non-weakref-able grid: keep cached
+        # Non-weakref-able grid: no finalizer means a later grid could
+        # reuse this id() and read the wrong cached weights — don't cache.
+        return pair
+    _AREA_CACHE[key] = pair
     return pair
 
 

@@ -86,6 +86,10 @@ def test_tt_tier_validation(tmp_path):
         Simulation({"model": {"numerics": "qtt"},
                     "parallelization": {"num_devices": 1}})
 
+    with pytest.raises(ValueError, match="halo"):
+        Simulation({"grid": {"n": 16, "halo": 0},
+                    "model": {"numerics": "tt"},
+                    "parallelization": {"num_devices": 1}})
     with pytest.raises(ValueError, match="hyperdiffusion"):
         Simulation({"model": {"numerics": "tt"},
                     "physics": {"hyperdiffusion": 1e14},
