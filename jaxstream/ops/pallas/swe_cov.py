@@ -1207,32 +1207,58 @@ def _cov_blockspecs(n, halo):
         ssn_blk, swe_blk
 
 
-def _make_fill(n, halo, i0, i1, corners: bool = False):
-    """Shared in-kernel ghost fill / strip emit over the split layout."""
+def _make_fill(n, halo, i0, i1, corners: bool = False,
+               interior: bool = True, base=(0, 0)):
+    """Shared in-kernel ghost fill / strip emit over the split layout.
+
+    ``interior=False`` skips the interior store (the manual-DMA stage
+    kernels land the interior in the scratch straight from HBM; only
+    the ghost bands need the VPU).  ``base=(by, bx)`` shifts the whole
+    extended window inside a larger scratch — the manual-DMA layout
+    puts the interior at a (8, 128)-tile-aligned offset because Mosaic
+    only accepts tile-aligned DMA destination windows, which parks the
+    extended window's top-left at ``(8 - halo, 128 - halo)``."""
     h = halo
+    by, bx = base
+    m = n + 2 * h
 
     def fill_ghosts(scratch, int_val, gsn, gwe, fi):
-        scratch[i0:i1, i0:i1] = int_val
-        scratch[0:h, i0:i1] = gsn[fi * 2 * h : fi * 2 * h + h]
-        scratch[i1 : i1 + h, i0:i1] = gsn[fi * 2 * h + h : (fi + 1) * 2 * h]
-        scratch[i0:i1, 0:h] = gwe[:, fi * 2 * h : fi * 2 * h + h]
-        scratch[i0:i1, i1 : i1 + h] = gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h]
+        if interior:
+            scratch[by + i0 : by + i1, bx + i0 : bx + i1] = int_val
+        scratch[by : by + h, bx + i0 : bx + i1] = \
+            gsn[fi * 2 * h : fi * 2 * h + h]
+        scratch[by + i1 : by + i1 + h, bx + i0 : bx + i1] = \
+            gsn[fi * 2 * h + h : (fi + 1) * 2 * h]
+        scratch[by + i0 : by + i1, bx : bx + h] = \
+            gwe[:, fi * 2 * h : fi * 2 * h + h]
+        scratch[by + i0 : by + i1, bx + i1 : bx + i1 + h] = \
+            gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h]
         if corners:
             # The Laplacian's cross-derivative faces read the h x h ghost
             # corners (unlike the dimension-split advective stencils).
             # Same edge-ghost averaging as parallel.halo._fill_corners —
             # purely face-local, no extra communication.
             half = jnp.float32(0.5)
-            scratch[0:h, 0:h] = half * (scratch[0:h, i0 : i0 + 1]
-                                        + scratch[i0 : i0 + 1, 0:h])
-            scratch[0:h, i1 : i1 + h] = half * (
-                scratch[0:h, i1 - 1 : i1] + scratch[i0 : i0 + 1, i1 : i1 + h])
-            scratch[i1 : i1 + h, 0:h] = half * (
-                scratch[i1 : i1 + h, i0 : i0 + 1] + scratch[i1 - 1 : i1, 0:h])
-            scratch[i1 : i1 + h, i1 : i1 + h] = half * (
-                scratch[i1 : i1 + h, i1 - 1 : i1]
-                + scratch[i1 - 1 : i1, i1 : i1 + h])
-        return scratch[:]
+            scratch[by : by + h, bx : bx + h] = half * (
+                scratch[by : by + h, bx + i0 : bx + i0 + 1]
+                + scratch[by + i0 : by + i0 + 1, bx : bx + h])
+            scratch[by : by + h, bx + i1 : bx + i1 + h] = half * (
+                scratch[by : by + h, bx + i1 - 1 : bx + i1]
+                + scratch[by + i0 : by + i0 + 1, bx + i1 : bx + i1 + h])
+            scratch[by + i1 : by + i1 + h, bx : bx + h] = half * (
+                scratch[by + i1 : by + i1 + h, bx + i0 : bx + i0 + 1]
+                + scratch[by + i1 - 1 : by + i1, bx : bx + h])
+            scratch[by + i1 : by + i1 + h, bx + i1 : bx + i1 + h] = half * (
+                scratch[by + i1 : by + i1 + h, bx + i1 - 1 : bx + i1]
+                + scratch[by + i1 - 1 : by + i1, bx + i1 : bx + i1 + h])
+        if (by, bx) == (0, 0):
+            return scratch[:]
+        # Manual-DMA path: hand back the REF, not a loaded value — the
+        # caller wraps it in an _OffsetView and every consumer loads just
+        # its own shifted window.  (A full load would materialize the
+        # padding lanes; a ref *window* at the misaligned base is
+        # rejected by Mosaic; per-site shifted loads are fine.)
+        return scratch
 
     def emit_strips(ssn_ref, swe_ref, int_new, fi):
         ssn_ref[0, fi * 2 * h : fi * 2 * h + h] = int_new[0:h, :]
@@ -1241,6 +1267,40 @@ def _make_fill(n, halo, i0, i1, corners: bool = False):
         swe_ref[0, :, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[:, n - h : n]
 
     return fill_ghosts, emit_strips
+
+
+class _OffsetView:
+    """Presents a padded 2-D value as if it were the (m, m) extended
+    frame at a static offset ``(by, bx)`` inside it.
+
+    Only the slice forms :func:`rhs_core_cov` uses are supported:
+    non-negative starts/stops or ``None``, no steps.  Mosaic rejects
+    ref windows whose offsets are not tile-aligned, so the manual-DMA
+    stage kernels never materialize the (m, m) window — every consumer
+    slices through this view and gets a plain shifted value slice.
+    """
+
+    __slots__ = ("v", "by", "bx", "m")
+
+    def __init__(self, v, by, bx, m):
+        self.v, self.by, self.bx, self.m = v, by, bx, m
+
+    def __getitem__(self, idx):
+        r, c = idx
+
+        def sh(s, off, size):
+            if isinstance(s, slice):
+                if s.step not in (None, 1):
+                    raise ValueError("_OffsetView: slice steps are "
+                                     "unsupported")
+                start = (s.start or 0)
+                stop = size if s.stop is None else s.stop
+                if start < 0 or stop < 0:
+                    raise ValueError("_OffsetView: negative slice bounds")
+                return slice(start + off, stop + off)
+            return s + off
+
+        return self.v[sh(r, self.by, self.m), sh(c, self.bx, self.m)]
 
 
 def make_cov_stage_compact(
@@ -1262,6 +1322,7 @@ def make_cov_stage_compact(
     u_scale: float = 1.0,
     seam: bool = True,
     sym_prescaled: bool = False,
+    manual_dma: bool | None = None,
 ):
     """One fused covariant RK stage over interior-only state.
 
@@ -1287,6 +1348,24 @@ def make_cov_stage_compact(
     then makes u quantization ~8x finer than bf16.  ``seam=False``
     ablates the symmetrized-seam imposition (measurement only: breaks
     cross-panel conservation).
+
+    ``manual_dma`` (measurement knob, default OFF — measured a dead
+    end on v5e): the h/u carry arrives as ANY-space refs and each
+    face's interior is DMA'd from HBM *directly into the extended
+    scratch's interior window* (``True``: double-buffered one face
+    ahead; ``"single"``: one static buffer, issue-and-wait).  The goal
+    was deleting the in-kernel VPU interior copy (measured 18 us/step
+    at C384: block fetch writes VMEM once, the placement copy reads +
+    writes it again).  Measured at C384 (bitwise-identical outputs):
+    block 303-310 us/step, manual double-buffered 314.5, manual single
+    370.8.  The interior-window DMA destination is a strided row
+    window of the padded halo frame and runs at ~70 GB/s effective
+    (per-row descriptor overhead), so un-overlapped it stalls ~26
+    us/stage, and even fully overlapped it loses ~10 us/step of
+    HBM/VMEM bandwidth to the extra traffic — Pallas's compact tiled
+    block bursts + VPU placement copy are the better structure on this
+    chip.  Kept (parity-tested) because the DMA/VPU balance shifts per
+    TPU generation.  Requires a plain f32 carry.
     """
     import numpy as np
 
@@ -1315,6 +1394,18 @@ def make_cov_stage_compact(
     with_scale = u_scale != 1.0
     with_hscale = h_scale != 1.0
 
+    plain_f32 = (cdt_h == jnp.float32 and cdt_u == jnp.float32
+                 and not with_off and not with_scale and not with_hscale)
+    if manual_dma is None:
+        manual_dma = False
+    elif manual_dma and not plain_f32:
+        raise ValueError("manual_dma needs a plain f32 carry (the DMA "
+                         "engine cannot widen or rescale)")
+    if manual_dma and n % 128 != 0:
+        raise ValueError(
+            f"manual_dma needs n % 128 == 0 (got n={n}): the ANY-space "
+            "carry's per-face slices must span whole 128-lane tiles")
+
     def f32h(x):
         # jnp scalars must be born inside the kernel trace (a captured
         # module-level constant is rejected by pallas_call).
@@ -1342,7 +1433,13 @@ def make_cov_stage_compact(
             c = jnp.float32(1.5 * 2.0**23)
             return ((x + c) - c).astype(cdt)
         return x.astype(cdt)
-    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1)
+    # Manual-DMA scratch layout: interior window at (8, 128) — the
+    # smallest (sublane, lane)-tile-aligned offset that leaves room for
+    # the ghost bands above/left of it.
+    _OY, _OX = 8, 128
+    fill_ghosts, emit_strips = _make_fill(
+        n, halo, i0, i1, interior=not manual_dma,
+        base=(_OY - halo, _OX - halo) if manual_dma else (0, 0))
 
     def kernel(*refs):
         if with_y0:
@@ -1356,9 +1453,64 @@ def make_cov_stage_compact(
 
         gsn = gsn_ref[0]
         gwe = gwe_ref[0]
-        hf = fill_ghosts(scratch[0], f32h(hc_ref[0]), gsn, gwe, 0)
-        ua = fill_ghosts(scratch[1], f32u(uc_ref[0, 0]), gsn, gwe, 1)
-        ub = fill_ghosts(scratch[2], f32u(uc_ref[1, 0]), gsn, gwe, 2)
+        if manual_dma:
+            # The carry is ANY-space: DMA each face's interior from HBM
+            # straight into the extended scratch's interior window,
+            # double-buffered one face ahead (the hand-rolled version of
+            # the block pipeline's fetch-ahead, minus the VPU placement
+            # copy).  Buffer parity alternates per face; face f-1 is
+            # fully consumed before face f starts (the TPU grid is
+            # sequential), so re-targeting its buffer is race-free.
+            sh2, sa2, sb2 = scratch[0], scratch[1], scratch[2]
+            sems = scratch[-1]
+            f = pl.program_id(0)
+            dsy, dsx = pl.ds(_OY, n), pl.ds(_OX, n)
+
+            def copies(face, buf):
+                return (
+                    pltpu.make_async_copy(
+                        hc_ref.at[face], sh2.at[buf, dsy, dsx],
+                        sems.at[buf, 0]),
+                    pltpu.make_async_copy(
+                        uc_ref.at[0, face], sa2.at[buf, dsy, dsx],
+                        sems.at[buf, 1]),
+                    pltpu.make_async_copy(
+                        uc_ref.at[1, face], sb2.at[buf, dsy, dsx],
+                        sems.at[buf, 2]),
+                )
+
+            if manual_dma == "single":
+                for c in copies(f, 0):
+                    c.start()
+                buf = 0
+            else:
+                @pl.when(f == 0)
+                def _():
+                    for c in copies(0, 0):
+                        c.start()
+
+                @pl.when(f + 1 < 6)
+                def _():
+                    for c in copies(f + 1, (f + 1) % 2):
+                        c.start()
+
+                buf = f % 2
+            for c in copies(f, buf):
+                c.wait()
+            ov = lambda v: _OffsetView(v, _OY - halo, _OX - halo, m)
+            hf = ov(fill_ghosts(sh2.at[buf], None, gsn, gwe, 0))
+            ua = ov(fill_ghosts(sa2.at[buf], None, gsn, gwe, 1))
+            ub = ov(fill_ghosts(sb2.at[buf], None, gsn, gwe, 2))
+            hc_int = hf[i0:i1, i0:i1]
+            ua_int = ua[i0:i1, i0:i1]
+            ub_int = ub[i0:i1, i0:i1]
+        else:
+            hf = fill_ghosts(scratch[0], f32h(hc_ref[0]), gsn, gwe, 0)
+            ua = fill_ghosts(scratch[1], f32u(uc_ref[0, 0]), gsn, gwe, 1)
+            ub = fill_ghosts(scratch[2], f32u(uc_ref[1, 0]), gsn, gwe, 2)
+            hc_int = hc_ref[0]
+            ua_int = uc_ref[0, 0]
+            ub_int = uc_ref[1, 0]
         fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
         ssn = gsn[6 * h : 6 * h + 2] if seam else None
         swe = gwe[:, 6 * h : 6 * h + 2] if seam else None
@@ -1402,13 +1554,13 @@ def make_cov_stage_compact(
             emit_strips(ssn_ref, swe_ref, sval, fi)
 
         if with_y0:
-            emit(hc_ref[0], h0_ref[0], dh, ho_ref, 0, is_h=True)
-            emit(uc_ref[0, 0], u0_ref[0, 0], dua, uo_ref, 1, lead=(0,))
-            emit(uc_ref[1, 0], u0_ref[1, 0], dub, uo_ref, 2, lead=(1,))
+            emit(hc_int, h0_ref[0], dh, ho_ref, 0, is_h=True)
+            emit(ua_int, u0_ref[0, 0], dua, uo_ref, 1, lead=(0,))
+            emit(ub_int, u0_ref[1, 0], dub, uo_ref, 2, lead=(1,))
         else:
-            emit(hc_ref[0], None, dh, ho_ref, 0, is_h=True)
-            emit(uc_ref[0, 0], None, dua, uo_ref, 1, lead=(0,))
-            emit(uc_ref[1, 0], None, dub, uo_ref, 2, lead=(1,))
+            emit(hc_int, None, dh, ho_ref, 0, is_h=True)
+            emit(ua_int, None, dua, uo_ref, 1, lead=(0,))
+            emit(ub_int, None, dub, uo_ref, 2, lead=(1,))
 
     (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
      ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
@@ -1416,7 +1568,11 @@ def make_cov_stage_compact(
     in_specs = [fz_spec] + coord_specs
     if with_y0:
         in_specs += [hi_blk, ui_blk]
-    in_specs += [hi_blk, ui_blk, gsn_blk, gwe_blk, be_blk]
+    if manual_dma:
+        any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+        in_specs += [any_spec, any_spec, gsn_blk, gwe_blk, be_blk]
+    else:
+        in_specs += [hi_blk, ui_blk, gsn_blk, gwe_blk, be_blk]
 
     call = pl.pallas_call(
         kernel,
@@ -1425,9 +1581,18 @@ def make_cov_stage_compact(
             in_specs=in_specs,
             out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
             scratch_shapes=(
-                [pltpu.VMEM((m, m), jnp.float32) for _ in range(3)]
+                # Logical shape rounded up to whole (8, 128) tiles:
+                # slicing the buffer dim needs tile-aligned trailing
+                # SHAPES, not just offsets.
+                ([pltpu.VMEM((2, -(-(_OY + n + halo) // 8) * 8,
+                              -(-(_OX + n + halo) // 128) * 128),
+                             jnp.float32) for _ in range(3)]
+                 if manual_dma else
+                 [pltpu.VMEM((m, m), jnp.float32) for _ in range(3)])
                 + [pltpu.VMEM((n, n + 1), jnp.float32),
-                   pltpu.VMEM((n + 1, n), jnp.float32)]),
+                   pltpu.VMEM((n + 1, n), jnp.float32)]
+                + ([pltpu.SemaphoreType.DMA((2, 3))]
+                   if manual_dma else [])),
         ),
         out_shape=[
             jax.ShapeDtypeStruct((6, n, n), cdt_h),

@@ -107,6 +107,49 @@ def test_tpu_cartesian_fused():
     assert np.isfinite(np.asarray(out["h"])).all()
 
 
+def test_tpu_manual_dma_bitwise_parity():
+    """The manual-DMA measurement knob (swe_cov.make_cov_stage_compact
+    ``manual_dma``) must stay bitwise-identical to the production block
+    path — it bypasses the Pallas input pipeline entirely, so semantic
+    drift would be silent."""
+    import jax
+    import jax.numpy as jnp
+
+    from jaxstream.ops.pallas.swe_cov import make_fused_ssprk3_cov_compact
+    import jaxstream.ops.pallas.swe_cov as sc
+
+    # n must be a lane-tile multiple for the ANY-space per-face slices.
+    model, state = _tpu_model(128)
+    g = model.grid
+    y0 = model.compact_state(state)
+
+    def build(mode):
+        orig = sc.make_cov_stage_compact
+
+        def patched(*a, **kw):
+            kw["manual_dma"] = mode
+            return orig(*a, **kw)
+
+        sc.make_cov_stage_compact = patched
+        try:
+            return make_fused_ssprk3_cov_compact(
+                g, model.gravity, model.omega, 120.0, model.b_ext)
+        finally:
+            sc.make_cov_stage_compact = orig
+
+    outs = {}
+    for mode in (False, True, "single"):
+        step = build(mode)
+        out = y0
+        for _ in range(3):
+            out = jax.jit(step)(out, jnp.float32(0.0))
+        outs[mode] = jax.tree.map(np.asarray, out)
+    for mode in (True, "single"):
+        for k in outs[False]:
+            assert np.array_equal(outs[mode][k], outs[False][k]), \
+                f"manual_dma={mode} field {k} differs from block path"
+
+
 def test_tpu_mega_step():
     import jax
     import jax.numpy as jnp
