@@ -349,9 +349,82 @@ def bench_tc5(n=384, dt=75.0, warm_steps=10, timed_steps=24000,
     return sim_days_per_sec, variants
 
 
+def bench_galewsky(n=384, dt=60.0, nu4=1.0e14):
+    """Galewsky C384 with the fused del^4 stage pair (BASELINE.md ladder
+    config #5) — the variant line for the flagship validation case.
+
+    Runs the jet to day 6 (8 640 steps) and gates on the instability's
+    physics before reporting a rate: finite fields, physical h range,
+    mass conservation, day-6 vorticity filaments in the documented band
+    (max |zeta| ~1.5e-4 s^-1, docs/galewsky_c384_day6_vorticity.png),
+    and a QUIESCENT southern hemisphere (measured 8e-7 vs the north's
+    1.5e-4 — any spurious noise source trips this 180x separation).
+    dt=60: the jet adds ~80 m/s to the gravity-wave speed, so TC5's
+    CFL-matched 75 s does not transfer.  Returns sim-days/sec/chip
+    (0.0 on gate breach).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
+    from jaxstream.ops.fv import vorticity_cov
+    from jaxstream.physics.initial_conditions import galewsky
+    from jaxstream.stepping import integrate
+    from jaxstream.utils.profiling import steady_state_rate
+
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext = galewsky(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                  omega=EARTH_OMEGA, backend="pallas",
+                                  nu4=nu4)
+    step = model.make_fused_step(dt)
+    st = model.initial_state(h_ext, v_ext)
+    area = np.asarray(grid.interior(grid.area), np.float64)
+    h0 = np.asarray(st["h"], np.float64)
+    m0 = np.sum(area * h0)
+    run = jax.jit(lambda y, k: integrate(step, y, 0.0, k, dt)[0],
+                  donate_argnums=0)
+
+    y = run(model.compact_state(st), 8640)          # day 6
+    h = np.asarray(y["h"], np.float64)
+    zeta = np.asarray(vorticity_cov(grid, model._fill_u(y["u"])),
+                      np.float64)
+    lat = np.asarray(grid.interior(grid.lat))
+    zN = np.abs(zeta)[lat > 0.2].max()
+    zS = np.abs(zeta)[lat < -0.2].max()
+    mass = abs(np.sum(area * h) - m0) / m0
+    ok = (bool(np.all(np.isfinite(h))) and 8500.0 < h.min()
+          and h.max() < 10800.0 and mass < 1e-3
+          and 5e-5 < zN < 5e-4 and zS < 5e-6)
+    log(f"gate Galewsky C{n} nu4 day-6: finite={np.all(np.isfinite(h))} "
+        f"h_range=[{h.min():.0f},{h.max():.0f}] (in (8500,10800)) "
+        f"mass_drift={mass:.2e} (<1e-3) max|zeta| N={zN:.2e} "
+        f"(in (5e-5,5e-4)) S={zS:.2e} (<5e-6, quiescent hemisphere)")
+    if not ok:
+        log("gate Galewsky: FAILED — variant reported as 0")
+        return 0.0
+
+    rate, out = steady_state_rate(lambda y, k: run(y, k), y,
+                                  k1=2000, k2=8000)
+    if not np.all(np.isfinite(np.asarray(out["h"]))):
+        log("bench variant galewsky: non-finite after timing — 0")
+        return 0.0
+    v = rate * dt / 86400.0
+    log(f"bench variant galewsky-nu4: {rate:.1f} steps/s -> "
+        f"{v:.4f} sim-days/sec/chip ({v / BASELINE_PER_CHIP:.4f}x "
+        "baseline; fused del^4 two-kernel stage pair, dt=60)")
+    return v
+
+
 def main():
     gates_ok = accuracy_gates()
     value, variants = bench_tc5()
+    try:
+        variants["galewsky_nu4_C384"] = round(bench_galewsky(), 4)
+    except Exception as e:
+        log(f"bench variant galewsky unavailable ({type(e).__name__}: {e})")
     if not gates_ok:
         log("bench: ACCURACY/STABILITY GATE BREACH — reporting value 0")
         value = 0.0

@@ -75,6 +75,16 @@ class ModelConfig:
     # field a rank-`tt_rank` factor pair, nothing (n, n) materialized.
     numerics: str = "dense"          # 'dense' | 'tt'
     tt_rank: int = 16                # factored-state rank when numerics='tt'
+    # In-step Laplacian dissipation on the factored SWE's velocity
+    # components (m^2/s; numerics='tt' + shallow_water only) — ordinary
+    # explicit viscosity for the factored tier.  0 disables.
+    tt_kappa: float = 0.0
+    # Factored-step rounding: 'auto' picks 'svd' (exact truncation —
+    # the stability tier; forced nonlinear flows NaN within a sim-day
+    # under 'aca', DESIGN.md stability envelope) for shallow-water runs
+    # and 'aca' (cross approximation — the speed tier, no
+    # factorization kernels in the step) for advection/diffusion.
+    tt_rounding: str = "auto"        # 'auto' | 'aca' | 'svd'
 
 
 @dataclasses.dataclass(frozen=True)

@@ -242,12 +242,21 @@ class Simulation:
         cfg = self.config
         m, p, g, tc = cfg.model, cfg.physics, self.grid, cfg.time
         par = cfg.parallelization
-        if par.num_devices > 1 or par.use_shard_map:
+        sharded = par.num_devices > 1 or par.use_shard_map
+        if sharded and par.num_devices != 6:
+            hint = (" (or set use_shard_map: false for the "
+                    "single-device tier)" if par.num_devices == 1
+                    else "")
             raise ValueError(
-                "model.numerics='tt' is a single-device tier; set "
-                "parallelization.num_devices: 1 and use_shard_map: false "
-                "(the factored state is O(n r) per panel — sharding it "
-                "is not supported)")
+                "model.numerics='tt' shards one face per device over a "
+                "6-device ('panel',) mesh (jaxstream.tt.shard); set "
+                "parallelization.num_devices: 6"
+                f"{hint} — got {par.num_devices}")
+        if sharded and par.tiles_per_edge > 1:
+            raise ValueError(
+                "model.numerics='tt' supports tiles_per_edge: 1 only "
+                "(the factored state is O(n r) per panel; intra-panel "
+                f"tiling is not meaningful) — got {par.tiles_per_edge}")
         if g.halo < 1:
             raise ValueError(
                 "model.numerics='tt' needs grid.halo >= 1 (the factored "
@@ -287,35 +296,87 @@ class Simulation:
         fac = lambda q: factor_panels(np.asarray(q, np.float64), rank)
         fields = self._ic_fields(name, family)
 
+        mesh = None
+        if sharded:
+            from .parallel.mesh import _pick_devices
+            from .tt.shard import (
+                make_tt_sphere_advection_sharded,
+                make_tt_sphere_diffusion_sharded,
+                make_tt_sphere_swe_sharded, panel_mesh)
+
+            mesh = panel_mesh(_pick_devices(par.device_type, 6))
+
+        rounding = m.tt_rounding
+        if rounding == "auto":
+            # Forced nonlinear flows need the exact-truncation tier
+            # (DESIGN.md stability envelope); the linear families keep
+            # the cheaper cross rounding.
+            rounding = "svd" if family == "shallow_water" else "aca"
+        elif rounding not in ("aca", "svd"):
+            raise ValueError(
+                f"model.tt_rounding={rounding!r}: use 'auto', 'aca' or "
+                "'svd'")
+        if rounding == "svd" and family != "shallow_water":
+            raise ValueError(
+                "model.tt_rounding='svd' applies to the shallow-water "
+                "family only (advection/diffusion run 'aca'); set "
+                "tt_rounding: auto")
+        if m.tt_kappa != 0.0 and family != "shallow_water":
+            raise ValueError(
+                "model.tt_kappa (in-step velocity dissipation) applies "
+                "to the shallow-water family only; set tt_kappa: 0 for "
+                f"{family!r} runs")
+
         if family == "advection":
-            tt_step = make_tt_sphere_advection(g, fields["wind"], tc.dt,
-                                               rank, scheme=tc.scheme)
+            if sharded:
+                tt_step = make_tt_sphere_advection_sharded(
+                    g, fields["wind"], tc.dt, rank, mesh,
+                    scheme=tc.scheme)
+            else:
+                tt_step = make_tt_sphere_advection(
+                    g, fields["wind"], tc.dt, rank, scheme=tc.scheme)
             keys = ("q",)
             pairs = (fac(g.interior(fields["q"])),)
             single = True
         elif family == "diffusion":
-            tt_step = make_tt_sphere_diffusion(g, p.diffusivity, tc.dt,
-                                               rank, scheme=tc.scheme)
+            if sharded:
+                tt_step = make_tt_sphere_diffusion_sharded(
+                    g, p.diffusivity, tc.dt, rank, mesh,
+                    scheme=tc.scheme)
+            else:
+                tt_step = make_tt_sphere_diffusion(
+                    g, p.diffusivity, tc.dt, rank, scheme=tc.scheme)
             keys = ("T",)
             pairs = (fac(g.interior(fields["T"])),)
             single = True
         else:
             b_ext = fields["b_ext"]
-            tt_step = make_tt_sphere_swe(
-                g, tc.dt, rank, hs=b_ext, omega=p.omega,
-                gravity=p.gravity, scheme=tc.scheme)
+            kw = dict(hs=b_ext, omega=p.omega, gravity=p.gravity,
+                      scheme=tc.scheme, kappa=m.tt_kappa,
+                      rounding=rounding)
+            tt_step = (make_tt_sphere_swe_sharded(g, tc.dt, rank, mesh,
+                                                  **kw)
+                       if sharded else
+                       make_tt_sphere_swe(g, tc.dt, rank, **kw))
             ua, ub = covariant_from_cartesian(g, fields["v"])
             keys = ("h", "ua", "ub")
             pairs = (fac(g.interior(fields["h"])), fac(ua), fac(ub))
             single = False
             self._tt_hs = b_ext
         self._tt_keys = keys
-        log.info("using factored (TT) %s tier, rank %d", family, rank)
+        log.info("using factored (TT) %s tier, rank %d%s%s", family, rank,
+                 f", rounding {rounding}" if family == "shallow_water"
+                 else "",
+                 ", panel-sharded over 6 devices" if sharded else "")
 
         state = {}
         for k, (A, B) in zip(keys, pairs):
             state[k + "__ttA"] = A
             state[k + "__ttB"] = B
+        if sharded:
+            from .tt.shard import shard_factored_state
+
+            state = shard_factored_state(state, mesh)
 
         def step(y, t):
             del t

@@ -228,6 +228,21 @@ def stack_pairs(pairs):
             jnp.concatenate([p[1] for p in pairs], axis=1))
 
 
+def _local_statics(ST, face_slice):
+    """Device-local view of a face-leading statics pytree.
+
+    Every static array in the factored factories carries the face axis
+    FIRST (including factored coefficient pairs and the edge-statics
+    dicts).  ``face_slice=None`` (single-device) returns ``ST``
+    unchanged; under the panel-sharded tier it is
+    ``lambda x: lax.dynamic_index_in_dim(x, lax.axis_index('panel'), 0,
+    keepdims=True)`` — applied at trace time inside ``shard_map`` so
+    each device computes with its own face's coefficients."""
+    if face_slice is None:
+        return ST
+    return jax.tree_util.tree_map(face_slice, ST)
+
+
 def _factored_stepper_multi(rhs_pairs, rnd_many, scheme: str) -> Callable:
     """SSPRK3/Euler stepper over a TUPLE of factored panel fields.
 
@@ -289,7 +304,9 @@ def _diff_mid(x, inv2d):
 
 def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
                              coeff_tol: float = 1e-7,
-                             scheme: str = "ssprk3") -> Callable:
+                             scheme: str = "ssprk3",
+                             strip_ghosts=None,
+                             face_slice=None) -> Callable:
     """Jit-able factored-panel SSPRK3 step for cosine-bell advection.
 
     ``wind_ext``: Cartesian wind on the extended grid ``(3, 6, M, M)``
@@ -300,6 +317,12 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
     Khatri-Rao rank, so auto-sizing it is the difference between TT
     winning and losing).  The returned ``step((A, B)) -> (A, B)`` never
     materializes a panel.
+
+    ``strip_ghosts``/``face_slice``: the panel-sharded tier's injection
+    points (:mod:`jaxstream.tt.shard`) — a device-local ppermute strip
+    exchange replacing :func:`tt_strip_ghosts`, and the per-device
+    statics slicer (:func:`_local_statics`).  Defaults run the
+    single-device global exchange.
     """
     n, h = grid.n, grid.halo
     d = float(grid.dalpha)
@@ -317,21 +340,25 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
     Ca_i = Ca_e[:, sl, sl]
     Cb_i = Cb_e[:, sl, sl]
     isg_i = 1.0 / sg[:, sl, sl]
-    Ca_tt = factor_panels(Ca_i, _numerical_rank(Ca_i, coeff_tol, 16))
-    Cb_tt = factor_panels(Cb_i, _numerical_rank(Cb_i, coeff_tol, 16))
-    isg_tt = factor_panels(isg_i, _numerical_rank(isg_i, coeff_tol, 16))
-    # Static ghost strips of the coefficients (placed layout, depth-1
-    # nearest value only — the centered stencil reads one ghost deep).
-    CaW = jnp.asarray(Ca_e[:, sl, h - 1])                 # (6, n)
-    CaE = jnp.asarray(Ca_e[:, sl, h + n])
-    CbS = jnp.asarray(Cb_e[:, h - 1, sl])
-    CbN = jnp.asarray(Cb_e[:, h + n, sl])
+    ST = {
+        "Ca": factor_panels(Ca_i, _numerical_rank(Ca_i, coeff_tol, 16)),
+        "Cb": factor_panels(Cb_i, _numerical_rank(Cb_i, coeff_tol, 16)),
+        "isg": factor_panels(isg_i, _numerical_rank(isg_i, coeff_tol, 16)),
+        # Static ghost strips of the coefficients (placed layout, depth-1
+        # nearest value only — the centered stencil reads one ghost deep).
+        "CaW": jnp.asarray(Ca_e[:, sl, h - 1]),           # (6, n)
+        "CaE": jnp.asarray(Ca_e[:, sl, h + n]),
+        "CbS": jnp.asarray(Cb_e[:, h - 1, sl]),
+        "CbN": jnp.asarray(Cb_e[:, h + n, sl]),
+    }
 
     ridx, rwgt = edge_resample(n, d)
 
-    dtype = Ca_tt[0].dtype
+    dtype = ST["Ca"][0].dtype
     e0 = jnp.zeros((1, n), dtype).at[0, 0].set(1.0)
     eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
+    if strip_ghosts is None:
+        strip_ghosts = lambda q: tt_strip_ghosts(q, 1)
 
     aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
 
@@ -342,19 +369,20 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
     def rhs_pairs(q, scale):
         """Factor pairs (lists of (A (6,n,k), B (6,k,n))) of
         ``scale * dt * RHS(q)``."""
-        gS, gN, gW, gE = tt_strip_ghosts(q, 1)
+        S = _local_statics(ST, face_slice)
+        gS, gN, gW, gE = strip_ghosts(q)
         # Flux pairs F = C (.) q, rank r * r_c.
-        Fa = kr_raw_f(Ca_tt, q)
-        Fb = kr_raw_f(Cb_tt, q)
+        Fa = kr_raw_f(S["Ca"], q)
+        Fb = kr_raw_f(S["Cb"], q)
         # Dense ghost values of the fluxes at the nearest ring — ghost q
         # resampled onto the local continuation positions (the seam fix,
         # :func:`edge_resample`) where the static coefficients live.
         rs = lambda v: resample_strip(v, ridx, rwgt)
-        FaW = CaW * rs(gW[:, :, 0])                       # (6, n)
-        FaE = CaE * rs(gE[:, :, 0])
-        FbS = CbS * rs(gS[:, 0, :])
-        FbN = CbN * rs(gN[:, 0, :])
-        ones = jnp.ones((6, 1, 1), dtype)
+        FaW = S["CaW"] * rs(gW[:, :, 0])                  # (F, n)
+        FaE = S["CaE"] * rs(gE[:, :, 0])
+        FbS = S["CbS"] * rs(gS[:, 0, :])
+        FbN = S["CbN"] * rs(gN[:, 0, :])
+        ones = jnp.ones((q[0].shape[0], 1, 1), dtype)
         # D_a F: columns (axis -1): shifted-slice difference on the B
         # factor (O(n r), no (n, n) matrix) + rank-1 ghost corrections
         # at columns 0 / n-1 (D_a F[i, 0] = (F[i, 1] - F_gW[i])/(2 d)).
@@ -375,7 +403,7 @@ def make_tt_sphere_advection(grid, wind_ext, dt: float, rank: int,
         # r_c * (2 r r_c + 4)), then multiply by isg and scale; the
         # stage combine performs the final rounding.
         dA, dB = aca(*stack_pairs(da + db))
-        Ai, Bi = kr_raw_f(isg_tt, (dA, dB))
+        Ai, Bi = kr_raw_f(S["isg"], (dA, dB))
         return (-(scale * dt)) * Ai, Bi
 
     return _factored_stepper(rhs_pairs, aca, scheme)

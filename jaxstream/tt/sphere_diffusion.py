@@ -53,6 +53,7 @@ from .cross import aca_lowrank
 from .swe2d import kr_raw
 from .sphere import (
     _factored_stepper,
+    _local_statics,
     _numerical_rank,
     dense_strip_ghosts,
     edge_resample,
@@ -62,7 +63,8 @@ from .sphere import (
     tt_strip_ghosts,
 )
 
-__all__ = ["make_tt_sphere_diffusion", "make_dense_sphere_diffusion"]
+__all__ = ["make_tt_sphere_diffusion", "make_dense_sphere_diffusion",
+           "make_lap_pairs", "make_dense_lap"]
 
 
 def _diffusion_coeffs(grid):
@@ -124,14 +126,20 @@ def _edge_cdiff(core, lo, hi):
     return 0.5 * (ext[:, 2:] - ext[:, :-2])
 
 
-def make_tt_sphere_diffusion(grid, kappa: float, dt: float, rank: int,
-                             coeff_tol: float = 1e-7,
-                             scheme: str = "ssprk3") -> Callable:
-    """Jit-able factored-panel diffusion step ``dq/dt = kappa * lap q``.
+def make_lap_pairs(grid, coeff_tol: float = 1e-7,
+                   face_slice=None) -> Callable:
+    """Factored Laplace-Beltrami term builder, reusable across tiers.
 
-    Coefficients are factored once at their own numerical rank
-    (equiangular ``g^ij`` / ``L^j`` are nearly exact low rank).  The
-    returned ``step((A, B)) -> (A, B)`` never materializes a panel.
+    Factors the five coefficient fields once and returns
+    ``lap_pairs(q, lines) -> [(A, B), ...]``: the UNROUNDED factor
+    pairs of ``lap q`` for a factored panel field ``q = (A, B)``, with
+    ``lines = (gS0, gN0, gW0, gE0)`` the depth-1 resampled ghost lines
+    of ``q`` (however the caller obtained them — its own strip
+    exchange, or the SWE tier's already-exchanged primitives).  The
+    caller scales/stacks/rounds.  Used by
+    :func:`make_tt_sphere_diffusion` and by the factored SWE's in-step
+    velocity dissipation (:func:`..sphere_swe.make_tt_sphere_swe`
+    ``kappa``).
     """
     n = grid.n
     d = float(grid.dalpha)
@@ -139,24 +147,21 @@ def make_tt_sphere_diffusion(grid, kappa: float, dt: float, rank: int,
     invd2 = 1.0 / (d * d)
 
     cfs = _diffusion_coeffs(grid)
-    Gaa_tt, Gab_tt, Gbb_tt, La_tt, Lb_tt = (
-        factor_panels(c, _numerical_rank(c, coeff_tol, 16)) for c in cfs)
+    ST = {k: factor_panels(c, _numerical_rank(c, coeff_tol, 16))
+          for k, c in zip(("Gaa", "Gab", "Gbb", "La", "Lb"), cfs)}
 
-    ridx, rwgt = edge_resample(n, d)
-
-    dtype = Gaa_tt[0].dtype
+    dtype = ST["Gaa"][0].dtype
     e0 = jnp.zeros((1, n), dtype).at[0, 0].set(1.0)
     eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
-    ones = jnp.ones((6, 1, 1), dtype)
 
-    aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
     kr_raw_f = jax.vmap(kr_raw)
     stack = stack_pairs
 
-    def rhs_pairs(q, scale):
+    def lap_pairs(q, lines):
+        S = _local_statics(ST, face_slice)
         A, B = q
-        gS0, gN0, gW0, gE0 = _resampled_lines(
-            tt_strip_ghosts(q, 1), ridx, rwgt)
+        ones = jnp.ones((A.shape[0], 1, 1), dtype)
+        gS0, gN0, gW0, gE0 = lines
         sw, se, nw, ne = _corner_ghosts(gS0, gN0, gW0, gE0)
 
         # First derivatives: factor-local shifted-slice diffs (zero
@@ -191,7 +196,7 @@ def make_tt_sphere_diffusion(grid, kappa: float, dt: float, rank: int,
         # boundary-line corrections are strip derivatives along the
         # edge.  Column corrections use corner-extended strips (they own
         # the corner terms); row corrections use zero-extended strips.
-        zero = jnp.zeros((6,), dtype)
+        zero = jnp.zeros((A.shape[0],), dtype)
         cW = -inv2d * inv2d * _edge_cdiff(gW0, sw, nw) * 2.0
         cE = inv2d * inv2d * _edge_cdiff(gE0, se, ne) * 2.0
         rS = -inv2d * inv2d * _edge_cdiff(gS0, zero, zero) * 2.0
@@ -202,24 +207,50 @@ def make_tt_sphere_diffusion(grid, kappa: float, dt: float, rank: int,
                (e0.T[None] * ones, rS[:, None, :]),
                (eN.T[None] * ones, rN[:, None, :])]
 
-        terms = [kr_raw_f(Gaa_tt, stack(Daa)),
-                 kr_raw_f(Gbb_tt, stack(Dbb)),
-                 kr_raw_f(Gab_tt, stack([(2.0 * a, b) for a, b in Dab])),
-                 kr_raw_f(La_tt, stack(Da)),
-                 kr_raw_f(Lb_tt, stack(Db))]
-        Astk, Bstk = stack(terms)
+        return [kr_raw_f(S["Gaa"], stack(Daa)),
+                kr_raw_f(S["Gbb"], stack(Dbb)),
+                kr_raw_f(S["Gab"], stack([(2.0 * a, b) for a, b in Dab])),
+                kr_raw_f(S["La"], stack(Da)),
+                kr_raw_f(S["Lb"], stack(Db))]
+
+    return lap_pairs
+
+
+def make_tt_sphere_diffusion(grid, kappa: float, dt: float, rank: int,
+                             coeff_tol: float = 1e-7,
+                             scheme: str = "ssprk3",
+                             strip_ghosts=None,
+                             face_slice=None) -> Callable:
+    """Jit-able factored-panel diffusion step ``dq/dt = kappa * lap q``.
+
+    Coefficients are factored once at their own numerical rank
+    (equiangular ``g^ij`` / ``L^j`` are nearly exact low rank).  The
+    returned ``step((A, B)) -> (A, B)`` never materializes a panel.
+    ``strip_ghosts``/``face_slice``: the panel-sharded tier's injection
+    points (:mod:`jaxstream.tt.shard`; see
+    :func:`..sphere.make_tt_sphere_advection`).
+    """
+    n = grid.n
+    d = float(grid.dalpha)
+    lap_pairs = make_lap_pairs(grid, coeff_tol, face_slice=face_slice)
+    ridx, rwgt = edge_resample(n, d)
+    aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
+    if strip_ghosts is None:
+        strip_ghosts = lambda q: tt_strip_ghosts(q, 1)
+
+    def rhs_pairs(q, scale):
+        lines = _resampled_lines(strip_ghosts(q), ridx, rwgt)
+        Astk, Bstk = stack_pairs(lap_pairs(q, lines))
         dAo, dBo = aca(Astk, Bstk)
         return (scale * dt * kappa) * dAo, dBo
 
     return _factored_stepper(rhs_pairs, aca, scheme)
 
 
-def make_dense_sphere_diffusion(grid, kappa: float, dt: float,
-                                scheme: str = "ssprk3") -> Callable:
-    """Dense twin of :func:`make_tt_sphere_diffusion` — identical
-    stencils (zero-closure diffs + the same strip/corner corrections),
-    coefficients, and exchange; the parity oracle and speed baseline.
-    ``step(q (6, n, n)) -> (6, n, n)``."""
+def make_dense_lap(grid) -> Callable:
+    """Dense twin of :func:`make_lap_pairs`: returns
+    ``lap(q, lines) -> (6, n, n)`` with the identical stencils and
+    strip/corner corrections, ``lines = (gS0, gN0, gW0, gE0)``."""
     n = grid.n
     d = float(grid.dalpha)
     inv2d = 1.0 / (2.0 * d)
@@ -227,12 +258,10 @@ def make_dense_sphere_diffusion(grid, kappa: float, dt: float,
 
     Gaa, Gab, Gbb, La, Lb = (jnp.asarray(c, grid.sqrtg.dtype)
                              for c in _diffusion_coeffs(grid))
-    ridx, rwgt = edge_resample(n, d)
 
-    def rhs(q):
+    def lap(q, lines):
         dtype = q.dtype
-        gS0, gN0, gW0, gE0 = _resampled_lines(
-            dense_strip_ghosts(q, 1), ridx, rwgt)
+        gS0, gN0, gW0, gE0 = lines
         sw, se, nw, ne = _corner_ghosts(gS0, gN0, gW0, gE0)
 
         pad = lambda x, axis, side: jnp.pad(
@@ -263,8 +292,26 @@ def make_dense_sphere_diffusion(grid, kappa: float, dt: float,
         Dab = (Dab.at[:, :, 0].add(cW).at[:, :, -1].add(cE)
                .at[:, 0, :].add(rS).at[:, -1, :].add(rN))
 
-        return kappa * (Gaa * Daa + 2.0 * Gab * Dab + Gbb * Dbb
-                        + La * Da + Lb * Db)
+        return (Gaa * Daa + 2.0 * Gab * Dab + Gbb * Dbb
+                + La * Da + Lb * Db)
+
+    return lap
+
+
+def make_dense_sphere_diffusion(grid, kappa: float, dt: float,
+                                scheme: str = "ssprk3") -> Callable:
+    """Dense twin of :func:`make_tt_sphere_diffusion` — identical
+    stencils (zero-closure diffs + the same strip/corner corrections),
+    coefficients, and exchange; the parity oracle and speed baseline.
+    ``step(q (6, n, n)) -> (6, n, n)``."""
+    n = grid.n
+    d = float(grid.dalpha)
+    lap = make_dense_lap(grid)
+    ridx, rwgt = edge_resample(n, d)
+
+    def rhs(q):
+        lines = _resampled_lines(dense_strip_ghosts(q, 1), ridx, rwgt)
+        return kappa * lap(q, lines)
 
     def step(q):
         if scheme == "euler":

@@ -56,12 +56,13 @@ import jax
 import jax.numpy as jnp
 
 from ..config import EARTH_GRAVITY, EARTH_OMEGA
-from .cross import aca_lowrank, aca_lowrank_many
+from .cross import aca_lowrank, aca_lowrank_many, svd_lowrank
 from .swe2d import kr_raw
 from .sphere import (
     _diff_last,
     _diff_mid,
     _factored_stepper_multi,
+    _local_statics,
     _numerical_rank,
     dense_strip_ghosts,
     edge_resample,
@@ -124,7 +125,11 @@ def _swe_statics(grid, hs, omega: float):
     edges = {}
     for X, c in cut.items():
         edges[X] = {
-            "ea": ea[c], "eb": eb[c],
+            # Face axis FIRST on every edge static (ea/eb are
+            # (6, 3, n)) so the sharded tier's per-device slicer
+            # (sphere._local_statics) can treat the whole pytree
+            # uniformly.
+            "ea": np.moveaxis(ea[c], 0, 1), "eb": np.moveaxis(eb[c], 0, 1),
             "gaa": dot(aa, aa)[c], "gab": dot(aa, ab)[c],
             "gbb": dot(ab, ab)[c], "sg": sg[c],
             "hs": hs_e[c],
@@ -140,8 +145,8 @@ def _ghost_composites(hl, vl, ES, grav):
     out = {}
     for X in _EDGES:
         es = ES[X]
-        ua = sum(es["ea"][c] * vl[X][c] for c in range(3))
-        ub = sum(es["eb"][c] * vl[X][c] for c in range(3))
+        ua = sum(es["ea"][:, c] * vl[X][c] for c in range(3))
+        ub = sum(es["eb"][:, c] * vl[X][c] for c in range(3))
         uua = es["gaa"] * ua + es["gab"] * ub
         uub = es["gab"] * ua + es["gbb"] * ub
         sgh = es["sg"] * hl[X]
@@ -160,12 +165,36 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                        omega: float = EARTH_OMEGA,
                        gravity: float = EARTH_GRAVITY,
                        scheme: str = "ssprk3",
-                       batch_rounding=None) -> Callable:
+                       batch_rounding=None,
+                       kappa: float = 0.0,
+                       rounding: str = "aca",
+                       strip_ghosts=None,
+                       face_slice=None) -> Callable:
     """Jit-able factored-panel SWE step.
 
     State: ``((hA, hB), (uaA, uaB), (ubA, ubB))`` — rank-``rank``
     factor pairs per prognostic, ``q[f] = A[f] @ B[f]`` in the interior
     layout.  ``step(state) -> state``; nothing (n, n) is ever formed.
+
+    ``kappa`` (m^2/s): in-step Laplace-Beltrami dissipation on the
+    velocity components — ``du_i/dt += kappa lap u_i`` in factored form
+    via the :mod:`..sphere_diffusion` pair machinery, reusing the ghost
+    lines the velocity exchange already produced.  h stays undissipated
+    (mass is untouched).  The dense twin applies identical terms.
+
+    ``rounding``: ``'aca'`` (cross approximation, no factorization
+    kernels — the speed tier) or ``'svd'`` (exact best-rank-k
+    truncation via QR+SVD, :func:`..cross.svd_lowrank` — the stability
+    tier).  Measured on mountain-forced TC5 C96 (round 4, DESIGN.md
+    stability envelope): under 'aca' the run NaNs within 0.17-0.5
+    sim-days at every rank/kappa tried — the quasi-optimal skeleton's
+    excess truncation error acts as a large non-dissipative
+    perturbation the nonlinear flow amplifies, and kappa cannot damp
+    it; under 'svd' the same configurations integrate 5+ days with
+    physical fields.  Steady/short-horizon flows (TC2) are stable
+    under either.  Use 'svd' for forced nonlinear flows; kappa then
+    controls the ordinary grid-scale cascade like any explicit
+    viscosity.
     """
     n = grid.n
     d = float(grid.dalpha)
@@ -173,58 +202,81 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
     I, ES = _swe_statics(grid, hs, omega)
 
     fac = lambda c: factor_panels(c, _numerical_rank(c, coeff_tol, 16))
-    gaa_tt, gab_tt, gbb_tt = fac(I["gaa"]), fac(I["gab"]), fac(I["gbb"])
-    sg_tt, isg_tt, f_tt = fac(I["sg"]), fac(I["isg"]), fac(I["f"])
-    hs_tt = None if hs is None else fac(I["hs"])
-    aax_tt = [fac(I["aax"][c]) for c in range(3)]
-    abx_tt = [fac(I["abx"][c]) for c in range(3)]
-    ES = {X: {k: jnp.asarray(v) for k, v in es.items()}
-          for X, es in ES.items()}
+    ST = {
+        "gaa": fac(I["gaa"]), "gab": fac(I["gab"]), "gbb": fac(I["gbb"]),
+        "sg": fac(I["sg"]), "isg": fac(I["isg"]), "f": fac(I["f"]),
+        "aax": tuple(fac(I["aax"][c]) for c in range(3)),
+        "abx": tuple(fac(I["abx"][c]) for c in range(3)),
+        "ES": {X: {k: jnp.asarray(v) for k, v in es.items()}
+               for X, es in ES.items()},
+    }
+    if hs is not None:
+        ST["hs"] = fac(I["hs"])
 
     ridx, rwgt = edge_resample(n, d)
-    dtype = sg_tt[0].dtype
+    dtype = ST["sg"][0].dtype
     e0 = jnp.zeros((1, n), dtype).at[0, 0].set(1.0)
     eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
-    ones = jnp.ones((6, 1, 1), dtype)
+    if strip_ghosts is None:
+        strip_ghosts = lambda q: tt_strip_ghosts(q, 1)
+
+    lap_pairs = None
+    if kappa != 0.0:
+        from .sphere_diffusion import make_lap_pairs
+
+        lap_pairs = make_lap_pairs(grid, coeff_tol,
+                                   face_slice=face_slice)
 
     kr = jax.vmap(kr_raw)
-    if batch_rounding is None:
-        # Measured trade (DESIGN.md): batching the independent ACA
-        # sweeps wins on accelerators (dispatch-latency-bound, -14..23%
-        # on v5e) and loses on CPU (the zero-padding to the largest
-        # operand's bond rank adds real memory traffic, up to 1.8x at
-        # C1536).
-        batch_rounding = jax.default_backend() != "cpu"
-    if batch_rounding:
-        rnd_many = lambda ops: aca_lowrank_many(ops, rank)
+    if rounding == "svd":
+        vsvd = jax.vmap(lambda A, B: svd_lowrank(A, B, rank))
+        rnd_many = lambda ops: [tuple(vsvd(*p)) for p in ops]
+    elif rounding != "aca":
+        raise ValueError(f"rounding must be 'aca' or 'svd', "
+                         f"got {rounding!r}")
     else:
-        aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
-        rnd_many = lambda ops: [tuple(aca(*p)) for p in ops]
-
-    def da_pairs(pair, W, E):
-        """Factor pairs of D_a(pair) with ghost-line corrections."""
-        A, B = pair
-        return [(A, _diff_last(B, inv2d)),
-                (W[:, :, None] * (-inv2d), ones * e0[None]),
-                (E[:, :, None] * inv2d, ones * eN[None])]
-
-    def db_pairs(pair, S, N):
-        A, B = pair
-        return [(_diff_mid(A, inv2d), B),
-                (e0.T[None] * ones, S[:, None, :] * (-inv2d)),
-                (eN.T[None] * ones, N[:, None, :] * inv2d)]
+        if batch_rounding is None:
+            # Measured trade (DESIGN.md): batching the independent ACA
+            # sweeps wins on accelerators (dispatch-latency-bound,
+            # -14..23% on v5e) and loses on CPU (the zero-padding to
+            # the largest operand's bond rank adds real memory traffic,
+            # up to 1.8x at C1536).
+            batch_rounding = jax.default_backend() != "cpu"
+        if batch_rounding:
+            rnd_many = lambda ops: aca_lowrank_many(ops, rank)
+        else:
+            aca = jax.vmap(lambda A, B: aca_lowrank(A, B, rank))
+            rnd_many = lambda ops: [tuple(aca(*p)) for p in ops]
 
     def rhs3(state, scale):
         hp, uap, ubp = state
+        S = _local_statics(ST, face_slice)
+        hs_tt = S.get("hs")
+        ES_l = S["ES"]
+        ones = jnp.ones((hp[0].shape[0], 1, 1), dtype)
+
+        def da_pairs(pair, W, E):
+            """Factor pairs of D_a(pair) with ghost-line corrections."""
+            A, B = pair
+            return [(A, _diff_last(B, inv2d)),
+                    (W[:, :, None] * (-inv2d), ones * e0[None]),
+                    (E[:, :, None] * inv2d, ones * eN[None])]
+
+        def db_pairs(pair, Sl, N):
+            A, B = pair
+            return [(_diff_mid(A, inv2d), B),
+                    (e0.T[None] * ones, Sl[:, None, :] * (-inv2d)),
+                    (eN.T[None] * ones, N[:, None, :] * inv2d)]
+
         # --- ghost primitives: h strips + Cartesian velocity strips ---
-        hl = resampled_ghost_lines(tt_strip_ghosts(hp, 1), ridx, rwgt)
+        hl = resampled_ghost_lines(strip_ghosts(hp), ridx, rwgt)
         vl = {X: [] for X in _EDGES}
         for c in range(3):
-            vc = stack_pairs([kr(aax_tt[c], uap), kr(abx_tt[c], ubp)])
-            lc = resampled_ghost_lines(tt_strip_ghosts(vc, 1), ridx, rwgt)
+            vc = stack_pairs([kr(S["aax"][c], uap), kr(S["abx"][c], ubp)])
+            lc = resampled_ghost_lines(strip_ghosts(vc), ridx, rwgt)
             for X in _EDGES:
                 vl[X].append(lc[X])
-        G = _ghost_composites(hl, vl, ES, gravity)
+        G = _ghost_composites(hl, vl, ES_l, gravity)
 
         # --- interior factored intermediates, rounded in TWO batched
         # sweeps (sequential ACA latency is the TPU wall; the operands
@@ -236,9 +288,9 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                     + [(-a, b) for a, b in
                        db_pairs(uap, G["S"]["ua"], G["N"]["ua"])])
         uua, uub, sgh, curl = rnd_many([
-            stk([kr(gaa_tt, uap), kr(gab_tt, ubp)]),
-            stk([kr(gab_tt, uap), kr(gbb_tt, ubp)]),
-            stk([kr(sg_tt, hp)]),
+            stk([kr(S["gaa"], uap), kr(S["gab"], ubp)]),
+            stk([kr(S["gab"], uap), kr(S["gbb"], ubp)]),
+            stk([kr(S["sg"], hp)]),
             stk(curl_ops),
         ])
 
@@ -253,17 +305,26 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
             stk(da_pairs(kr(sgh, uua), G["W"]["Fa"], G["E"]["Fa"])
                 + db_pairs(kr(sgh, uub), G["S"]["Fb"], G["N"]["Fb"])),
             stk(kp_pairs),
-            stk([kr(isg_tt, curl), f_tt]),
-            stk([kr(sg_tt, uua)]),
-            stk([kr(sg_tt, uub)]),
+            stk([kr(S["isg"], curl), S["f"]]),
+            stk([kr(S["sg"], uua)]),
+            stk([kr(S["sg"], uub)]),
         ])
 
-        dh = kr(isg_tt, div)
+        dh = kr(S["isg"], div)
         dh = ((-scale * dt) * dh[0], dh[1])
         dua = [kr(zeta, mbu)] + [(-a, b) for a, b in
                                  da_pairs(KP, G["W"]["KP"], G["E"]["KP"])]
         dub = [(-a, b) for a, b in ([kr(zeta, mau)]
                + db_pairs(KP, G["S"]["KP"], G["N"]["KP"]))]
+        if lap_pairs is not None:
+            # In-step velocity dissipation, factored: the exchange's own
+            # resampled ghost lines of u_a/u_b serve as the Laplacian's
+            # depth-1 strips — no extra communication.
+            lines = lambda k: tuple(G[X][k] for X in _EDGES)
+            dua += [(kappa * a, b)
+                    for a, b in lap_pairs(uap, lines("ua"))]
+            dub += [(kappa * a, b)
+                    for a, b in lap_pairs(ubp, lines("ub"))]
         sc = lambda pairs: stack_pairs(
             [((scale * dt) * a, b) for a, b in pairs])
         return dh, sc(dua), sc(dub)
@@ -275,10 +336,13 @@ def make_dense_sphere_swe(grid, dt: float,
                           hs=None,
                           omega: float = EARTH_OMEGA,
                           gravity: float = EARTH_GRAVITY,
-                          scheme: str = "ssprk3") -> Callable:
+                          scheme: str = "ssprk3",
+                          kappa: float = 0.0) -> Callable:
     """Dense twin of :func:`make_tt_sphere_swe` — identical stencils,
     ghost composites, and exchange; the parity oracle and speed
-    baseline.  ``step((h, ua, ub)) -> (h, ua, ub)``, each (6, n, n)."""
+    baseline.  ``step((h, ua, ub)) -> (h, ua, ub)``, each (6, n, n).
+    ``kappa``: the same in-step velocity dissipation as the factored
+    tier (see :func:`make_tt_sphere_swe`)."""
     n = grid.n
     d = float(grid.dalpha)
     inv2d = 1.0 / (2.0 * d)
@@ -292,6 +356,12 @@ def make_dense_sphere_swe(grid, dt: float,
     ES = {X: {k: jnp.asarray(v, dtype) for k, v in es.items()}
           for X, es in ES.items()}
     ridx, rwgt = edge_resample(n, d)
+
+    lap = None
+    if kappa != 0.0:
+        from .sphere_diffusion import make_dense_lap
+
+        lap = make_dense_lap(grid)
 
     def Da(x, W, E):
         lo = jnp.pad(x[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
@@ -327,6 +397,10 @@ def make_dense_sphere_swe(grid, dt: float,
                       - Db(ua, G["S"]["ua"], G["N"]["ua"])) + f
         dua = zeta * sg * uub - Da(KP, G["W"]["KP"], G["E"]["KP"])
         dub = -zeta * sg * uua - Db(KP, G["S"]["KP"], G["N"]["KP"])
+        if lap is not None:
+            lines = lambda k: tuple(G[X][k] for X in _EDGES)
+            dua = dua + kappa * lap(ua, lines("ua"))
+            dub = dub + kappa * lap(ub, lines("ub"))
         return dh, dua, dub
 
     def step(state):

@@ -1,0 +1,128 @@
+"""TC5 C96 stability envelope of the factored sphere SWE.
+
+Measures, per (rank, kappa) configuration, how far the factored TC5
+integration runs before going non-finite (up to --days), and the final
+h-error against the dense twin run with the SAME kappa (so the error
+reported is rank-truncation error, not the dissipation difference).
+Feeds the rank-vs-horizon table in DESIGN.md ("stability envelope").
+
+Methodology matches the round-2 envelope measurement: f64, CPU backend,
+dt=300 s, finiteness checked every `check` steps on the unfactored h.
+
+    python scripts/tt_tc5_envelope.py [--days 5] [--ranks 8,16,24,32]
+        [--kappas 0,1e5,3e5,1e6] [--n 96] [--rounding aca|svd]
+
+Prints one JSON line per configuration (and a final dense reference
+line per kappa).  Round-4 result (DESIGN.md envelope table): under
+--rounding aca every configuration NaNs within 0.17-0.5 days; under
+--rounding svd rank 8+ integrates the full 5 days at truncation-level
+error — the blowup was ACA's excess over optimal truncation.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--days", type=float, default=5.0)
+    ap.add_argument("--dt", type=float, default=300.0)
+    ap.add_argument("--n", type=int, default=96)
+    ap.add_argument("--ranks", default="8,16,24,32")
+    ap.add_argument("--kappas", default="0,1e5,3e5,1e6")
+    ap.add_argument("--check", type=int, default=48,
+                    help="steps between finiteness checks (48 = 4 h)")
+    ap.add_argument("--rounding", default="aca", choices=("aca", "svd"))
+    args = ap.parse_args()
+
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.physics import initial_conditions as ics
+    from jaxstream.tt.sphere import factor_panels, unfactor_panels
+    from jaxstream.tt.sphere_swe import (covariant_from_cartesian,
+                                         make_dense_sphere_swe,
+                                         make_tt_sphere_swe)
+
+    n, dt = args.n, args.dt
+    nsteps = int(round(args.days * 86400.0 / dt))
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    h_ext, v_ext, b_ext = ics.williamson_tc5(grid, EARTH_GRAVITY,
+                                             EARTH_OMEGA)
+    h0 = np.asarray(grid.interior(h_ext))
+    ua0, ub0 = covariant_from_cartesian(grid, v_ext)
+    area = np.asarray(grid.interior(grid.area), np.float64)
+
+    ranks = [int(r) for r in args.ranks.split(",")]
+    kappas = [float(k) for k in args.kappas.split(",")]
+
+    # Dense references (one per kappa): the truncation-error oracle.
+    dense_h = {}
+    for kap in kappas:
+        step = jax.jit(make_dense_sphere_swe(grid, dt, hs=b_ext,
+                                             kappa=kap))
+        s = (jnp.asarray(h0), jnp.asarray(ua0), jnp.asarray(ub0))
+        t0 = time.time()
+        for _ in range(nsteps):
+            s = step(s)
+        h = np.asarray(s[0], np.float64)
+        fin = bool(np.isfinite(h).all())
+        dense_h[kap] = h if fin else None
+        print(json.dumps({
+            "config": "dense", "kappa": kap, "days": args.days,
+            "finite": fin,
+            "h_range": [float(h.min()), float(h.max())] if fin else None,
+            "wall_s": round(time.time() - t0, 1),
+        }), flush=True)
+
+    for rank in ranks:
+        for kap in kappas:
+            step = jax.jit(make_tt_sphere_swe(grid, dt, rank=rank,
+                                              hs=b_ext, kappa=kap,
+                                              rounding=args.rounding))
+            p = tuple(factor_panels(x, rank) for x in (h0, ua0, ub0))
+            t0 = time.time()
+            done = 0
+            horizon = None
+            while done < nsteps:
+                k = min(args.check, nsteps - done)
+                for _ in range(k):
+                    p = step(p)
+                done += k
+                h = np.asarray(unfactor_panels(p[0]), np.float64)
+                if not np.isfinite(h).all():
+                    horizon = (done - k) * dt / 86400.0
+                    break
+            rec = {"config": "tt", "rank": rank, "kappa": kap,
+                   "rounding": args.rounding,
+                   "days": args.days, "dt": dt,
+                   "wall_s": round(time.time() - t0, 1)}
+            if horizon is None:
+                rec["finite"] = True
+                rec["h_range"] = [float(h.min()), float(h.max())]
+                ref = dense_h.get(kap)
+                if ref is not None:
+                    d = h - ref
+                    rec["h_l2_vs_dense"] = float(np.sqrt(
+                        np.sum(area * d**2) / np.sum(area * ref**2)))
+                m0 = np.sum(area * h0)
+                rec["mass_drift"] = float(abs(np.sum(area * h) - m0) / m0)
+            else:
+                rec["finite"] = False
+                rec["horizon_days"] = round(horizon, 2)
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,13 +75,56 @@ def test_tt_advection_and_diffusion_tiers(tmp_path):
     assert np.isfinite(sim.diagnostics()["heat"])
 
 
+def test_tt_sharded_run_matches_single_device(tmp_path):
+    """numerics='tt' on 6 virtual devices (the panel-sharded tier,
+    round-3 verdict ask #4): runs end to end behind the same config
+    surface and tracks the single-device factored run.  Full rank +
+    svd rounding so the comparison is discretization-exact (see
+    tests/test_tt_shard.py for why truncated runs are not
+    device-count-comparable)."""
+    import jax
+
+    if len(jax.devices("cpu")) < 6:
+        pytest.skip("needs 6 virtual CPU devices")
+    base = {
+        "grid": {"n": 16, "halo": 2, "dtype": "float64"},
+        "model": {"numerics": "tt", "tt_rank": 16,
+                  "tt_rounding": "svd", "initial_condition": "tc2"},
+        "time": {"dt": 300.0, "nsteps": 4, "scheme": "euler"},
+    }
+    sim6 = Simulation({**base, "parallelization":
+                       {"num_devices": 6, "device_type": "cpu"}})
+    sim6.run()
+    sim1 = Simulation({**base, "parallelization":
+                       {"num_devices": 1, "device_type": "cpu"}})
+    sim1.run()
+    from jaxstream.tt.sphere import unfactor_panels
+
+    for k in ("h", "ua", "ub"):
+        d6 = np.asarray(unfactor_panels((np.asarray(sim6.state[k + "__ttA"]),
+                                         np.asarray(sim6.state[k + "__ttB"]))))
+        d1 = np.asarray(unfactor_panels((sim1.state[k + "__ttA"],
+                                         sim1.state[k + "__ttB"])))
+        err = np.max(np.abs(d6 - d1)) / np.max(np.abs(d1))
+        assert err < 1e-10, (k, err)
+
+
 def test_tt_tier_validation(tmp_path):
     """Clear remediation errors for unsupported TT configurations."""
-    with pytest.raises(ValueError, match="single-device"):
+    with pytest.raises(ValueError, match="6-device"):
         Simulation({
             "model": {"numerics": "tt"},
-            "parallelization": {"num_devices": 6, "device_type": "cpu"},
+            "parallelization": {"num_devices": 4, "device_type": "cpu"},
         })
+    with pytest.raises(ValueError, match="tiles_per_edge"):
+        Simulation({
+            "model": {"numerics": "tt"},
+            "parallelization": {"num_devices": 6, "tiles_per_edge": 2,
+                                "device_type": "cpu"},
+        })
+    with pytest.raises(ValueError, match="tt_rounding"):
+        Simulation({"model": {"numerics": "tt", "tt_rounding": "qr"},
+                    "parallelization": {"num_devices": 1}})
     with pytest.raises(ValueError, match="valid: 'dense'"):
         Simulation({"model": {"numerics": "qtt"},
                     "parallelization": {"num_devices": 1}})
