@@ -112,10 +112,9 @@ def qtt(sizes, rank=12):
                  for c in qtt_compress_separable(rows, cols, rank)]
         tq = _median_rate(step, y, 10)
         msg = f"N={N:6d}: qtt {tq * 1e3:8.2f} ms/step"
-        if N <= 4096:
-            qd = jnp.asarray(q0)
 
-            def dstep(q, _dx=dx, _dt=dt):
+        def make_dstep(_dx=dx, _dt=dt):
+            def dstep(q):
                 def lap(v):
                     return (jnp.roll(v, 1, 0) + jnp.roll(v, -1, 0)
                             + jnp.roll(v, 1, 1) + jnp.roll(v, -1, 1)
@@ -123,10 +122,40 @@ def qtt(sizes, rank=12):
                 k1 = q + _dt * lap(q)
                 y2 = 0.75 * q + 0.25 * (k1 + _dt * lap(k1))
                 return q / 3 + (2.0 / 3.0) * (y2 + _dt * lap(y2))
+            return jax.jit(dstep)
 
-            td = _median_rate(jax.jit(dstep), qd, 10)
+        # Dense baseline: MEASURED through N=16384 in f64 (2.1 GB
+        # field; fewer reps — the step is seconds); at N=65536 the f64
+        # field alone is 34 GB and the roll temporaries exceed host
+        # RAM, so the rung is measured in f32 (17 GB field) and
+        # labeled — a CONSERVATIVE comparison for the f64 QTT step
+        # (f32 dense moves half the bytes an f64 dense would).
+        try:
+            if N <= 4096:
+                td = _median_rate(make_dstep(), jnp.asarray(q0), 10)
+                tag = ""
+            elif N <= 16384:
+                qd = jnp.asarray(sum(np.outer(rows[k], cols[k])
+                                     for k in range(2)))
+                td = _median_rate(make_dstep(), qd, 2, reps=3)
+                tag = " [measured f64]"
+            else:
+                # Assemble in f32 from the start (an f64 intermediate
+                # would be 34 GB by itself); accumulate in place so the
+                # peak stays at two 17 GB buffers.
+                r32 = rows.astype(np.float32)
+                c32 = cols.astype(np.float32)
+                q0f = np.outer(r32[0], c32[0])
+                q0f += np.outer(r32[1], c32[1])
+                qd = jnp.asarray(q0f)
+                del q0f
+                td = _median_rate(make_dstep(), qd, 1, reps=1)
+                gb = N * N * 8 / 2**30
+                tag = f" [measured f32: f64 field would be {gb:.0f} GB]"
             msg += (f"   dense {td * 1e3:8.2f} ms/step   "
-                    f"speedup {td / tq:.2f}x")
+                    f"speedup {td / tq:.2f}x{tag}")
+        except (MemoryError, RuntimeError) as e:
+            msg += f"   dense: not measured ({type(e).__name__})"
         print(msg, flush=True)
 
 
