@@ -310,8 +310,36 @@ class Simulation:
         if rounding == "auto":
             # Forced nonlinear flows need the exact-truncation tier
             # (DESIGN.md stability envelope); the linear families keep
-            # the cheaper cross rounding.
-            rounding = "svd" if family == "shallow_water" else "aca"
+            # the cheaper cross rounding.  The svd tier is
+            # CPU-validated only — TPU f32 QR/eigh lose orthogonality
+            # at production bond sizes (cross.svd_lowrank docstring) —
+            # so 'auto' picks it for CPU runs and falls back to 'aca'
+            # elsewhere with a warning.
+            if family == "shallow_water":
+                # The platform the step will EXECUTE on: a sharded run
+                # is pinned to its mesh's devices; a single-device run
+                # lands on the process default backend regardless of
+                # device_type (nothing pins it).
+                import jax
+
+                if sharded and par.device_type != "default":
+                    exec_backend = par.device_type
+                else:
+                    exec_backend = jax.default_backend()
+                if exec_backend == "cpu":
+                    rounding = "svd"
+                else:
+                    rounding = "aca"
+                    log.warning(
+                        "numerics='tt' shallow water executes on %s; "
+                        "keeping tt_rounding='aca' (the svd stability "
+                        "tier is CPU-validated only).  Forced "
+                        "nonlinear flows (TC5) destabilize under "
+                        "'aca' — run this case on CPU (sharded: "
+                        "device_type: cpu; single-device: a CPU-"
+                        "default process)", exec_backend)
+            else:
+                rounding = "aca"
         elif rounding not in ("aca", "svd"):
             raise ValueError(
                 f"model.tt_rounding={rounding!r}: use 'auto', 'aca' or "

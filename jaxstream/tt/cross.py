@@ -36,7 +36,7 @@ import jax.numpy as jnp
 __all__ = ["aca_lowrank", "aca_lowrank_many", "svd_lowrank"]
 
 
-def svd_lowrank(P, Q, k: int):
+def svd_lowrank(P, Q, k: int, backend: str | None = None):
     """EXACT best rank-``k`` truncation of ``M = P @ Q`` (never formed):
     thin QR of ``P`` then SVD of the small ``(R, m)`` product —
     O(n R^2 + R^2 m + R m min(R, m)), one QR + one SVD per call.
@@ -52,11 +52,66 @@ def svd_lowrank(P, Q, k: int):
     "non-dissipative perturbation" that destabilized the flow was
     dominated by ACA's excess over optimal truncation, not by optimal
     truncation itself.  Factors are balanced ``sqrt(s)`` per side (the
-    layer's convention)."""
-    Qf, Rf = jnp.linalg.qr(P)
-    U, s, Vt = jnp.linalg.svd(Rf @ Q, full_matrices=False)
-    rs = jnp.sqrt(s[:k])
-    return Qf @ (U[:, :k] * rs[None]), (rs[:, None] * Vt[:k])
+    layer's convention).
+
+    Backend status (round 4, measured): CPU f32/f64 run the QR+SVD
+    path (LAPACK; TC5 C96 stable 8+ sim-hours in f32, 5 days in f64).
+    On ACCELERATOR f32 the QR+SVD path NaNs the TC5 run within 4-8
+    sim-hours — with AND without pinned matmul precision (TPU f32 QR
+    loses orthogonality on near-rank-deficient operands, the same
+    failure qtt.py:418-432 hit) — so that combination routes to the
+    masked-Gram-eigh path below (qtt's proven f32 construction).  The
+    v5e's f32 ``eigh`` then ALSO degrades at production bond sizes
+    (garbage eigenbasis at bond ~100, followed by a TPU-worker crash;
+    correct at bond ~20), so the svd stability tier is currently
+    CPU-validated only; the TPU path stays in place as the
+    best-known-construction for when TPU linalg robustness improves
+    (Simulation's 'auto' picks it only for CPU runs).
+
+    ``backend``: the platform this rounding will execute on ('cpu' /
+    'tpu' / ...).  Callers that place computation explicitly (the
+    panel-sharded tier's CPU mesh inside a TPU-enabled process) MUST
+    pass it — the default consults the process-global
+    ``jax.default_backend()``, which is where an un-pinned jit runs.
+    """
+    if backend is None:
+        backend = jax.default_backend()
+    if P.dtype == jnp.float32 and backend != "cpu":
+        return _svd_lowrank_gram(P, Q, k)
+    with jax.default_matmul_precision("highest"):
+        Qf, Rf = jnp.linalg.qr(P)
+        U, s, Vt = jnp.linalg.svd(Rf @ Q, full_matrices=False)
+        rs = jnp.sqrt(s[:k])
+        return Qf @ (U[:, :k] * rs[None]), (rs[:, None] * Vt[:k])
+
+
+def _svd_lowrank_gram(P, Q, k: int):
+    """f32 exact-truncation path: two masked Gram eighs, no QR/SVD.
+
+    ``M = P Q``; eigh of ``Q Q^T`` gives ``Q = S W`` with orthonormal
+    rows ``W`` (masked against zero modes), so ``M = (P S) W`` and the
+    best rank-k of ``M`` is the best rank-k of ``T = P S`` against
+    ``W``; eigh of ``T^T T`` then yields the singular pairs.  Balanced
+    ``sqrt(sigma)`` per side; zero-padded to exactly rank k."""
+    fi = jnp.finfo(P.dtype)
+    with jax.default_matmul_precision("highest"):
+        lam_q, Eq = jnp.linalg.eigh(Q @ Q.T)            # ascending
+        keep_q = lam_q > fi.eps * lam_q[-1] + fi.tiny
+        sq = jnp.sqrt(jnp.where(keep_q, lam_q, 1.0))
+        W = jnp.where(keep_q, 1.0 / sq, 0.0)[:, None] * (Eq.T @ Q)
+        T = P @ (Eq * jnp.where(keep_q, sq, 0.0)[None, :])
+        lam, E = jnp.linalg.eigh(T.T @ T)
+        lam, E = lam[::-1], E[:, ::-1]
+        kk = min(k, T.shape[1])
+        keep = lam[:kk] > fi.eps * jnp.maximum(lam[0], 0.0) + fi.tiny
+        s = jnp.sqrt(jnp.where(keep, lam[:kk], 1.0))    # sigma_i of M
+        root = jnp.sqrt(s)
+        A = T @ (E[:, :kk] * jnp.where(keep, root / s, 0.0)[None, :])
+        B = jnp.where(keep, root, 0.0)[:, None] * (E[:, :kk].T @ W)
+        if kk < k:
+            A = jnp.pad(A, ((0, 0), (0, k - kk)))
+            B = jnp.pad(B, ((0, k - kk), (0, 0)))
+        return A, B
 
 
 def aca_lowrank(P, Q, k: int):

@@ -176,6 +176,11 @@ def make_tt_sphere_swe_sharded(grid, dt, rank, mesh,
     from .sphere_swe import make_tt_sphere_swe
 
     kw.setdefault("batch_rounding", False)
+    # The svd rounding's CPU/accelerator dispatch must follow the
+    # MESH's platform, not the process default backend (a CPU panel
+    # mesh inside a TPU-enabled process must keep the CPU path).
+    kw.setdefault("rounding_backend",
+                  mesh.devices.flat[0].platform)
     return _shard_step(
         partial(make_tt_sphere_swe, grid, dt, rank, **kw),
         mesh, axis_name)

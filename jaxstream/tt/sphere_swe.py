@@ -168,6 +168,7 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                        batch_rounding=None,
                        kappa: float = 0.0,
                        rounding: str = "aca",
+                       rounding_backend: str | None = None,
                        strip_ghosts=None,
                        face_slice=None) -> Callable:
     """Jit-able factored-panel SWE step.
@@ -229,7 +230,12 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
 
     kr = jax.vmap(kr_raw)
     if rounding == "svd":
-        vsvd = jax.vmap(lambda A, B: svd_lowrank(A, B, rank))
+        # rounding_backend: where this step will actually execute —
+        # the sharded tier passes its mesh's platform so a CPU mesh
+        # inside a TPU-enabled process keeps the CPU-validated path.
+        vsvd = jax.vmap(
+            lambda A, B: svd_lowrank(A, B, rank,
+                                     backend=rounding_backend))
         rnd_many = lambda ops: [tuple(vsvd(*p)) for p in ops]
     elif rounding != "aca":
         raise ValueError(f"rounding must be 'aca' or 'svd', "
