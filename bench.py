@@ -17,8 +17,9 @@ The timed step is dt=75 s — matched to the worst-cell CFL the C96 gate
 config has always run at; the verification evidence (15-day stability,
 temporal error at the f32 roundoff floor) is in ``bench_tc5``'s
 docstring and DESIGN.md "The time step".  The ``variants`` JSON field
-records the dt=60-equivalent rate (rounds 1-3 comparability) and the
-opt-in bf16-carry rate.
+records the dt=60-equivalent rate (rounds 1-3 comparability), the
+opt-in bf16-carry rate, the dt=90 empirical-max-stable rate (own
+15-day gate each run), and the Galewsky-nu4 rate (day-6 physics gate).
 """
 
 from __future__ import annotations
@@ -261,19 +262,21 @@ def bench_tc5(n=384, dt=75.0, warm_steps=10, timed_steps=24000,
     h0_f64 = np.asarray(grid.interior(h_ext), np.float64)
     mass0 = np.sum(area_w * h0_f64)
 
-    def tc5_gate(h, label):
+    def tc5_gate(h, label, mass_tol=1e-3):
         """Shared TC5 C384 stability gate: finite, physical h range,
-        mass conserved vs the initial state.  Returns ok (logged)."""
+        mass conserved vs the initial state.  Returns ok (logged).
+        ``mass_tol``: the f32 default is 1e-3; the bf16-carry variant
+        uses its own documented band (see the call site)."""
         if h.shape[-1] != grid.n:
             h = grid.interior(h)
         h = np.asarray(h, np.float64)
         finite = bool(np.all(np.isfinite(h)))
         mass_drift = abs(np.sum(area_w * h) - mass0) / mass0
         ok = (finite and 3000.0 < h.min() and h.max() < 6500.0
-              and mass_drift < 1e-3)
+              and mass_drift < mass_tol)
         log(f"bench gate C{n} TC5 {label}: finite={finite} "
             f"h_range=[{h.min():.0f},{h.max():.0f}] (in (3000,6500)) "
-            f"mass_drift={mass_drift:.3e} (<1e-3)")
+            f"mass_drift={mass_drift:.3e} (<{mass_tol:g})")
         return ok
 
     # Total integration reaching `out`: warmup + both measurement
@@ -336,9 +339,18 @@ def bench_tc5(n=384, dt=75.0, warm_steps=10, timed_steps=24000,
             jax.block_until_ready(y16["h"])
             rate16, out16 = steady_state_rate(
                 lambda y, k: run16(y, k), y16, k1=3000, k2=12000)
-            if not np.all(np.isfinite(np.asarray(out16["h"],
-                                                 np.float32))):
-                raise RuntimeError("bf16 variant produced non-finite h")
+            h16 = model.decode_carry(out16, h_offset=off)["h"]
+            # bf16's own gate band: the 16-bit h-anomaly carry leaks
+            # mass at a measured ~1.3e-3 per sim-day at C384 (round 4;
+            # the f32 path holds < 1e-3 over 26 days) — that leak IS
+            # the recorded trade, bounded here at 3e-2 over the ~13-day
+            # window so a regression beyond the known trade still
+            # suppresses the line.  Accuracy-neutral 16-bit storage
+            # exists (int16 fixed-point, DESIGN.md carry ladder) at
+            # +0.5% instead of +7%.
+            if not tc5_gate(h16, "bf16 timed run (own trade band)",
+                            mass_tol=3e-2):
+                raise RuntimeError("bf16 variant gate breached")
             v16 = rate16 * dt / 86400.0
             variants["bf16_carry"] = round(v16, 4)
             log(f"bench variant bf16-carry: {rate16:.1f} steps/s -> "
