@@ -1298,6 +1298,8 @@ class _OffsetView:
                 if start < 0 or stop < 0:
                     raise ValueError("_OffsetView: negative slice bounds")
                 return slice(start + off, stop + off)
+            if s < 0:
+                raise ValueError("_OffsetView: negative integer indices")
             return s + off
 
         return self.v[sh(r, self.by, self.m), sh(c, self.bx, self.m)]

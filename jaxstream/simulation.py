@@ -308,13 +308,12 @@ class Simulation:
 
         rounding = m.tt_rounding
         if rounding == "auto":
-            # Forced nonlinear flows need the exact-truncation tier
-            # (DESIGN.md stability envelope); the linear families keep
-            # the cheaper cross rounding.  The svd tier is
-            # CPU-validated only — TPU f32 QR/eigh lose orthogonality
-            # at production bond sizes (cross.svd_lowrank docstring) —
-            # so 'auto' picks it for CPU runs and falls back to 'aca'
-            # elsewhere with a warning.
+            # Forced nonlinear flows need a near-optimal-truncation
+            # tier (DESIGN.md stability envelope); the linear families
+            # keep the cheaper cross rounding.  Exact svd is CPU-only
+            # — TPU f32 QR/eigh lose orthogonality at production bond
+            # sizes (cross.svd_lowrank docstring) — so accelerators
+            # get the matmul-only rsvd tier instead.
             if family == "shallow_water":
                 # The platform the step will EXECUTE on: a sharded run
                 # is pinned to its mesh's devices; a single-device run
@@ -329,26 +328,30 @@ class Simulation:
                 if exec_backend == "cpu":
                     rounding = "svd"
                 else:
-                    rounding = "aca"
-                    log.warning(
-                        "numerics='tt' shallow water executes on %s; "
-                        "keeping tt_rounding='aca' (the svd stability "
-                        "tier is CPU-validated only).  Forced "
-                        "nonlinear flows (TC5) destabilize under "
-                        "'aca' — run this case on CPU (sharded: "
-                        "device_type: cpu; single-device: a CPU-"
-                        "default process)", exec_backend)
+                    # Accelerators cannot run the exact tier (f32
+                    # QR/eigh are measured-broken on the v5e,
+                    # cross.svd_lowrank docstring) — but round 5's
+                    # matmul-only rsvd tier is near-optimal (<=1.04x
+                    # the exact truncation, tests/
+                    # test_tt_rounding_tiers.py) and TPU-validated:
+                    # mountain-forced TC5 C96 integrates 5+ sim-days
+                    # finite on the real chip at the exact tier's f32
+                    # error level (DESIGN.md stability envelope,
+                    # round-5 addendum).  'aca' would NaN TC5 within
+                    # half a sim-day; never auto-select it here.
+                    rounding = "rsvd"
             else:
                 rounding = "aca"
-        elif rounding not in ("aca", "svd"):
+        elif rounding not in ("aca", "svd", "rsvd", "host_svd"):
             raise ValueError(
-                f"model.tt_rounding={rounding!r}: use 'auto', 'aca' or "
-                "'svd'")
-        if rounding == "svd" and family != "shallow_water":
+                f"model.tt_rounding={rounding!r}: use 'auto', 'aca', "
+                "'svd', 'rsvd' or 'host_svd'")
+        if (rounding in ("svd", "rsvd", "host_svd")
+                and family != "shallow_water"):
             raise ValueError(
-                "model.tt_rounding='svd' applies to the shallow-water "
-                "family only (advection/diffusion run 'aca'); set "
-                "tt_rounding: auto")
+                f"model.tt_rounding={rounding!r} applies to the "
+                "shallow-water family only (advection/diffusion run "
+                "'aca'); set tt_rounding: auto")
         if m.tt_kappa != 0.0 and family != "shallow_water":
             raise ValueError(
                 "model.tt_kappa (in-step velocity dissipation) applies "

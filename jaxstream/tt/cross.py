@@ -33,7 +33,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["aca_lowrank", "aca_lowrank_many", "svd_lowrank"]
+__all__ = ["aca_lowrank", "aca_lowrank_many", "svd_lowrank",
+           "rsvd_lowrank", "host_svd_lowrank"]
 
 
 def svd_lowrank(P, Q, k: int, backend: str | None = None):
@@ -81,8 +82,14 @@ def svd_lowrank(P, Q, k: int, backend: str | None = None):
     with jax.default_matmul_precision("highest"):
         Qf, Rf = jnp.linalg.qr(P)
         U, s, Vt = jnp.linalg.svd(Rf @ Q, full_matrices=False)
-        rs = jnp.sqrt(s[:k])
-        return Qf @ (U[:, :k] * rs[None]), (rs[:, None] * Vt[:k])
+        kk = min(k, s.shape[0])
+        rs = jnp.sqrt(s[:kk])
+        A = Qf @ (U[:, :kk] * rs[None])
+        B = rs[:, None] * Vt[:kk]
+        if kk < k:  # zero-pad to exactly rank k (the gram path's contract)
+            A = jnp.pad(A, ((0, 0), (0, k - kk)))
+            B = jnp.pad(B, ((0, k - kk), (0, 0)))
+        return A, B
 
 
 def _svd_lowrank_gram(P, Q, k: int):
@@ -112,6 +119,137 @@ def _svd_lowrank_gram(P, Q, k: int):
             A = jnp.pad(A, ((0, 0), (0, k - kk)))
             B = jnp.pad(B, ((0, k - kk), (0, 0)))
         return A, B
+
+
+def _ns_orth(X, iters: int = 90):
+    """Orthonormalize the columns of ``X (n, l)`` by Newton-Schulz
+    polar iteration — **matmul-only**, no QR/eigh/SVD primitives.
+
+    The cubic map ``X <- 1.5 X - 0.5 X (X^T X)`` drives every singular
+    value of the Frobenius-prenormalized operand toward 1 (monotone on
+    (0, sqrt(3)); ~1.5x growth per sweep for small values, quadratic
+    contraction near the fixed point), so the limit is the orthogonal
+    polar factor of ``X`` — same column span, orthonormal columns.
+    This is the v5e-robust replacement for the f32 ``jnp.linalg.qr``
+    whose orthogonality loss on near-rank-deficient operands NaN'd the
+    svd rounding tier on TPU (see :func:`svd_lowrank` backend notes):
+    matmuls carry none of the Householder pivoting that breaks there,
+    and exactly-zero columns (rank-deficient operands, zero-padded
+    factors) stay exactly zero instead of poisoning the basis.
+    """
+    fi = jnp.finfo(X.dtype)
+    X = X / (jnp.sqrt(jnp.sum(X * X)) + fi.tiny)
+    with jax.default_matmul_precision("highest"):
+        def body(_, Y):
+            return 1.5 * Y - 0.5 * (Y @ (Y.T @ Y))
+
+        return jax.lax.fori_loop(0, iters, body, X)
+
+
+_SKETCH_SEED = 7031  # fixed: rounding is deterministic run to run
+
+
+def _balanced(A, B, k: int):
+    """Rescale mode ``j`` so each side carries ``sqrt(sigma_j)`` (the
+    layer's factor convention; ``sigma_j ~ |A_j| |B_j|``), zero dead
+    modes, and zero-pad to exactly width ``k``.  The product ``A B`` is
+    unchanged on live modes."""
+    fi = jnp.finfo(A.dtype)
+    na = jnp.sqrt(jnp.sum(A * A, axis=0))
+    nb = jnp.sqrt(jnp.sum(B * B, axis=1))
+    s = na * nb
+    keep = s > fi.tiny
+    root = jnp.sqrt(jnp.where(keep, s, 1.0))
+    A = A * jnp.where(keep, root / jnp.maximum(na, fi.tiny), 0.0)[None, :]
+    B = jnp.where(keep, root / jnp.maximum(nb, fi.tiny), 0.0)[:, None] * B
+    w = A.shape[1]
+    if w < k:
+        A = jnp.pad(A, ((0, 0), (0, k - w)))
+        B = jnp.pad(B, ((0, k - w), (0, 0)))
+    return A, B
+
+
+def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
+                 subspace_iters: int = 6, ns_iters: int = 90):
+    """Near-optimal rank-``k`` truncation of ``M = P @ Q`` using ONLY
+    matrix multiplies — the TPU-viable stability tier (round 5).
+
+    The exact tier (:func:`svd_lowrank`) is measured-blocked on v5e
+    f32: QR loses orthogonality and ``eigh`` returns garbage at
+    production bond sizes (its docstring).  This tier replaces every
+    factorization primitive with Newton-Schulz polar orthogonalization
+    (:func:`_ns_orth`) inside a two-stage randomized-SVD:
+
+    1. **Range finder** (Halko-Martinsson-Tropp): a deterministic
+       Gaussian sketch of width ``l = k + oversample`` gives
+       ``Y = P (Q Om)``; ``power`` subspace iterations with NS
+       re-orthogonalization tighten the basis ``U`` toward the top-l
+       left singular space.  Oversampling keeps the *top-k* angle
+       small even where the spectrum is flat at the cutoff.
+    2. **Core truncation**: project ``C = (U^T P) Q`` (small,
+       ``(l, m)``) and extract its top-k right basis ``V`` by NS-
+       orthogonalized subspace iteration on the explicit core — cheap,
+       so ``subspace_iters`` can be generous.  ``M ~ (U C V) V^T``.
+
+    Error ~ sigma_{k+1} times a modest factor (measured against the
+    exact tier in tests/test_tt_rounding_tiers.py); deterministic
+    (fixed sketch key) and jit/vmap-safe.  Factors balanced
+    ``sqrt(sigma)`` per side, zero-padded to exactly ``k``.
+    """
+    n, R = P.shape
+    m = Q.shape[1]
+    rmax = min(n, m, R)
+    l = min(k + oversample, rmax)
+    with jax.default_matmul_precision("highest"):
+        key = jax.random.PRNGKey(_SKETCH_SEED)
+        Om = jax.random.normal(key, (m, l), P.dtype)
+        U = _ns_orth(P @ (Q @ Om), ns_iters)
+        for _ in range(power):
+            Z = Q.T @ (P.T @ U)                       # (m, l)
+            U = _ns_orth(P @ (Q @ Z), ns_iters)
+        C = (U.T @ P) @ Q                             # (l, m)
+        if l <= k:  # the basis already spans rank(M): exact, just pad
+            return _balanced(U, C, k)
+        V = jax.random.normal(key, (m, k), P.dtype)
+        for _ in range(subspace_iters):
+            V = _ns_orth(C.T @ (C @ V), ns_iters)
+        A = U @ (C @ V)                               # (n, k)
+        return _balanced(A, V.T, k)
+
+
+def host_svd_lowrank(P, Q, k: int):
+    """EXACT rank-``k`` truncation with the small factorization on the
+    HOST (numpy/LAPACK, f64) via ``jax.pure_callback`` — the guaranteed
+    stopgap rung for backends whose on-device linalg is unreliable.
+    Bit-identical quality to the CPU svd tier; costs one host round
+    trip per call (measured cost line in DESIGN.md).  Supports leading
+    batch dims (numpy stacked linalg), so it vmaps via broadcast.
+    """
+    import numpy as np
+
+    dt = P.dtype
+    m = Q.shape[-1]
+
+    def _host(p, q):
+        p = np.asarray(p, np.float64)
+        q = np.asarray(q, np.float64)
+        Qf, Rf = np.linalg.qr(p)
+        U, s, Vt = np.linalg.svd(Rf @ q, full_matrices=False)
+        kk = min(k, s.shape[-1])
+        rs = np.sqrt(s[..., :kk])
+        A = Qf @ (U[..., :, :kk] * rs[..., None, :])
+        B = rs[..., :, None] * Vt[..., :kk, :]
+        if kk < k:
+            pad = [(0, 0)] * (A.ndim - 1)
+            A = np.pad(A, pad + [(0, k - kk)])
+            B = np.pad(B, pad[:-1] + [(0, k - kk), (0, 0)])
+        return (np.ascontiguousarray(A, dtype=dt),
+                np.ascontiguousarray(B, dtype=dt))
+
+    out = (jax.ShapeDtypeStruct(P.shape[:-1] + (k,), dt),
+           jax.ShapeDtypeStruct(Q.shape[:-2] + (k, m), dt))
+    return jax.pure_callback(_host, out, P, Q,
+                             vmap_method="broadcast_all")
 
 
 def aca_lowrank(P, Q, k: int):

@@ -56,7 +56,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import EARTH_GRAVITY, EARTH_OMEGA
-from .cross import aca_lowrank, aca_lowrank_many, svd_lowrank
+from .cross import (aca_lowrank, aca_lowrank_many, host_svd_lowrank,
+                    rsvd_lowrank, svd_lowrank)
 from .swe2d import kr_raw
 from .sphere import (
     _diff_last,
@@ -237,9 +238,24 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
             lambda A, B: svd_lowrank(A, B, rank,
                                      backend=rounding_backend))
         rnd_many = lambda ops: [tuple(vsvd(*p)) for p in ops]
+    elif rounding == "rsvd":
+        # Matmul-only near-optimal truncation (Newton-Schulz polar +
+        # two-stage randomized SVD) — the rounding that runs on TPU
+        # f32, where the exact tier's QR/eigh primitives fail
+        # (cross.rsvd_lowrank; round-5 stability tier).
+        vr = jax.vmap(lambda A, B: rsvd_lowrank(A, B, rank))
+        rnd_many = lambda ops: [tuple(vr(*p)) for p in ops]
+    elif rounding == "host_svd":
+        # Exact truncation with the small factorization on the host
+        # (LAPACK f64 via pure_callback) — the guaranteed rung for
+        # backends with unreliable on-device linalg.  Handles the
+        # 6-face batch natively (numpy stacked linalg): one round trip
+        # per operand, not per face.
+        rnd_many = lambda ops: [tuple(host_svd_lowrank(A, B, rank))
+                                for A, B in ops]
     elif rounding != "aca":
-        raise ValueError(f"rounding must be 'aca' or 'svd', "
-                         f"got {rounding!r}")
+        raise ValueError(f"rounding must be 'aca', 'svd', 'rsvd' or "
+                         f"'host_svd', got {rounding!r}")
     else:
         if batch_rounding is None:
             # Measured trade (DESIGN.md): batching the independent ACA

@@ -31,9 +31,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
-
 
 def main():
     ap = argparse.ArgumentParser()
@@ -44,8 +41,24 @@ def main():
     ap.add_argument("--kappas", default="0,1e5,3e5,1e6")
     ap.add_argument("--check", type=int, default=48,
                     help="steps between finiteness checks (48 = 4 h)")
-    ap.add_argument("--rounding", default="aca", choices=("aca", "svd"))
+    ap.add_argument("--rounding", default="aca",
+                    choices=("aca", "svd", "rsvd", "host_svd"))
+    ap.add_argument("--platform", default="cpu",
+                    help="JAX platform to pin ('cpu' is the round-2 "
+                    "methodology; 'default' leaves the process backend "
+                    "alone — use with --f32 for the round-5 on-chip "
+                    "stability check, since the tunneled TPU rejects "
+                    "an explicit 'tpu' pin)")
+    ap.add_argument("--f32", action="store_true",
+                    help="run in float32 (the TPU execution dtype) "
+                    "instead of the f64 CPU methodology")
     args = ap.parse_args()
+
+    if args.platform not in ("", "default"):
+        jax.config.update("jax_platforms", args.platform)
+    if not args.f32:
+        jax.config.update("jax_enable_x64", True)
+    wdtype = jnp.float32 if args.f32 else jnp.float64
 
     from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
     from jaxstream.geometry.cubed_sphere import build_grid
@@ -57,7 +70,7 @@ def main():
 
     n, dt = args.n, args.dt
     nsteps = int(round(args.days * 86400.0 / dt))
-    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=wdtype)
     h_ext, v_ext, b_ext = ics.williamson_tc5(grid, EARTH_GRAVITY,
                                              EARTH_OMEGA)
     h0 = np.asarray(grid.interior(h_ext))

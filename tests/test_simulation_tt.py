@@ -158,3 +158,27 @@ def test_tt_tier_validation(tmp_path):
     fam_cfg = _cfg(tmp_path, initial_condition="tc1")
     with pytest.raises(ValueError, match="model family"):
         Simulation(fam_cfg)
+
+
+def test_tt_auto_rounding_accelerator_picks_stable_tier(monkeypatch,
+                                                        caplog):
+    """tt_rounding='auto' must not silently select the known-NaN 'aca'
+    rounding for shallow water on an accelerator backend (round-4
+    ADVICE).  Round 5's fix: it selects the matmul-only 'rsvd'
+    stability tier (TPU-validated; tests/test_tt_rounding_tiers.py)."""
+    import logging
+
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "gpu")
+    # The package logger is non-propagating (own handler); let caplog's
+    # root-attached handler see it for this test.
+    monkeypatch.setattr(logging.getLogger("jaxstream"), "propagate", True)
+    with caplog.at_level(logging.INFO, logger="jaxstream"):
+        Simulation({"grid": {"n": 16},
+                    "model": {"numerics": "tt", "tt_rank": 8,
+                              "initial_condition": "tc5"},
+                    "time": {"dt": 300.0, "nsteps": 1},
+                    "parallelization": {"num_devices": 1}})
+    assert any("rounding rsvd" in r.getMessage()
+               for r in caplog.records), caplog.records
