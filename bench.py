@@ -394,8 +394,10 @@ def bench_tc5(n=384, dt=BENCH_DT, warm_steps=10, timed_steps=24000,
 
 
 def bench_galewsky(n=384, dt=60.0, nu4=1.0e14):
-    """Galewsky C384 with the fused del^4 stage pair (BASELINE.md ladder
-    config #5) — the variant line for the flagship validation case.
+    """Galewsky C384 with the split del^4 filter stepper (round 5:
+    three plain RK stage kernels + one once-per-step filter kernel,
+    1.90x the round-4 in-stage pair; BASELINE.md ladder config #5) —
+    the variant line for the flagship validation case.
 
     Runs the jet to day 6 (8 640 steps) and gates on the instability's
     physics before reporting a rate: finite fields, physical h range,
@@ -458,7 +460,7 @@ def bench_galewsky(n=384, dt=60.0, nu4=1.0e14):
     v = rate * dt / 86400.0
     log(f"bench variant galewsky-nu4: {rate:.1f} steps/s -> "
         f"{v:.4f} sim-days/sec/chip ({v / BASELINE_PER_CHIP:.4f}x "
-        "baseline; fused del^4 two-kernel stage pair, dt=60)")
+        "baseline; split del^4 filter stepper, dt=60)")
     return v
 
 

@@ -1,0 +1,227 @@
+"""MEASUREMENT HARNESS — Galewsky/nu4 step-budget bisection (round 5).
+
+Times the del^4 stage pair's components in isolation on the real chip
+to turn the round-4 trace budget (944 us/step = 3 x (kernel A ~182 +
+kernel B ~108) + ~66 us glue at C384) into per-lever floors:
+
+  * ``step``     — the production fused nu4 step (reference rate)
+  * ``stageAB``  — one A -> route -> B -> route chain (should be ~1/3)
+  * ``A+route``  — kernel A + one route (B ablated)
+  * ``B``        — kernel B alone (ghost fills + 3 laps + combine)
+  * ``B_nofill`` — B with the ghost-strip/corner fills ablated (the
+                   laps read whatever is in scratch; values are garbage
+                   but timing is sound — measures fill cost by
+                   difference)
+  * ``B_nolap``  — B with the Laplacians ablated (fills + combine only)
+  * ``route``    — the strip router alone
+
+Timing: jitted ``fori_loop`` chains where each iteration's outputs feed
+the next iteration's inputs (prevents hoisting/DCE without adding
+per-iteration overhead); two-window differencing via
+``steady_state_rate``'s methodology.  Values in the ablated variants
+are physically meaningless — this file measures WALL TIME ONLY and is
+never imported by the library.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.models.shallow_water_cov import CovariantShallowWater
+from jaxstream.ops.pallas.swe_cov import (_cov_blockspecs, _make_fill,
+                                          lap_core, make_cov_stage_nu4,
+                                          make_cov_strip_router_split,
+                                          make_fused_ssprk3_cov_nu4)
+from jaxstream.ops.pallas.swe_rhs import coord_rows
+from jaxstream.physics.initial_conditions import galewsky
+
+
+def timeit(fn, *args, iters=2000):
+    f = jax.jit(fn, static_argnums=0)
+    small, big = iters // 4, iters
+    # compile BOTH window sizes before any timing (each static k is its
+    # own executable; round-5 lesson: a compile inside the timed window
+    # poisoned the first bisect by ~15x)
+    jax.block_until_ready(jax.tree.leaves(f(small, *args))[0])
+    jax.block_until_ready(jax.tree.leaves(f(big, *args))[0])
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.tree.leaves(f(small, *args))[0])
+    t1 = time.perf_counter()
+    jax.block_until_ready(jax.tree.leaves(f(big, *args))[0])
+    t2 = time.perf_counter()
+    # two-window differencing removes dispatch overhead
+    return ((t2 - t1) - (t1 - t0)) / (big - small)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 384
+    dt, nu4 = 60.0, 1.0e14
+    halo = 2
+    grid = build_grid(n, halo=halo, radius=EARTH_RADIUS,
+                      dtype=jnp.float32)
+    m = n + 2 * halo
+    h = halo
+    h_ext, v_ext = galewsky(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                  omega=EARTH_OMEGA, backend="pallas",
+                                  nu4=nu4)
+    y0 = model.compact_state(model.initial_state(h_ext, v_ext))
+    bz = jnp.zeros((6, m, m), jnp.float32)
+
+    route = make_cov_strip_router_split(grid)
+    sa, sb = make_cov_stage_nu4(grid, EARTH_GRAVITY, EARTH_OMEGA, dt,
+                                0.0, 1.0, nu4)
+
+    # --- reference: the production step -------------------------------
+    step = make_fused_ssprk3_cov_nu4(grid, EARTH_GRAVITY, EARTH_OMEGA,
+                                     dt, bz, nu4)
+
+    def run_step(k, y):
+        def body(_, y):
+            return step(y, 0.0)
+        return jax.lax.fori_loop(0, k, body, y)
+
+    t_step = timeit(run_step, y0)
+    print(f"step       : {t_step * 1e6:8.1f} us  "
+          f"({1.0 / t_step:7.1f} steps/s)")
+
+    # --- chains -------------------------------------------------------
+    gsn0, gwe0 = route(y0["strips_sn"], y0["strips_we"])
+
+    def run_stage(k, hc, uc, gsn, gwe):
+        def body(_, c):
+            hc, uc, gsn, gwe = c
+            ha, ua, l1h, l1u, sn, we = sa(hc, uc, gsn, gwe, bz)
+            g2sn, g2we = route(sn, we)
+            ho, uo, sn2, we2 = sb(ha, ua, l1h, l1u, g2sn, g2we)
+            g3sn, g3we = route(sn2, we2)
+            return ho, uo, g3sn, g3we
+        return jax.lax.fori_loop(0, k, body, (hc, uc, gsn, gwe))
+
+    t_stage = timeit(run_stage, y0["h"], y0["u"], gsn0, gwe0)
+    print(f"stage A+r+B+r: {t_stage * 1e6:6.1f} us  (x3 = "
+          f"{3 * t_stage * 1e6:7.1f})")
+
+    def run_a(k, hc, uc, gsn, gwe):
+        def body(_, c):
+            hc, uc, gsn, gwe = c
+            ha, ua, l1h, l1u, sn, we = sa(hc, uc, gsn, gwe, bz)
+            g2sn, g2we = route(sn, we)
+            return ha, ua, g2sn, g2we
+        return jax.lax.fori_loop(0, k, body, (hc, uc, gsn, gwe))
+
+    t_a = timeit(run_a, y0["h"], y0["u"], gsn0, gwe0)
+    print(f"A + route  : {t_a * 1e6:8.1f} us")
+
+    ha, ua, l1h, l1u, sn1, we1 = sa(y0["h"], y0["u"], gsn0, gwe0, bz)
+    gsn1, gwe1 = route(sn1, we1)
+
+    def run_b(k, ha, ua, l1h, l1u):
+        def body(_, c):
+            ha, ua, l1h, l1u = c
+            ho, uo, _, _ = sb(ha, ua, l1h, l1u, gsn1, gwe1)
+            return ho, uo, ho, uo  # feed back; values diverge, timing only
+        return jax.lax.fori_loop(0, k, body, (ha, ua, l1h, l1u))
+
+    t_b = timeit(run_b, ha, ua, l1h, l1u)
+    print(f"B          : {t_b * 1e6:8.1f} us")
+
+    def run_route(k, sn, we):
+        def body(_, c):
+            sn, we = c
+            gsn, gwe = route(sn, we)
+            # fold ghosts back to strip shapes to keep the chain closed
+            return gsn[:, :6 * h], gwe[:, :, :6 * h]
+        return jax.lax.fori_loop(0, k, body, (sn, we))
+
+    t_r = timeit(run_route, sn1, we1)
+    print(f"route      : {t_r * 1e6:8.1f} us")
+
+    # --- kernel-B ablations ------------------------------------------
+    i0, i1 = halo, halo + n
+    d = float(grid.dalpha)
+    radius = float(grid.radius)
+    damp = 1.0 * dt * nu4
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
+     ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
+    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1, corners=True)
+    lap = lambda xr, xfr, yc, yfc, psi: lap_core(
+        xr, xfr, yc, yfc, psi, n=n, halo=halo, d=d, radius=radius)
+
+    def variant_b(mode):
+        def kernel(*refs):
+            (xr_ref, xfr_ref, yc_ref, yfc_ref,
+             ha_ref, ua_ref, l1h_ref, l1u_ref, gsn_ref, gwe_ref,
+             ho_ref, uo_ref, ssn_ref, swe_ref, *scratch) = refs
+            gsn = gsn_ref[0]
+            gwe = gwe_ref[0]
+            dmp = jnp.float32(damp)
+            for fi, (int_ref, lead, adv_ref, out_ref) in enumerate(
+                    ((l1h_ref, (), ha_ref, ho_ref),
+                     (l1u_ref, (0,), ua_ref, uo_ref),
+                     (l1u_ref, (1,), ua_ref, uo_ref))):
+                if mode == "nofill":
+                    scratch[fi][i0:i1, i0:i1] = int_ref[lead + (0,)]
+                    l1f = scratch[fi][:]
+                else:
+                    l1f = fill_ghosts(scratch[fi], int_ref[lead + (0,)],
+                                      gsn, gwe, fi)
+                if mode == "nolap":
+                    l2 = l1f[i0:i1, i0:i1]
+                else:
+                    l2 = lap(xr_ref[:], xfr_ref[:], yc_ref[:],
+                             yfc_ref[:], l1f)
+                int_new = adv_ref[lead + (0,)] - dmp * l2
+                out_ref[lead + (0,)] = int_new
+                emit_strips(ssn_ref, swe_ref, int_new, fi)
+
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pl.GridSpec(
+                grid=(6,),
+                in_specs=coord_specs + [hi_blk, ui_blk, hi_blk, ui_blk,
+                                        gsn_blk, gwe_blk],
+                out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
+                scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                                for _ in range(3)],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+                jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+                jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
+                jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=110 * 1024 * 1024,
+            ),
+        )
+
+    for mode in ("full", "nofill", "nolap"):
+        vb = variant_b(mode)
+
+        def run_vb(k, ha, ua, l1h, l1u, vb=vb):
+            def body(_, c):
+                ha, ua, l1h, l1u = c
+                ho, uo, _, _ = vb(x_row, xf_row, x_col, xf_col,
+                                  ha, ua, l1h, l1u, gsn1, gwe1)
+                return ho, uo, ho, uo
+            return jax.lax.fori_loop(0, k, body, (ha, ua, l1h, l1u))
+
+        t = timeit(run_vb, ha, ua, l1h, l1u)
+        print(f"B[{mode:6s}]  : {t * 1e6:8.1f} us")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""MEASUREMENT HARNESS — algorithmic variants of the rsvd rounding.
+
+Round-5 attribution: the factored TC5's rsvd trajectory error floors
+at ~2.4e-3/day in f32 (CPU and TPU alike) while the exact-svd tier at
+the SAME f32 state precision reaches 2.6e-4 — the floor lives in the
+rounding's own f32 internals, and parameter bumps (oversample, power,
+subspace iterations) do not move it.  This harness tests algorithmic
+changes on the day-1 TC5 C96 number:
+
+  * ``ref``    — library rsvd_lowrank as-is
+  * ``alt``    — Gram-free stage 2: alternating NS-orthogonalized
+                 one-sided iterations (V <- orth(C^T U2),
+                 U2 <- orth(C V)) instead of the squared-condition
+                 C^T C subspace iteration
+  * ``direct`` — no oversample, no stage 2: sketch at width k with
+                 power=3 (the subspace is chosen by power iteration
+                 alone; tests whether stage-2 extraction is the noise)
+  * ``gramf64``— stage 2 exactly as the library, but the tiny
+                 (l, m) core math done in f64 (CPU only; isolates the
+                 core-extraction precision from the big-factor path)
+
+Usage: python experiments/rsvd_variants.py [tpu|cpu] [days]
+"""
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    plat = sys.argv[1] if len(sys.argv) > 1 else "tpu"
+    days = float(sys.argv[2]) if len(sys.argv) > 2 else 1.0
+    if plat == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.physics import initial_conditions as ics
+    from jaxstream.tt import sphere_swe as ssw
+    from jaxstream.tt.cross import _balanced, _ns_orth, _SKETCH_SEED
+    from jaxstream.tt.sphere import factor_panels, unfactor_panels
+    from jaxstream.tt.sphere_swe import (covariant_from_cartesian,
+                                         make_dense_sphere_swe)
+
+    n, dt, rank = 96, 300.0, 16
+    nsteps = int(round(days * 86400.0 / dt))
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = ics.williamson_tc5(grid, EARTH_GRAVITY,
+                                             EARTH_OMEGA)
+    h0 = np.asarray(grid.interior(h_ext))
+    ua0, ub0 = covariant_from_cartesian(grid, v_ext)
+    area = np.asarray(grid.interior(grid.area), np.float64)
+
+    dstep = jax.jit(make_dense_sphere_swe(grid, dt, hs=b_ext))
+    s = (jnp.asarray(h0), jnp.asarray(ua0), jnp.asarray(ub0))
+    for _ in range(nsteps):
+        s = dstep(s)
+    ref = np.asarray(s[0], np.float64)
+
+    def rsvd_variant(P, Q, k, mode):
+        oversample, power, ns_iters, si = 8, 2, 90, 6
+        nn, R = P.shape
+        m = Q.shape[1]
+        rmax = min(nn, m, R)
+        if mode == "direct":
+            l, power = min(k, rmax), 3
+        else:
+            l = min(k + oversample, rmax)
+        with jax.default_matmul_precision("highest"):
+            key = jax.random.PRNGKey(_SKETCH_SEED)
+            Om = jax.random.normal(key, (m, l), P.dtype)
+            U = _ns_orth(P @ (Q @ Om), ns_iters)
+            for _ in range(power):
+                Z = Q.T @ (P.T @ U)
+                U = _ns_orth(P @ (Q @ Z), ns_iters)
+            C = (U.T @ P) @ Q
+            if l <= k:
+                return _balanced(U, C, k)
+            if mode == "alt":
+                U2 = _ns_orth(C @ jax.random.normal(key, (m, k), P.dtype),
+                              ns_iters)
+                for _ in range(si):
+                    V = _ns_orth(C.T @ U2, ns_iters)
+                    U2 = _ns_orth(C @ V, ns_iters)
+                V = _ns_orth(C.T @ U2, ns_iters)
+            elif mode == "gramf64":
+                C64 = C.astype(jnp.float64)
+                V = jax.random.normal(key, (m, k), jnp.float64)
+                for _ in range(si):
+                    V = _ns_orth(C64.T @ (C64 @ V), ns_iters)
+                V = V.astype(P.dtype)
+            else:
+                V = jax.random.normal(key, (m, k), P.dtype)
+                for _ in range(si):
+                    V = _ns_orth(C.T @ (C @ V), ns_iters)
+            A = U @ (C @ V)
+            return _balanced(A, V.T, k)
+
+    modes = ["ref", "alt", "direct"]
+    if plat == "cpu":
+        jax.config.update("jax_enable_x64", True)  # gramf64 needs it
+        modes.append("gramf64")
+    base = ssw.rsvd_lowrank
+    for mode in modes:
+        ssw.rsvd_lowrank = functools.partial(rsvd_variant, mode=mode)
+        try:
+            # f32 state even under x64 (factor_panels emits f64 there)
+            fac32 = lambda x: tuple(
+                f.astype(jnp.float32) for f in factor_panels(x, rank))
+            step = jax.jit(ssw.make_tt_sphere_swe(
+                grid, dt, rank=rank, hs=b_ext, rounding="rsvd"))
+            p = tuple(fac32(x) for x in (h0, ua0, ub0))
+            t0 = time.time()
+            for _ in range(nsteps):
+                p = step(p)
+            h = np.asarray(unfactor_panels(p[0]), np.float64)
+            fin = bool(np.isfinite(h).all())
+            rec = {"mode": mode, "finite": fin,
+                   "wall_s": round(time.time() - t0, 1)}
+            if fin:
+                d = h - ref
+                rec["h_l2_vs_dense"] = float(np.sqrt(
+                    np.sum(area * d**2) / np.sum(area * ref**2)))
+            print(json.dumps(rec), flush=True)
+        finally:
+            ssw.rsvd_lowrank = base
+
+
+if __name__ == "__main__":
+    main()

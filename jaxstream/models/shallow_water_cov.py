@@ -167,15 +167,20 @@ class CovariantShallowWater(SWEBase):
     def make_fused_step(self, dt: float, compact: bool = True,
                         carry_dtype=None, h_offset: float = 0.0,
                         h_scale: float = 1.0, u_scale: float = 1.0,
-                        _ablate_seam: bool = False):
+                        _ablate_seam: bool = False,
+                        nu4_mode: str = "split"):
         """Fused SSPRK3: one Pallas kernel per stage (halo fill in-kernel,
         edge rotations/symmetrization on a packed strip carry,
         :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
         production path) carries interior-only fields — initialise with
         :meth:`compact_state`; ``compact=False`` keeps the extended-state
         carry from :meth:`extend_state` ``(with_strips=True)``.
-        ``nu4 > 0`` (the Galewsky filter) uses the two-kernel del^4
-        stage pair, compact carry only.  Requires ``backend='pallas'``.
+        ``nu4 > 0`` (the Galewsky filter) uses the split once-per-step
+        del^4 filter kernel (``nu4_mode='split'``, round 5 — 1.9x the
+        in-stage pair, same day-6 physics) or the in-stage two-kernel
+        pair (``nu4_mode='stage'``, the round-4 path, kept as the
+        parity oracle); compact carry only.  Requires
+        ``backend='pallas'``.
 
         ``carry_dtype`` (compact only): HBM storage dtype of the h/u
         carry — cast the :meth:`compact_state` output to match.  bf16
@@ -184,6 +189,9 @@ class CovariantShallowWater(SWEBase):
         perf measurement only (breaks conservation)."""
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
+        if nu4_mode not in ("split", "stage"):
+            raise ValueError(f"nu4_mode must be 'split' or 'stage', "
+                             f"got {nu4_mode!r}")
         interpret = self.backend == "pallas_interpret"
         if self.nu4 != 0.0:
             if not compact:
@@ -192,10 +200,13 @@ class CovariantShallowWater(SWEBase):
                     or u_scale != 1.0 or _ablate_seam):
                 raise ValueError("carry_dtype/h_offset/u_scale/"
                                  "_ablate_seam are not supported on the "
-                                 "nu4 stage pair")
-            from ..ops.pallas.swe_cov import make_fused_ssprk3_cov_nu4
+                                 "nu4 paths")
+            from ..ops.pallas.swe_cov import (
+                make_fused_ssprk3_cov_nu4, make_fused_ssprk3_cov_split_nu4)
 
-            return make_fused_ssprk3_cov_nu4(
+            mk = (make_fused_ssprk3_cov_split_nu4 if nu4_mode == "split"
+                  else make_fused_ssprk3_cov_nu4)
+            return mk(
                 self.grid, self.gravity, self.omega, dt, self.b_ext,
                 self.nu4, scheme=self.scheme, limiter=self.limiter,
                 interpret=interpret,

@@ -56,6 +56,8 @@ __all__ = [
     "lap_core",
     "make_cov_stage_nu4",
     "make_fused_ssprk3_cov_nu4",
+    "make_cov_nu4_filter",
+    "make_fused_ssprk3_cov_split_nu4",
 ]
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
@@ -1694,14 +1696,30 @@ def make_fused_ssprk3_cov_compact(
 # ---------------------------------------------------------------------------
 
 
-def lap_core(xr, xfr, yc, yfc, psi, *, n, halo, d, radius):
-    """Laplace-Beltrami of one ghost-filled (M, M) face -> (n, n).
+def lap_core(xr, xfr, yc, yfc, psi, *, n, halo, d, radius, ring=0):
+    """Laplace-Beltrami of one ghost-filled (M, M) face.
 
     The kernel-math twin of :func:`jaxstream.ops.fv.laplacian` (same
     conservative flux form and stencils, cross-shaped and corner-free),
     with face metrics from the sqrtg-folded closed forms.
+
+    ``ring``: how many ghost rings to INCLUDE in the output — 0 gives
+    the interior ``(n, n)``; ``ring=g`` gives ``(n+2g, n+2g)``,
+    evaluating the operator on the innermost ``g`` ghost rings too
+    (their stencils read ghosts to depth ``g+1``, so ``g <= halo - 1``;
+    the cross-derivative faces additionally read the corner-filled
+    ghost corners).  The split-nu4 filter uses ``ring=1`` so the
+    second Laplacian can consume the first one's ring without a
+    mid-filter exchange — the ring values are face-local evaluations
+    at the neighbor's physical points, consistent to the stencil's
+    own O(d^2) (the same class of seam approximation as the ghost
+    resampling itself).
     """
-    h0, h1 = halo, halo + n
+    if not 0 <= ring <= halo - 1:
+        raise ValueError(f"lap_core: ring={ring} needs 0 <= ring <= "
+                         f"halo-1 (halo={halo}; the ring stencil reads "
+                         "ghosts to depth ring+1)")
+    h0, h1 = halo - ring, halo + n + ring
     invd = jnp.float32(1.0 / d)
     inv2d = jnp.float32(0.5 / d)
 
@@ -1898,6 +1916,178 @@ def make_cov_stage_nu4(
                             h_adv, u_adv, l1h, l1u, gsn, gwe))
 
     return stage_a, stage_b
+
+
+def make_cov_nu4_filter(
+    grid,
+    nu4: float,
+    dt_eff: float,
+    interpret: bool = False,
+):
+    """Once-per-step del^4 filter as ONE kernel (round 5).
+
+    ``filter(h, u, gsn, gwe) -> (h', u', sn, we)`` applying
+    ``q -= dt_eff nu4 lap(lap q)`` to the three prognostics.  The
+    in-stage pair (:func:`make_cov_stage_nu4`) refills the first
+    Laplacian's ghosts from the neighbor panel between the two
+    Laplacians; here the first Laplacian is instead evaluated on the
+    extended ring (``lap_core(ring=1)``, legal at halo >= 2 with the
+    in-kernel corner fill) so the second one needs no exchange.  The
+    ring values are face-local evaluations at the neighbor's physical
+    points — an O(d^2) seam approximation on a damp-scaled (~1e-3
+    relative) term; the Galewsky day-6 physics gate (vorticity band,
+    quiescent hemisphere, mass) is the acceptance test
+    (bench_galewsky), plus interpret-mode split-vs-stage parity in
+    tests/test_cov_swe.py::test_cov_split_nu4_matches_stage.
+
+    Splitting the filter out of the RK stages (standard dycore
+    practice: hyperdiffusion applied once per step, first-order in
+    time like any split filter) removes 12 of the in-stage path's 18
+    Laplacian evaluations and 3 of its 6 routes — measured budget in
+    DESIGN.md "Galewsky/nu4 step budget".
+    """
+    n, halo = grid.n, grid.halo
+    if halo < 2:
+        raise ValueError(f"split nu4 filter needs halo >= 2 (ring-1 "
+                         f"first Laplacian), got halo={halo}")
+    m = n + 2 * halo
+    i0, i1 = halo, halo + n
+    d = float(grid.dalpha)
+    radius = float(grid.radius)
+    h = halo
+    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1, corners=True)
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
+     ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
+
+    def kernel(*refs):
+        (xr_ref, xfr_ref, yc_ref, yfc_ref,
+         hc_ref, uc_ref, gsn_ref, gwe_ref,
+         ho_ref, uo_ref, ssn_ref, swe_ref, *scratch) = refs
+
+        gsn = gsn_ref[0]
+        gwe = gwe_ref[0]
+        damp = jnp.float32(dt_eff * nu4)
+        # Coordinate windows for the second (halo-1-indexed) Laplacian:
+        # l1 lives on (n+2)^2 whose [1:n+1] maps to the interior.
+        xr2 = xr_ref[:][:, h - 1:m - h + 1]
+        xfr2 = xfr_ref[:][:, h - 1:m - h + 2]
+        yc2 = yc_ref[:][h - 1:m - h + 1, :]
+        yfc2 = yfc_ref[:][h - 1:m - h + 2, :]
+        for fi, (int_ref, lead, out_ref) in enumerate(
+                ((hc_ref, (), ho_ref),
+                 (uc_ref, (0,), uo_ref),
+                 (uc_ref, (1,), uo_ref))):
+            psi = fill_ghosts(scratch[fi], int_ref[lead + (0,)],
+                              gsn, gwe, fi)
+            l1 = lap_core(xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+                          psi, n=n, halo=halo, d=d, radius=radius,
+                          ring=1)                       # (n+2, n+2)
+            l2 = lap_core(xr2, xfr2, yc2, yfc2, l1,
+                          n=n, halo=1, d=d, radius=radius)
+            int_new = int_ref[lead + (0,)] - damp * l2
+            out_ref[lead + (0,)] = int_new
+            emit_strips(ssn_ref, swe_ref, int_new, fi)
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pl.GridSpec(
+            grid=(6,),
+            in_specs=coord_specs + [hi_blk, ui_blk, gsn_blk, gwe_blk],
+            out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
+            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                            for _ in range(3)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    def filt(hc, uc, gsn, gwe):
+        return tuple(call(x_row, xf_row, x_col, xf_col,
+                          hc, uc, gsn, gwe))
+
+    return filt
+
+
+def make_fused_ssprk3_cov_split_nu4(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    b_ext,
+    nu4: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+    interval: int = 1,
+):
+    """``step(y, t) -> y``: three PLAIN compact RK stages + one del^4
+    filter kernel per step (4 kernels + 4 routes, vs the in-stage
+    pair's 6 + 6 with twice the Laplacian count).
+
+    The split form is first-order in time in the filter term — the
+    standard operator-split treatment of hyperdiffusion in dynamical
+    cores — so trajectories differ from the in-stage path at the
+    damp-scale; the Galewsky day-6 physics gate is the equivalence
+    standard (see :func:`make_cov_nu4_filter`).  Carry/router identical
+    to :func:`make_fused_ssprk3_cov_compact`; the stage kernels ARE
+    that stepper's (un-prescaled router shared with the filter).
+
+    ``interval``: apply the filter every ``interval``-th step with an
+    ``interval x`` coefficient (filter-cycling, the same split-filter
+    logic one level up).  The explicit del^4 stability bound is miles
+    away (nu4 dt interval / dx^4 ~ 0.03 at C384/interval=2), so the
+    arbiter is the physics gate, not stability.  Step counting derives
+    from ``t/dt`` (exact in f32 for the < 2^24 steps any run takes).
+    """
+    from .swe_step import SSPRK3_COEFFS
+
+    route = make_cov_strip_router_split(grid)
+    mk = lambda a, b: make_cov_stage_compact(
+        grid.n, grid.halo, float(grid.dalpha), float(grid.radius),
+        gravity, omega, dt, a, b, scheme=scheme, limiter=limiter,
+        interpret=interpret, seam=True, sym_prescaled=False,
+    )
+    (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+    stage1 = mk(a1, b1)
+    stage2 = mk(a2, b2)
+    stage3 = mk(a3, b3)
+    filt = make_cov_nu4_filter(grid, nu4, dt * interval,
+                               interpret=interpret)
+
+    def step(y, t):
+        h0, u0 = y["h"], y["u"]
+        gsn, gwe = route(y["strips_sn"], y["strips_we"])
+        h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
+        gsn, gwe = route(sn1, we1)
+        h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
+        gsn, gwe = route(sn2, we2)
+        h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
+        if interval == 1:
+            gsn, gwe = route(sn3, we3)
+            hf, uf, snf, wef = filt(h3, u3, gsn, gwe)
+        else:
+            k = jnp.round(t / jnp.float32(dt)).astype(jnp.int32)
+
+            def do_filter(args):
+                h3, u3, sn3, we3 = args
+                gsn, gwe = route(sn3, we3)
+                return filt(h3, u3, gsn, gwe)
+
+            hf, uf, snf, wef = jax.lax.cond(
+                k % interval == interval - 1,
+                do_filter, lambda args: args, (h3, u3, sn3, we3))
+        return {"h": hf, "u": uf, "strips_sn": snf, "strips_we": wef}
+
+    return step
 
 
 def make_fused_ssprk3_cov_nu4(

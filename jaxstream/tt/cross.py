@@ -170,7 +170,8 @@ def _balanced(A, B, k: int):
 
 
 def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
-                 subspace_iters: int = 6, ns_iters: int = 90):
+                 subspace_iters: int = 6, ns_iters: int = 90,
+                 compute_dtype=None):
     """Near-optimal rank-``k`` truncation of ``M = P @ Q`` using ONLY
     matrix multiplies — the TPU-viable stability tier (round 5).
 
@@ -196,6 +197,10 @@ def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
     (fixed sketch key) and jit/vmap-safe.  Factors balanced
     ``sqrt(sigma)`` per side, zero-padded to exactly ``k``.
     """
+    out_dtype = P.dtype
+    if compute_dtype is not None:
+        P = P.astype(compute_dtype)
+        Q = Q.astype(compute_dtype)
     n, R = P.shape
     m = Q.shape[1]
     rmax = min(n, m, R)
@@ -209,12 +214,14 @@ def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
             U = _ns_orth(P @ (Q @ Z), ns_iters)
         C = (U.T @ P) @ Q                             # (l, m)
         if l <= k:  # the basis already spans rank(M): exact, just pad
-            return _balanced(U, C, k)
+            A, B = _balanced(U, C, k)
+            return A.astype(out_dtype), B.astype(out_dtype)
         V = jax.random.normal(key, (m, k), P.dtype)
         for _ in range(subspace_iters):
             V = _ns_orth(C.T @ (C @ V), ns_iters)
         A = U @ (C @ V)                               # (n, k)
-        return _balanced(A, V.T, k)
+        A, B = _balanced(A, V.T, k)
+        return A.astype(out_dtype), B.astype(out_dtype)
 
 
 def host_svd_lowrank(P, Q, k: int):
