@@ -12,11 +12,16 @@ Usage::
     python scripts/tt_probe.py qtt   [N ...]      # QTT diffusion vs dense
     python scripts/tt_probe.py tpu   [n ...]      # factored SWE on the
                                                   # default (device) backend
+    python scripts/tt_probe.py sharded [n ...]    # 6-virtual-device factored
+                                                  # rate vs single-device +
+                                                  # HLO permute-payload bytes
+                                                  # vs the dense explicit tier
 
-``sphere``/``qtt`` force CPU f64 (the recorded tables); ``tpu`` keeps
-the default backend and f32 (the v5e numbers).
+``sphere``/``qtt``/``sharded`` force CPU f64 (the recorded tables);
+``tpu`` keeps the default backend and f32 (the v5e numbers).
 """
 
+import os
 import sys
 import time
 
@@ -25,9 +30,18 @@ import numpy as np
 import jax
 
 _MODE = sys.argv[1] if len(sys.argv) > 1 else "sphere"
-if _MODE in ("sphere", "qtt"):
+if _MODE in ("sphere", "qtt", "sharded"):
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+if _MODE == "sharded":
+    # Virtual devices: effective because the backend is not yet
+    # initialized at this point (the reference's setup_sharding set
+    # this AFTER first device contact — the ordering bug SURVEY.md §7
+    # documents; conftest.py fixes it the same way for tests).
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax.numpy as jnp
 
@@ -87,6 +101,103 @@ def sphere(sizes, dtype, rank=12):
         tq = _median_rate(tt, p, iters)
         print(f"C{n} rank{rank}: dense {td * 1e3:8.2f} ms/step   "
               f"tt {tq * 1e3:8.2f} ms/step   speedup {td / tq:.2f}x",
+              flush=True)
+
+
+def _permute_payload_elements(hlo_text):
+    """Sum the output-shape ELEMENT counts of every collective-permute
+    in an HLO dump — the per-call inter-device payload of one compiled
+    step, dtype-neutral.  Returns ``(elements, count, dtypes_seen)``;
+    the dtype set is printed so a mixed-dtype payload can never
+    silently skew a recorded ratio."""
+    import re
+
+    total = 0
+    count = 0
+    dtypes = set()
+    for m in re.finditer(r"= ([a-z0-9]+)\[([0-9,]*)\][^ ]* collective-permute",
+                         hlo_text):
+        dtypes.add(m.group(1))
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n
+        count += 1
+    return total, count, dtypes
+
+
+def sharded(sizes, rank=12):
+    """Round-5 VERDICT ask #6: sharded-TT rate + communication-volume
+    evidence on virtual devices, replacing the prose O(n) claim in
+    tt/shard.py with numbers (recorded in DESIGN.md)."""
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
+    from jaxstream.parallel.mesh import setup_sharding, shard_state
+    from jaxstream.parallel.sharded_model import make_stepper_for
+    from jaxstream.physics import initial_conditions as ics
+    from jaxstream.tt.shard import (make_tt_sphere_swe_sharded,
+                                    panel_mesh, shard_factored_state)
+    from jaxstream.tt.sphere import factor_panels
+    from jaxstream.tt.sphere_swe import (covariant_from_cartesian,
+                                         make_tt_sphere_swe)
+
+    devs = jax.devices("cpu")
+    if len(devs) < 6:
+        sys.exit("needs >= 6 virtual CPU devices (XLA_FLAGS was set "
+                 "too late — another jax client initialized first)")
+    mesh = panel_mesh(devs)
+    for n in sizes:
+        grid = build_grid(n, halo=2, radius=EARTH_RADIUS,
+                          dtype=jnp.float64)
+        h_ext, v_ext = ics.williamson_tc2(grid, EARTH_GRAVITY,
+                                          EARTH_OMEGA)
+        h0 = np.asarray(grid.interior(h_ext), np.float64)
+        ua0, ub0 = covariant_from_cartesian(grid, v_ext)
+        dt = 30.0 * 256 / n
+        p = tuple(factor_panels(x, rank) for x in (h0, ua0, ub0))
+
+        single = jax.jit(make_tt_sphere_swe(grid, dt, rank=rank))
+        shard = jax.jit(make_tt_sphere_swe_sharded(grid, dt, rank, mesh))
+        ps = shard_factored_state(p, mesh)
+
+        # AOT-compile once; the timed callable IS this executable (a
+        # separate jit dispatch would compile the same graph twice).
+        shard_exe = shard.lower(ps).compile()
+        tt_el, tt_n, tt_dt = _permute_payload_elements(
+            shard_exe.as_text())
+
+        iters = max(4, 1024 // n)
+        t1 = _median_rate(single, p, iters)
+        t6 = _median_rate(shard_exe, ps, iters)
+
+        # Dense explicit-ppermute comparator (one face per device, the
+        # same 4-stage schedule), same n / ssprk3.  Its Pallas RHS is
+        # f32-pinned, so it runs on an f32 grid; the volume comparison
+        # is in ELEMENTS (bytes / dtype size) to stay dtype-neutral.
+        grid32 = build_grid(n, halo=2, radius=EARTH_RADIUS,
+                            dtype=jnp.float32)
+        h32, v32 = ics.williamson_tc2(grid32, EARTH_GRAVITY,
+                                      EARTH_OMEGA)
+        model = CovariantShallowWater(grid32, gravity=EARTH_GRAVITY,
+                                      omega=EARTH_OMEGA)
+        s0 = model.initial_state(h32, v32)
+        setup = setup_sharding({
+            "parallelization": {"num_devices": 6, "device_type": "cpu",
+                                "use_shard_map": True}})
+        ss = shard_state(setup, s0)
+        dstep = make_stepper_for(model, setup, ss, dt)
+        d_el, d_n, d_dt = _permute_payload_elements(
+            dstep.lower(ss, jnp.float32(0.0)).compile().as_text())
+
+        print(f"C{n} rank{rank}: single {t1 * 1e3:8.2f} ms/step   "
+              f"6-dev {t6 * 1e3:8.2f} ms/step   ratio {t1 / t6:.2f}x",
+              flush=True)
+        print(f"C{n} permute payload/step: factored {tt_el} elements "
+              f"({tt_n} permutes, {sorted(tt_dt)})   dense explicit "
+              f"{d_el} elements ({d_n}, {sorted(d_dt)})   "
+              f"factored/dense = {tt_el / max(d_el, 1):.4f}",
               flush=True)
 
 
@@ -171,8 +282,11 @@ def main():
         qtt(args or [256, 1024, 4096, 16384, 65536])
     elif _MODE == "tpu":
         sphere(args or [256, 512], jnp.float32)
+    elif _MODE == "sharded":
+        sharded(args or [48, 96])
     else:
-        sys.exit(f"unknown mode {_MODE!r}; use sphere | qtt | tpu")
+        sys.exit(f"unknown mode {_MODE!r}; use sphere | qtt | tpu | "
+                 "sharded")
 
 
 if __name__ == "__main__":
