@@ -16,9 +16,12 @@ Usage::
                                                   # rate vs single-device +
                                                   # HLO permute-payload bytes
                                                   # vs the dense explicit tier
+    python scripts/tt_probe.py qttswe [N ...]     # QTT 2-D SWE vs dense twin
+                                                  # (the deck's LANL-124x
+                                                  # system in order-d form)
 
-``sphere``/``qtt``/``sharded`` force CPU f64 (the recorded tables);
-``tpu`` keeps the default backend and f32 (the v5e numbers).
+``sphere``/``qtt``/``qttswe``/``sharded`` force CPU f64 (the recorded
+tables); ``tpu`` keeps the default backend and f32 (the v5e numbers).
 """
 
 import os
@@ -30,7 +33,7 @@ import numpy as np
 import jax
 
 _MODE = sys.argv[1] if len(sys.argv) > 1 else "sphere"
-if _MODE in ("sphere", "qtt", "sharded"):
+if _MODE in ("sphere", "qtt", "qttswe", "sharded"):
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 if _MODE == "sharded":
@@ -201,6 +204,51 @@ def sharded(sizes, rank=12):
               flush=True)
 
 
+def qttswe(sizes, rank=12):
+    """Round-5 VERDICT ask #3: the QTT rung table for the 2-D SWE —
+    the very system LANL measured 124x on (deck p.3) — with the
+    crossover against a dense jnp twin of the same centered scheme.
+    The QTT step cost is N-independent (O(d) factorizations at the
+    stage bond); the dense step is O(N^2)."""
+    from jaxstream.tt.qtt import (make_dense_swe_twin,
+                                  make_qtt_swe_stepper,
+                                  qtt_compress_separable)
+
+    g, H, f = 9.80616, 1000.0, 1.0e-4
+    for N in sizes:
+        x = np.arange(N) / N
+        dx = 1.0e7 / N                       # 10,000 km domain
+        dt = 0.2 * dx / np.sqrt(g * H)
+        nu = 1e-4 * dx * dx / dt             # mild grid-scaled filter
+        # Separable smooth IC (h anomaly; geostrophic-ish jet + bump)
+        rows = np.stack([np.sin(2 * np.pi * x), np.cos(2 * np.pi * x)])
+        cols = np.stack([np.cos(4 * np.pi * x), np.ones(N)])
+        y0 = tuple(
+            [jnp.asarray(np.asarray(c, np.float64)) for c in cores]
+            for cores in (
+                qtt_compress_separable(30.0 * rows, cols, rank),
+                qtt_compress_separable(np.stack([5.0 * np.cos(
+                    2 * np.pi * x)]), np.stack([np.ones(N)]), rank),
+                qtt_compress_separable(np.stack([np.zeros(N)]),
+                                       np.stack([np.zeros(N)]), rank),
+            ))
+        step = jax.jit(make_qtt_swe_stepper(N, g, H, dx, dt, rank,
+                                            f=f, nu=nu))
+        tq = _median_rate(step, y0, 4)
+
+        X, Y = np.meshgrid(x, x, indexing="xy")
+        h0 = 30.0 * np.sin(2 * np.pi * X) * np.cos(4 * np.pi * Y)
+        s0 = tuple(jnp.asarray(q) for q in (
+            h0, 5.0 * np.cos(2 * np.pi * Y), np.zeros_like(h0)))
+
+        dstep = jax.jit(make_dense_swe_twin(N, g, H, dx, dt, f=f,
+                                            nu=nu))
+        td = _median_rate(dstep, s0, max(2, 512 // N))
+        print(f"N={N:6d} rank{rank}: dense {td * 1e3:9.2f} ms/step   "
+              f"qtt-swe {tq * 1e3:9.2f} ms/step   "
+              f"speedup {td / tq:.2f}x", flush=True)
+
+
 def qtt(sizes, rank=12):
     from jaxstream.tt.qtt import (
         make_qtt_diffusion_stepper,
@@ -284,9 +332,11 @@ def main():
         sphere(args or [256, 512], jnp.float32)
     elif _MODE == "sharded":
         sharded(args or [48, 96])
+    elif _MODE == "qttswe":
+        qttswe(args or [256, 1024, 4096])
     else:
         sys.exit(f"unknown mode {_MODE!r}; use sphere | qtt | tpu | "
-                 "sharded")
+                 "sharded | qttswe")
 
 
 if __name__ == "__main__":
