@@ -406,6 +406,41 @@ def bench_tc5(n=384, dt=BENCH_DT, warm_steps=10, timed_steps=24000,
         except Exception as e:
             log(f"bench variant dt90 unavailable "
                 f"({type(e).__name__}: {e})")
+        # Combined variant (round 5): the two trades above are
+        # orthogonal — mixed16 trades u-ulp accuracy for rate, dt=90
+        # trades stability margin for sim-days/step — so their product
+        # is a legitimate gated configuration.  Requires BOTH parents'
+        # gates green this run, plus its own 15-day integration gate
+        # at the default mass band.
+        if "mixed16_carry" in variants and "dt90_max_stable" in variants:
+            try:
+                # st0/off/cd/hs are the mixed16 parent's own values —
+                # the guard above proves that block completed, so the
+                # combined gate tests EXACTLY the reported encoding.
+                s9016 = model.make_fused_step(90.0, carry_dtype=cd,
+                                              h_offset=off, h_scale=hs)
+                y9016 = model.encode_carry(model.compact_state(st0), cd,
+                                           off, hs)
+                run9016 = jax.jit(
+                    lambda y, k: integrate(s9016, y, 0.0, k, 90.0)[0],
+                    donate_argnums=0)
+                out9016 = run9016(y9016, 14400)          # 15 days
+                h9016 = model.decode_carry(out9016, h_offset=off,
+                                           h_scale=hs)["h"]
+                if tc5_gate(h9016, "15d at dt=90 + mixed16"):
+                    # rate: the mixed16 steps/s (dt-independent).
+                    v = (variants["mixed16_carry"] / dt) * 90.0
+                    variants["mixed16_dt90"] = round(v, 4)
+                    log(f"bench variant mixed16+dt90: {v:.4f} "
+                        f"sim-days/sec/chip "
+                        f"({v / BASELINE_PER_CHIP:.4f}x baseline; both "
+                        "parent trades documented, own 15-day gate)")
+                else:
+                    log("bench variant mixed16+dt90: gate FAILED — "
+                        "not reported")
+            except Exception as e:
+                log(f"bench variant mixed16+dt90 unavailable "
+                    f"({type(e).__name__}: {e})")
     return sim_days_per_sec, variants
 
 

@@ -61,18 +61,17 @@ def main():
     tols = (1e-4, 1e-6, 1e-8)
     ranks = (2, 3, 4, 6, 8, 10, 12, 16, 20, 24, 32)
     for name, field in metric_fields().items():
-        need = {t: None for t in tols}
         worst = {t: 0 for t in tols}
         for face in range(6):
             q = field[face]
             nrm = np.linalg.norm(q)
+            # One compression sweep per rank; derive every tolerance's
+            # minimum rank from the same error curve.
+            errs = {r: np.linalg.norm(np.asarray(
+                qtt_decompress(qtt_compress(q, r))) - q) / nrm
+                for r in ranks}
             for t in tols:
-                got = None
-                for r in ranks:
-                    rec = np.asarray(qtt_decompress(qtt_compress(q, r)))
-                    if np.linalg.norm(rec - q) <= t * nrm:
-                        got = r
-                        break
+                got = next((r for r in ranks if errs[r] <= t), None)
                 worst[t] = max(worst[t], got if got is not None
                                else 10 ** 9)
         print(json.dumps({"field": name, "n": n, **{

@@ -708,16 +708,10 @@ def make_qtt_swe_stepper(N: int, gravity: float, depth: float,
     crossover live in scripts/tt_probe.py ``qttswe`` mode + DESIGN.md.
     """
     dtype = jnp.zeros(()).dtype
-
-    def mk_d(axis):
-        op = ttm_add(ttm_scale(shift_ttm(N, axis, -1, base), 0.5),
-                     ttm_scale(shift_ttm(N, axis, +1, base), -0.5))
-        op = ttm_compress_np(op)
-        return [jnp.asarray(c / dx if j == 0 else c, dtype)
-                for j, c in enumerate(op)]
-
+    cast = lambda op: [jnp.asarray(c, dtype) for c in op]
     # Layout is [y, x] (interleaved digits): axis 0 = y, axis 1 = x.
-    Dy, Dx = mk_d(0), mk_d(1)
+    Dy = cast(centered_diff_ttm(N, 0, dx, base))
+    Dx = cast(centered_diff_ttm(N, 1, dx, base))
     L = None
     if nu:
         L = [jnp.asarray(c, dtype)
@@ -776,6 +770,21 @@ def make_qtt_swe_stepper(N: int, gravity: float, depth: float,
     return step
 
 
+def centered_diff_ttm(N: int, axis: int, dx: float,
+                      base: int = 4) -> List[np.ndarray]:
+    """The periodic centered first-derivative TT-matrix along one axis
+    (``(q[i+1]-q[i-1])/(2 dx)``), compressed to its true numerical bond
+    at build time — the single stencil-to-TTM recipe shared by the
+    Burgers and SWE steppers (one place to fix, both stay in step).
+    Returns numpy f64 cores (the eager build convention; cast at the
+    jit boundary)."""
+    op = ttm_add(ttm_scale(shift_ttm(N, axis, -1, base), 0.5),
+                 ttm_scale(shift_ttm(N, axis, +1, base), -0.5))
+    op = ttm_compress_np(op)
+    return [np.asarray(c / dx if j == 0 else c, np.float64)
+            for j, c in enumerate(op)]
+
+
 def make_dense_swe_twin(N: int, gravity: float, depth: float,
                         dx: float, dt: float, f: float = 0.0,
                         nu: float = 0.0) -> Callable:
@@ -827,15 +836,12 @@ def make_qtt_burgers_stepper(N: int, nu: float, dx: float, dt: float,
     the SWE's quadratic terms with Khatri-Rao + ACA.
     """
     dtype = jnp.zeros(()).dtype
-    Dc = ttm_add(*[op for axis in (0, 1) for op in
-                   (ttm_scale(shift_ttm(N, axis, -1, base), 0.5),
-                    ttm_scale(shift_ttm(N, axis, +1, base), -0.5))])
-    # Compress the raw bond-8 sum to its true numerical bond ranks at
-    # build time (verified-or-identity) — every step's Hadamard and
-    # rounding cost scales with this bond.
-    Dc = ttm_compress_np(Dc)
-    Dc = [jnp.asarray(c / dx, dtype) if j == 0 else jnp.asarray(c, dtype)
-          for j, c in enumerate(Dc)]
+    # The combined (d/dx + d/dy) operator from the shared per-axis
+    # recipe, re-compressed to the true numerical bond of the sum —
+    # every step's Hadamard and rounding cost scales with this bond.
+    Dc = ttm_compress_np(ttm_add(centered_diff_ttm(N, 0, dx, base),
+                                 centered_diff_ttm(N, 1, dx, base)))
+    Dc = [jnp.asarray(c, dtype) for c in Dc]
     L = [jnp.asarray(c, dtype)
          for c in ttm_scale(laplacian_ttm(N, base), nu / (dx * dx))]
 

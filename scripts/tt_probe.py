@@ -220,15 +220,18 @@ def qttswe(sizes, rank=12):
         dx = 1.0e7 / N                       # 10,000 km domain
         dt = 0.2 * dx / np.sqrt(g * H)
         nu = 1e-4 * dx * dx / dt             # mild grid-scaled filter
-        # Separable smooth IC (h anomaly; geostrophic-ish jet + bump)
-        rows = np.stack([np.sin(2 * np.pi * x), np.cos(2 * np.pi * x)])
-        cols = np.stack([np.cos(4 * np.pi * x), np.ones(N)])
+        # Separable smooth IC, IDENTICAL for both sides.  Layout is
+        # [y, x]: qtt_compress_separable's rows act on y, cols on x —
+        # h = 30 sin(2 pi y) cos(4 pi x), u = 5 cos(2 pi y), v = 0.
         y0 = tuple(
             [jnp.asarray(np.asarray(c, np.float64)) for c in cores]
             for cores in (
-                qtt_compress_separable(30.0 * rows, cols, rank),
-                qtt_compress_separable(np.stack([5.0 * np.cos(
-                    2 * np.pi * x)]), np.stack([np.ones(N)]), rank),
+                qtt_compress_separable(
+                    np.stack([30.0 * np.sin(2 * np.pi * x)]),
+                    np.stack([np.cos(4 * np.pi * x)]), rank),
+                qtt_compress_separable(
+                    np.stack([5.0 * np.cos(2 * np.pi * x)]),
+                    np.stack([np.ones(N)]), rank),
                 qtt_compress_separable(np.stack([np.zeros(N)]),
                                        np.stack([np.zeros(N)]), rank),
             ))
@@ -237,7 +240,7 @@ def qttswe(sizes, rank=12):
         tq = _median_rate(step, y0, 4)
 
         X, Y = np.meshgrid(x, x, indexing="xy")
-        h0 = 30.0 * np.sin(2 * np.pi * X) * np.cos(4 * np.pi * Y)
+        h0 = 30.0 * np.sin(2 * np.pi * Y) * np.cos(4 * np.pi * X)
         s0 = tuple(jnp.asarray(q) for q in (
             h0, 5.0 * np.cos(2 * np.pi * Y), np.zeros_like(h0)))
 
