@@ -512,8 +512,13 @@ class Simulation:
 
                 fn = jax.jit(fn)
             else:
+                # unroll=1: the generic tiers' steps are ms-scale (TT
+                # roundings, classic jnp), where the while-carry's
+                # ~us-scale copies are invisible but a 4x-traced step
+                # graph would multiply compile time.
                 fn = jax.jit(
-                    lambda y, t: integrate(self._step, y, t, k, dt)
+                    lambda y, t: integrate(self._step, y, t, k, dt,
+                                           unroll=1)
                 )
             self._segment_cache[k] = fn
         self.state, t = fn(self.state, self.t)

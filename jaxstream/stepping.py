@@ -66,7 +66,7 @@ def make_stepper(rhs: Callable, dt: float, scheme: str = "ssprk3") -> Callable:
 
 
 def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,
-              unroll: int = 2):
+              unroll: int = 4):
     """Run ``nsteps`` under one compiled ``lax.fori_loop``.
 
     Returns ``(y_final, t_final)``.  The carry keeps time as a traced
@@ -74,40 +74,39 @@ def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,
     lowers to a ``while`` — ``lax.fori_loop(unroll=...)`` requires
     static bounds and cannot apply here).
 
-    ``unroll=2`` (default) runs two steps per while iteration with a
-    guarded remainder step: numerically identical (same ops, same
-    order), but halves the per-iteration while-carry copies XLA cannot
-    alias away — measured +2.0% on the C384 TC5 fused stepper
-    (3 313.9 -> 3 378.8 steps/s, the 5-10 us/step glue named by the
-    round-2 trace; DESIGN.md round-5 addendum).  ``unroll=1`` keeps
-    the plain loop.
+    ``unroll`` runs that many steps per while iteration, with the
+    ``nsteps % unroll`` remainder in a second (at most unroll-1
+    iteration) plain loop: numerically identical to ``unroll=1`` —
+    same ops in the same order, sequential time adds — but the
+    per-iteration while-carry copies XLA cannot alias away are paid
+    1/unroll as often.  Measured on the C384 TC5 fused stepper
+    (single-session ladder, round 5): 3 336 (u=2) -> 3 386 (u=4) ->
+    3 405 (u=8) steps/s; +2.0% at u=2 over the plain loop was the
+    first measurement (DESIGN.md round-5 addendum).  Default 4: u=8's
+    last +0.6% doubles the traced body again, which matters for large
+    step graphs (the TT tier rides this function too).
     """
+    if unroll < 1:
+        raise ValueError(f"integrate: unroll must be >= 1, got {unroll}")
 
     def body(_, carry):
         y, t = carry
         return step(y, t), t + dt
+
+    def body_u(_, carry):
+        y, t = carry
+        for _ in range(unroll):
+            y = step(y, t)
+            t = t + dt  # sequential adds: bitwise-identical to unroll=1
+        return y, t
 
     # dtype=float -> float64 under jax_enable_x64, else float32: long runs
     # in x64 mode keep full time resolution (t ~ 1e6 s overwhelms f32 ulp).
     t0a = jnp.asarray(t0, dtype=float)
     if unroll == 1:
         return jax.lax.fori_loop(0, nsteps, body, (y0, t0a))
-    if unroll != 2:
-        raise ValueError(f"integrate: unroll must be 1 or 2, got {unroll}")
-
-    def body2(_, carry):
-        y, t = carry
-        y = step(y, t)
-        t1 = t + dt  # sequential adds: bitwise-identical t to unroll=1
-        return step(y, t1), t1 + dt
-
-    y, t = jax.lax.fori_loop(0, nsteps // 2, body2, (y0, t0a))
-    return jax.lax.cond(
-        nsteps % 2 == 1,
-        lambda c: (step(c[0], c[1]), c[1] + dt),
-        lambda c: c,
-        (y, t),
-    )
+    y, t = jax.lax.fori_loop(0, nsteps // unroll, body_u, (y0, t0a))
+    return jax.lax.fori_loop(0, nsteps % unroll, body, (y, t))
 
 
 def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float,

@@ -222,23 +222,26 @@ def test_tc1_advection_full_revolution_error_norms():
 
 
 def test_integrate_unroll_parity():
-    """integrate's unroll=2 while-body (round-5 glue squeeze) is
+    """integrate's unrolled while-body (round-5 glue squeeze) is
     numerically IDENTICAL to the plain loop — same ops in the same
-    order — for both even and odd step counts (the odd remainder runs
-    under lax.cond), and for traced step counts."""
+    order — at every unroll level, for step counts around the unroll
+    boundaries (remainder loop), and for traced step counts."""
     import jax
 
     from jaxstream.stepping import integrate
 
     step = lambda y, t: {"x": y["x"] * 1.5 - 0.25 * t}
     y0 = {"x": jnp.arange(6.0) + 1.0}
-    for nsteps in (0, 1, 4, 7):
+    for nsteps in (0, 1, 3, 4, 7, 9):
         y1, t1 = integrate(step, y0, 0.0, nsteps, 60.0, unroll=1)
-        y2, t2 = integrate(step, y0, 0.0, nsteps, 60.0)
-        np.testing.assert_array_equal(np.asarray(y1["x"]),
-                                      np.asarray(y2["x"]))
-        assert float(t1) == float(t2) == nsteps * 60.0
+        for u in (2, 4, 8):
+            y2, t2 = integrate(step, y0, 0.0, nsteps, 60.0, unroll=u)
+            np.testing.assert_array_equal(np.asarray(y1["x"]),
+                                          np.asarray(y2["x"]))
+            assert float(t1) == float(t2) == nsteps * 60.0
     # traced nsteps (the bench/run-loop usage: one executable, any k)
+    ref7, _ = integrate(step, y0, 0.0, 7, 60.0, unroll=1)
     run = jax.jit(lambda y, k: integrate(step, y, 0.0, k, 60.0))
     y3, t3 = run(y0, 7)
-    np.testing.assert_array_equal(np.asarray(y3["x"]), np.asarray(y1["x"]))
+    np.testing.assert_array_equal(np.asarray(y3["x"]),
+                                  np.asarray(ref7["x"]))
