@@ -51,6 +51,13 @@ class ParallelConfig:
     # one face per device) instead of the GSPMD-inferred path.  Honored by
     # jaxstream.parallel.sharded_model.make_stepper_for.
     use_shard_map: bool = False
+    # Overlapped halo exchange (explicit shard_map paths + the sharded
+    # factored tier): issue every ppermute stage up front, run the
+    # interior-only RHS kernel while the collectives are in flight, and
+    # finish with the boundary-band pass on the received strips.  The
+    # split path is parity-tested against the serialized default on all
+    # tiers; default off so the serialized exchange stays the reference.
+    overlap_exchange: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

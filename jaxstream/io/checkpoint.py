@@ -62,5 +62,9 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.path}")
-        out = self.mgr.restore(step)
+        # Explicit StandardRestore: a fresh manager (no prior save in
+        # this process) has no registered handler for the default item,
+        # and a bare restore() KeyErrors on orbax's composite handler.
+        out = self.mgr.restore(step,
+                               args=self._ocp.args.StandardRestore())
         return out["state"], float(np.asarray(out["t"]))

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...utils.jax_compat import tpu_compiler_params
+
 from ...geometry.connectivity import (
     EDGE_E,
     EDGE_N,
@@ -44,6 +46,8 @@ __all__ = [
     "sym_edge_normals",
     "rhs_core_cov",
     "make_cov_rhs_pallas",
+    "make_cov_rhs_interior_local",
+    "make_cov_rhs_band_local",
     "make_cov_strip_router",
     "make_cov_strip_router_linear",
     "make_cov_strip_router_split",
@@ -215,7 +219,8 @@ def sym_edge_normals(grid, u_ext):
 
 def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
                  n, halo, d, radius, gravity, omega, recon,
-                 seam_scratch=None, sym_prescaled=False):
+                 seam_scratch=None, sym_prescaled=False,
+                 seam_edges=(True, True, True, True)):
     """One face's covariant-SWE right-hand side as traceable kernel math.
 
     ``fz = (c0z, cxz, cyz)`` are the face frame's z-components (scalars,
@@ -225,8 +230,25 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     normals imposed on the panel-boundary faces (pass ``None`` for both
     to keep the local values — single-panel tests).  Returns
     ``(dh, dua, dub)`` interior (n, n) tendencies.
+
+    Rectangular windows (the interior/boundary split of the overlapped
+    exchange path): pass ``n=(ny, nx)`` with operand windows extended by
+    ``halo`` on every side, and ``recon=(recon_y, recon_x)`` partials
+    built for the matching extents.  ``seam_edges = (S, N, W, E)`` gates
+    each seam imposition individually — a window whose edge is NOT a
+    panel/block seam must leave that flux row/column at its local value
+    (the full-face call imposes all four).  Every arithmetic operation
+    on a given output cell is identical (same operand windows, same op
+    order) to the square full-face call, so a tiling of rectangular
+    calls reproduces the full kernel at the trace level; the compiled
+    equality is ulp-level in general (execution-context fusion — see
+    the interior/boundary split section comment).
     """
-    h0, h1 = halo, halo + n
+    ny, nx = (n, n) if isinstance(n, int) else n
+    recon_y, recon_x = recon if isinstance(recon, tuple) else (recon, recon)
+    eS, eN, eW, eE = seam_edges
+    h0y, h1y = halo, halo + ny
+    h0x, h1x = halo, halo + nx
     inv2d = jnp.float32(1.0 / (2.0 * d))
     g = jnp.float32(gravity)
     two_omega = jnp.float32(2.0 * omega)
@@ -239,11 +261,11 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     # the identical sym strip by the identical edge sqrtg (the equiangular
     # sqrtg is even in the along-edge coordinate), so cross-seam flux
     # equality — hence exact mass conservation — is preserved.
-    Fx = _fast_frame(xfr[:, h0:h1 + 1], yc[h0:h1], radius)
-    uba = 0.5 * (ua[h0:h1, h0 - 1:h1] + ua[h0:h1, h0:h1 + 1])
-    ubb = 0.5 * (ub[h0:h1, h0 - 1:h1] + ub[h0:h1, h0:h1 + 1])
-    ux = Fx["fg_aa"] * uba + Fx["fg_ab"] * ubb      # sqrtg u^a, (n, n+1)
-    if sym_we is not None:
+    Fx = _fast_frame(xfr[:, h0x:h1x + 1], yc[h0y:h1y], radius)
+    uba = 0.5 * (ua[h0y:h1y, h0x - 1:h1x] + ua[h0y:h1y, h0x:h1x + 1])
+    ubb = 0.5 * (ub[h0y:h1y, h0x - 1:h1x] + ub[h0y:h1y, h0x:h1x + 1])
+    ux = Fx["fg_aa"] * uba + Fx["fg_ab"] * ubb      # sqrtg u^a, (ny, nx+1)
+    if sym_we is not None and (eW or eE):
         # Seam imposition: replace the two boundary flux-velocity
         # columns/rows with the symmetrized-edge values.  The in-kernel
         # edge-sqrtg evals are tiny (n, 1)-shaped op chains — expensive
@@ -256,44 +278,58 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
         if sym_prescaled:
             uW, uE = sym_we[:, 0:1], sym_we[:, 1:2]
         else:
-            sgW = _fast_frame(xfr[:, h0:h0 + 1], yc[h0:h1], radius)["sqrtg"]
-            sgE = _fast_frame(xfr[:, h1:h1 + 1], yc[h0:h1], radius)["sqrtg"]
-            uW, uE = sgW * sym_we[:, 0:1], sgE * sym_we[:, 1:2]
+            sgW = (_fast_frame(xfr[:, h0x:h0x + 1], yc[h0y:h1y],
+                               radius)["sqrtg"] if eW else None)
+            sgE = (_fast_frame(xfr[:, h1x:h1x + 1], yc[h0y:h1y],
+                               radius)["sqrtg"] if eE else None)
+            uW = sgW * sym_we[:, 0:1] if eW else None
+            uE = sgE * sym_we[:, 1:2] if eE else None
         if seam_scratch is not None:
             sx = seam_scratch[0]
             sx[:, :] = ux
-            sx[:, 0:1] = uW
-            sx[:, n:n + 1] = uE
+            if eW:
+                sx[:, 0:1] = uW
+            if eE:
+                sx[:, nx:nx + 1] = uE
             ux = sx[:, :]
         else:
-            colx = jax.lax.broadcasted_iota(jnp.int32, (n, n + 1), 1)
-            ux = jnp.where(colx == 0, uW, ux)
-            ux = jnp.where(colx == n, uE, ux)
-    qL, qR = recon(hf[h0:h1, :], -1)
+            colx = jax.lax.broadcasted_iota(jnp.int32, (ny, nx + 1), 1)
+            if eW:
+                ux = jnp.where(colx == 0, uW, ux)
+            if eE:
+                ux = jnp.where(colx == nx, uE, ux)
+    qL, qR = recon_x(hf[h0y:h1y, :], -1)
     fx = jnp.maximum(ux, 0.0) * qL + jnp.minimum(ux, 0.0) * qR
 
-    Fy = _fast_frame(xr[:, h0:h1], yfc[h0:h1 + 1], radius)
-    vba = 0.5 * (ua[h0 - 1:h1, h0:h1] + ua[h0:h1 + 1, h0:h1])
-    vbb = 0.5 * (ub[h0 - 1:h1, h0:h1] + ub[h0:h1 + 1, h0:h1])
-    uy = Fy["fg_ab"] * vba + Fy["fg_bb"] * vbb      # sqrtg u^b, (n+1, n)
-    if sym_sn is not None:
+    Fy = _fast_frame(xr[:, h0x:h1x], yfc[h0y:h1y + 1], radius)
+    vba = 0.5 * (ua[h0y - 1:h1y, h0x:h1x] + ua[h0y:h1y + 1, h0x:h1x])
+    vbb = 0.5 * (ub[h0y - 1:h1y, h0x:h1x] + ub[h0y:h1y + 1, h0x:h1x])
+    uy = Fy["fg_ab"] * vba + Fy["fg_bb"] * vbb      # sqrtg u^b, (ny+1, nx)
+    if sym_sn is not None and (eS or eN):
         if sym_prescaled:
             uS, uN = sym_sn[0:1, :], sym_sn[1:2, :]
         else:
-            sgS = _fast_frame(xr[:, h0:h1], yfc[h0:h0 + 1], radius)["sqrtg"]
-            sgN = _fast_frame(xr[:, h0:h1], yfc[h1:h1 + 1], radius)["sqrtg"]
-            uS, uN = sgS * sym_sn[0:1, :], sgN * sym_sn[1:2, :]
+            sgS = (_fast_frame(xr[:, h0x:h1x], yfc[h0y:h0y + 1],
+                               radius)["sqrtg"] if eS else None)
+            sgN = (_fast_frame(xr[:, h0x:h1x], yfc[h1y:h1y + 1],
+                               radius)["sqrtg"] if eN else None)
+            uS = sgS * sym_sn[0:1, :] if eS else None
+            uN = sgN * sym_sn[1:2, :] if eN else None
         if seam_scratch is not None:
             sy = seam_scratch[1]
             sy[:, :] = uy
-            sy[0:1, :] = uS
-            sy[n:n + 1, :] = uN
+            if eS:
+                sy[0:1, :] = uS
+            if eN:
+                sy[ny:ny + 1, :] = uN
             uy = sy[:, :]
         else:
-            rowy = jax.lax.broadcasted_iota(jnp.int32, (n + 1, n), 0)
-            uy = jnp.where(rowy == 0, uS, uy)
-            uy = jnp.where(rowy == n, uN, uy)
-    qL, qR = recon(hf[:, h0:h1], -2)
+            rowy = jax.lax.broadcasted_iota(jnp.int32, (ny + 1, nx), 0)
+            if eS:
+                uy = jnp.where(rowy == 0, uS, uy)
+            if eN:
+                uy = jnp.where(rowy == ny, uN, uy)
+    qL, qR = recon_y(hf[:, h0x:h1x], -2)
     fy = jnp.maximum(uy, 0.0) * qL + jnp.minimum(uy, 0.0) * qR
 
     # ---- momentum (vector-invariant, covariant components) ---------------
@@ -301,24 +337,27 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     # every _fast_frame output is an elementwise function of the same
     # coordinate-row values, so slicing is bitwise-identical to
     # recomputing — and saves a full (n, n) metric evaluation per stage.
-    b0, b1 = h0 - 1, h1 + 1
-    Fb = _fast_frame(xr[:, b0:b1], yc[b0:b1], radius)
+    b0y, b1y = h0y - 1, h1y + 1
+    b0x, b1x = h0x - 1, h1x + 1
+    Fb = _fast_frame(xr[:, b0x:b1x], yc[b0y:b1y], radius)
     Fc = {k: v[-1:, 1:-1] if v.shape[-2] == 1 else
              (v[1:-1, -1:] if v.shape[-1] == 1 else v[1:-1, 1:-1])
           for k, v in Fb.items()}
     inv_sg_d = Fc["inv_sqrtg"] * jnp.float32(1.0 / d)
     dh = -((fx[:, 1:] - fx[:, :-1]) + (fy[1:, :] - fy[:-1, :])) * inv_sg_d
-    uab = ua[b0:b1, b0:b1]
-    ubb_ = ub[b0:b1, b0:b1]
+    uab = ua[b0y:b1y, b0x:b1x]
+    ubb_ = ub[b0y:b1y, b0x:b1x]
     uca = Fb["inv_aa"] * uab + Fb["inv_ab"] * ubb_        # u^alpha, band
     ucb = Fb["inv_ab"] * uab + Fb["inv_bb"] * ubb_        # u^beta, band
     ke = 0.5 * (uca * uab + ucb * ubb_)
-    bern = g * (hf[b0:b1, b0:b1] + bf[b0:b1, b0:b1]) + ke
+    bern = g * (hf[b0y:b1y, b0x:b1x] + bf[b0y:b1y, b0x:b1x]) + ke
     dba = (bern[1:-1, 2:] - bern[1:-1, :-2]) * inv2d
     dbb = (bern[2:, 1:-1] - bern[:-2, 1:-1]) * inv2d
 
-    dub_da = (ub[h0:h1, h0 + 1:h1 + 1] - ub[h0:h1, h0 - 1:h1 - 1]) * inv2d
-    dua_db = (ua[h0 + 1:h1 + 1, h0:h1] - ua[h0 - 1:h1 - 1, h0:h1]) * inv2d
+    dub_da = (ub[h0y:h1y, h0x + 1:h1x + 1]
+              - ub[h0y:h1y, h0x - 1:h1x - 1]) * inv2d
+    dua_db = (ua[h0y + 1:h1y + 1, h0x:h1x]
+              - ua[h0y - 1:h1y - 1, h0x:h1x]) * inv2d
 
     # (zeta + f) sqrtg expanded: zeta sqrtg is just the covariant curl
     # (zeta = curl / sqrtg), so only the Coriolis part needs the metric —
@@ -418,7 +457,7 @@ def make_cov_rhs_pallas(
             jax.ShapeDtypeStruct((nf, n, n), jnp.float32),
             jax.ShapeDtypeStruct((2, nf, n, n), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -520,7 +559,7 @@ def make_cov_rhs_pallas_local(
             jax.ShapeDtypeStruct((1, n, n), jnp.float32),
             jax.ShapeDtypeStruct((2, 1, n, n), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -531,6 +570,209 @@ def make_cov_rhs_pallas_local(
                           h_ext, u_ext, b_ext, sym_sn, sym_we))
 
     return rhs
+
+
+# ---------------------------------------------------------------------------
+# Interior/boundary split of the covariant RHS — the overlapped-exchange
+# building blocks (parallelization.overlap_exchange).
+#
+# A halo of depth h is exactly the stencil radius of one RHS evaluation,
+# so the tendency of any interior cell at distance >= h from the panel
+# (or block) boundary reads NO ghost value: that "interior of the
+# interior" — an (n-2h)^2 core out of n^2 cells, 97.9% of the face at
+# C384 — is computable before any exchange completes (Putman & Lin 2007
+# make the same observation for ghost-cell fills).  The sharded steppers
+# therefore issue their ppermute stages FIRST, run the interior-only
+# kernel below while XLA's async collectives are in flight, and finish
+# with the boundary-band pass on the received strips.
+#
+# The band pass is four rectangular rhs_core_cov windows (S/N full-width
+# rows, W/E the remaining columns: an exact disjoint tiling of the ring)
+# kept as traced jnp rather than a fourth Pallas variant: the band is
+# O(h*n) work — ~2% of the face at C384 — and leaving it to XLA lets the
+# scheduler start it the moment the last receive lands, with no
+# custom-call boundary in between.  Both passes slice the SAME operand
+# windows in the SAME op order as the full-face kernel; at the default
+# halo=2 the interior+band tiling reproduces the serialized path
+# bitwise under one jit (tested), and the general contract is
+# ulp-level — XLA may fuse the differently-shaped band subgraphs with
+# different FMA/reassociation choices (measured: single-ulp band drift
+# at halo=3) — the same budget the multi-step overlap parities carry.
+# ---------------------------------------------------------------------------
+
+
+def make_cov_rhs_interior_local(
+    n: int,
+    halo: int,
+    dalpha: float,
+    radius: float,
+    gravity: float,
+    omega: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+):
+    """Interior-pass covariant RHS for ONE local block, no ghosts read.
+
+    Signature::
+
+        rhs(fz, xr, xfr, yc, yfc, h_int, u_int, b_int)
+            -> (dh (1, ni, ni), du (2, 1, ni, ni)),  ni = n - 2*halo
+
+    ``h_int`` (1, n, n) / ``u_int`` (2, 1, n, n) are the block's plain
+    interior fields (exactly the sharded state — no embed, no exchange);
+    ``b_int`` the (1, n, n) interior window of the orography;
+    ``xr``/``xfr`` (1, n), ``yc``/``yfc`` (n, 1) the INTERIOR coordinate
+    windows (extended coords sliced ``[halo : halo+n]``).  The interior
+    field plays the role of the extended array for the core window: its
+    outer ``halo`` ring is the stencil halo of the ``ni x ni`` output.
+    No seam strips exist this deep inside a block, so the seam machinery
+    is off entirely.
+    """
+    ni = n - 2 * halo
+    if ni <= 0:
+        raise ValueError(
+            f"interior split needs n > 2*halo (got n={n}, halo={halo}): "
+            "with no ghost-free core the serialized exchange is the "
+            "whole kernel")
+    d = float(dalpha)
+    recon = pick_recon(scheme, halo, ni, limiter)
+
+    def kernel(fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref, h_ref, u_ref,
+               b_ref, dh_ref, du_ref):
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            h_ref[0], u_ref[0, 0], u_ref[1, 0], b_ref[0],
+            None, None, n=ni, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+        )
+        dh_ref[0] = dh
+        du_ref[0, 0] = dua
+        du_ref[1, 0] = dub
+
+    grid_spec = pl.GridSpec(
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, n), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, 1), lambda f: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, n), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, ni, ni), lambda f: (f, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, 1, ni, ni), lambda f: (0, f, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((1, ni, ni), jnp.float32),
+            jax.ShapeDtypeStruct((2, 1, ni, ni), jnp.float32),
+        ],
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    def rhs(fz, xr, xfr, yc, yfc, h_int, u_int, b_int):
+        return tuple(call(fz, xr, xfr, yc, yfc, h_int, u_int, b_int))
+
+    return rhs
+
+
+def make_cov_rhs_band_local(
+    n: int,
+    halo: int,
+    dalpha: float,
+    radius: float,
+    gravity: float,
+    omega: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+):
+    """Boundary-band covariant RHS + stitch for ONE local block.
+
+    Signature::
+
+        band(fz, xr, xfr, yc, yfc, h_ext, u_ext, b_ext, sym_sn, sym_we,
+             dh_core, du_core) -> (dh (1, n, n), du (2, 1, n, n))
+
+    Operands as :func:`make_cov_rhs_pallas_local` (extended block with
+    ghosts filled by the completed exchange, sym strips for all four
+    edges) plus the interior pass's core tendencies, which are stitched
+    into the full interior output.  Four rectangular windows tile the
+    depth-``halo`` ring exactly: S/N rows over the full width (they own
+    the corners), W/E the remaining ``n - 2h`` rows.  Each window's
+    seam flags impose exactly the strip rows the full-face kernel
+    imposes there — sym values at a window edge that is NOT the block
+    edge are never touched, and every imposition site is covered by
+    exactly one window.  Traced jnp by design (see the section comment).
+    """
+    h = halo
+    ni = n - 2 * h
+    if ni <= 0:
+        raise ValueError(
+            f"band split needs n > 2*halo (got n={n}, halo={halo})")
+    m = n + 2 * h
+    d = float(dalpha)
+    recon_n = pick_recon(scheme, h, n, limiter)
+    recon_h = pick_recon(scheme, h, h, limiter)
+    recon_i = pick_recon(scheme, h, ni, limiter)
+    kw = dict(halo=h, d=d, radius=radius, gravity=gravity, omega=omega)
+
+    def band(fz, xr, xfr, yc, yfc, h_ext, u_ext, b_ext, sym_sn, sym_we,
+             dh_core, du_core):
+        fz3 = (fz[0, 0, 0], fz[0, 0, 1], fz[0, 0, 2])
+        hf, ua, ub, bf = h_ext[0], u_ext[0, 0], u_ext[1, 0], b_ext[0]
+        ssn, swe = sym_sn[0], sym_we[0]            # (2, n) / (n, 2)
+
+        def win(r0, r1, c0, c1):
+            sl = (slice(r0, r1), slice(c0, c1))
+            return (xr[:, c0:c1], xfr[:, c0:c1], yc[r0:r1], yfc[r0:r1],
+                    hf[sl], ua[sl], ub[sl], bf[sl])
+
+        # S/N bands: (h, n) outputs over the full width.
+        dS = rhs_core_cov(fz3, *win(0, 3 * h, 0, m), ssn, swe[0:h],
+                          n=(h, n), recon=(recon_h, recon_n),
+                          seam_edges=(True, False, True, True), **kw)
+        dN = rhs_core_cov(fz3, *win(m - 3 * h, m, 0, m), ssn,
+                          swe[n - h:n], n=(h, n),
+                          recon=(recon_h, recon_n),
+                          seam_edges=(False, True, True, True), **kw)
+        # W/E bands: (ni, h) outputs on the remaining rows.
+        dW = rhs_core_cov(fz3, *win(h, n + h, 0, 3 * h), None,
+                          swe[h:n - h], n=(ni, h),
+                          recon=(recon_i, recon_h),
+                          seam_edges=(False, False, True, False), **kw)
+        dE = rhs_core_cov(fz3, *win(h, n + h, m - 3 * h, m), None,
+                          swe[h:n - h], n=(ni, h),
+                          recon=(recon_i, recon_h),
+                          seam_edges=(False, False, False, True), **kw)
+
+        def stitch(i, core):
+            mid = jnp.concatenate([dW[i], core, dE[i]], axis=-1)
+            return jnp.concatenate([dS[i], mid, dN[i]], axis=-2)
+
+        dh = stitch(0, dh_core[0])[None]
+        du = jnp.stack([stitch(1, du_core[0, 0])[None],
+                        stitch(2, du_core[1, 0])[None]])
+        return dh, du
+
+    return band
 
 
 # ---------------------------------------------------------------------------
@@ -945,7 +1187,7 @@ def make_cov_stage_inkernel(
             jax.ShapeDtypeStruct((2, 6, m, m), jnp.float32),
             jax.ShapeDtypeStruct((6, R, n), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -1604,7 +1846,7 @@ def make_cov_stage_compact(
             jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
             jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -1874,7 +2116,7 @@ def make_cov_stage_nu4(
             jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
             jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -1896,7 +2138,7 @@ def make_cov_stage_nu4(
             jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
             jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -2004,7 +2246,7 @@ def make_cov_nu4_filter(
             jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
             jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -2045,8 +2287,13 @@ def make_fused_ssprk3_cov_split_nu4(
     ``interval x`` coefficient (filter-cycling, the same split-filter
     logic one level up).  The explicit del^4 stability bound is miles
     away (nu4 dt interval / dx^4 ~ 0.03 at C384/interval=2), so the
-    arbiter is the physics gate, not stability.  Step counting derives
-    from ``t/dt`` (exact in f32 for the < 2^24 steps any run takes).
+    arbiter is the physics gate, not stability.  Step counting rides an
+    integer ``"filter_k"`` counter in the carry — seed it with
+    ``jnp.int32(0)`` alongside :meth:`compact_state`'s fields.  (It
+    must NOT be reconstructed as ``round(t/dt)``: ``t`` is accumulated
+    in f32 one ``+ dt`` at a time, and for a dt whose multiples are not
+    exactly representable the accumulated rounding makes ``round(t/dt)``
+    skip or repeat an index — double- or un-applied filter steps.)
     """
     from .swe_step import SSPRK3_COEFFS
 
@@ -2064,6 +2311,7 @@ def make_fused_ssprk3_cov_split_nu4(
                                interpret=interpret)
 
     def step(y, t):
+        del t
         h0, u0 = y["h"], y["u"]
         gsn, gwe = route(y["strips_sn"], y["strips_we"])
         h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
@@ -2074,18 +2322,25 @@ def make_fused_ssprk3_cov_split_nu4(
         if interval == 1:
             gsn, gwe = route(sn3, we3)
             hf, uf, snf, wef = filt(h3, u3, gsn, gwe)
-        else:
-            k = jnp.round(t / jnp.float32(dt)).astype(jnp.int32)
+            return {"h": hf, "u": uf, "strips_sn": snf, "strips_we": wef}
 
-            def do_filter(args):
-                h3, u3, sn3, we3 = args
-                gsn, gwe = route(sn3, we3)
-                return filt(h3, u3, gsn, gwe)
+        if "filter_k" not in y:
+            raise ValueError(
+                "the interval > 1 filter-cycling carry needs an integer "
+                "'filter_k' step counter; seed it as "
+                "dict(model.compact_state(state), filter_k=jnp.int32(0))")
+        k = y["filter_k"]
 
-            hf, uf, snf, wef = jax.lax.cond(
-                k % interval == interval - 1,
-                do_filter, lambda args: args, (h3, u3, sn3, we3))
-        return {"h": hf, "u": uf, "strips_sn": snf, "strips_we": wef}
+        def do_filter(args):
+            h3, u3, sn3, we3 = args
+            gsn, gwe = route(sn3, we3)
+            return filt(h3, u3, gsn, gwe)
+
+        hf, uf, snf, wef = jax.lax.cond(
+            k % interval == interval - 1,
+            do_filter, lambda args: args, (h3, u3, sn3, we3))
+        return {"h": hf, "u": uf, "strips_sn": snf, "strips_we": wef,
+                "filter_k": (k + 1) % interval}
 
     return step
 

@@ -46,6 +46,7 @@ class ShardingSetup:
     sy: int
     sx: int
     use_shard_map: bool = False
+    overlap_exchange: bool = False
 
     @property
     def scalar_spec(self) -> P:
@@ -119,6 +120,7 @@ def setup_sharding(config: Any = None) -> ShardingSetup:
             num_devices=block.get("num_devices", 6),
             device_type=block.get("device_type", "cpu"),
             use_shard_map=block.get("use_shard_map", False),
+            overlap_exchange=block.get("overlap_exchange", False),
         )
 
     t = par.tiles_per_edge
@@ -151,7 +153,8 @@ def setup_sharding(config: Any = None) -> ShardingSetup:
         d, par.device_type, p, sy, sx, t,
     )
     return ShardingSetup(mesh=mesh, num_devices=d, panel=p, sy=sy, sx=sx,
-                         use_shard_map=par.use_shard_map)
+                         use_shard_map=par.use_shard_map,
+                         overlap_exchange=par.overlap_exchange)
 
 
 def shard_state(setup: ShardingSetup, state):

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 from ..geometry.connectivity import (
     EDGE_E,
     EDGE_N,
@@ -47,7 +49,8 @@ from ..geometry.connectivity import (
 from ..geometry.cubed_sphere import FACE_AXES
 from .halo import read_strip, write_strip
 
-__all__ = ["CovShardProgram", "make_sharded_cov_stepper"]
+__all__ = ["CovShardProgram", "make_cov_shard_exchange",
+           "make_cov_shard_exchange_phases", "make_sharded_cov_stepper"]
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
 
@@ -225,6 +228,57 @@ def ssprk3_sharded_body(f, state, dt):
             "u": a3 * u0 + b3 * (u2 + dt * du)}
 
 
+def make_cov_shard_exchange_phases(program: CovShardProgram):
+    """``(start, finish)`` — the cube-edge exchange split at the wire.
+
+    ``start(h_blk, u_blk, t)`` reads the canonical boundary strips ONCE
+    (the stages write only the ghost ring, so every payload is a
+    function of the pre-exchange state) and issues all four stage
+    ``ppermute``s immediately; ``finish(h_blk, u_blk, recvs)`` rotates
+    the received strips into ghosts and runs the seam symmetrization.
+    Nothing between ``start`` and ``finish`` depends on the collectives,
+    so the overlapped stepper runs the interior RHS kernel there and
+    XLA's async collectives fly under it.  The serialized
+    :func:`make_cov_shard_exchange` is ``finish(.., start(..))`` —
+    one exchange implementation, two schedules.
+    """
+    n, halo = program.n, program.halo
+    axis = program.axis_name
+
+    def start(h_blk, u_blk, t):
+        # Canonical strips for every edge, read once: the stages write
+        # only the ghost ring, so the interior strips are loop-invariant.
+        hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
+                        for e in range(4)])                  # (4, halo, n)
+        us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
+                        for e in range(4)], axis=1)          # (2, 4, halo, n)
+        recvs = []
+        for s, perm in enumerate(program.perms):
+            rows = tuple(t[name][0, s] for name in CUBE_ROW_NAMES)
+            e_s, rev = rows[0], rows[1]
+            h_send = jnp.take(hs, e_s, axis=0)
+            u_send = jnp.take(us, e_s, axis=1)
+            payload = jnp.concatenate([h_send[None], u_send])  # (3, halo, n)
+            payload = _maybe_flip(payload, rev)
+            recvs.append((lax.ppermute(payload, axis, perm), u_send, rows))
+        return recvs
+
+    def finish(h_blk, u_blk, recvs):
+        sym = jnp.zeros((4, n), jnp.float32)
+        for recv, u_send, rows in recvs:
+            e_s = rows[0]
+            h_blk, u_blk, mine = apply_cov_cube_recv(
+                h_blk, u_blk, u_send, recv, rows, e_s)
+            sym = jnp.where(
+                (jnp.arange(4) == e_s)[:, None], mine[None], sym)
+
+        sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]     # (1, 2, n)
+        sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
+        return h_blk, u_blk, sym_sn, sym_we
+
+    return start, finish
+
+
 def make_cov_shard_exchange(program: CovShardProgram):
     """``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)``.
 
@@ -235,39 +289,15 @@ def make_cov_shard_exchange(program: CovShardProgram):
     symmetrized edge-normal strips ``sym_sn (1, 2, n) / sym_we (1, n, 2)``
     for the RHS kernel.
     """
-    n, halo = program.n, program.halo
-    axis = program.axis_name
+    start, finish = make_cov_shard_exchange_phases(program)
 
     def exchange(h_blk, u_blk, t):
-        sym = jnp.zeros((4, n), jnp.float32)
-        # Canonical strips for every edge, read once: the stages write
-        # only the ghost ring, so the interior strips are loop-invariant.
-        hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
-                        for e in range(4)])                  # (4, halo, n)
-        us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
-                        for e in range(4)], axis=1)          # (2, 4, halo, n)
-        for s, perm in enumerate(program.perms):
-            rows = tuple(t[name][0, s] for name in CUBE_ROW_NAMES)
-            e_s, rev = rows[0], rows[1]
-            h_send = jnp.take(hs, e_s, axis=0)
-            u_send = jnp.take(us, e_s, axis=1)
-            payload = jnp.concatenate([h_send[None], u_send])  # (3, halo, n)
-            payload = _maybe_flip(payload, rev)
-            recv = lax.ppermute(payload, axis, perm)
-
-            h_blk, u_blk, mine = apply_cov_cube_recv(
-                h_blk, u_blk, u_send, recv, rows, e_s)
-            sym = jnp.where(
-                (jnp.arange(4) == e_s)[:, None], mine[None], sym)
-
-        sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]     # (1, 2, n)
-        sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
-        return h_blk, u_blk, sym_sn, sym_we
+        return finish(h_blk, u_blk, start(h_blk, u_blk, t))
 
     return exchange
 
 
-def make_sharded_cov_stepper(model, setup, dt: float):
+def make_sharded_cov_stepper(model, setup, dt: float, overlap=None):
     """``step(state, t) -> state`` for the covariant model under shard_map.
 
     Requires a ``(panel=6, 1, 1)`` mesh (one face per device).  State is
@@ -275,6 +305,17 @@ def make_sharded_cov_stepper(model, setup, dt: float):
     sharded over the panel axis.  Each SSPRK3 stage = one explicit
     4-ppermute exchange + the fused covariant Pallas RHS kernel on the
     local face (interpret mode off-TPU) + the stage combination.
+
+    ``overlap`` (default: the setup's ``overlap_exchange`` flag): issue
+    the 4 ppermute stages first, run the interior-only RHS kernel (the
+    ghost-free (n-2h)^2 core) while the collectives are in flight, then
+    consume the received strips in the boundary-band pass — the
+    interior/band split of :mod:`jaxstream.ops.pallas.swe_cov`.  The
+    split tiles the exact arithmetic of the fused kernel; compiled
+    states agree at the ulp level (XLA re-fuses the differently-shaped
+    kernels' surroundings — <= 1e-6 relative over the multi-step parity
+    runs in tests/test_overlap_exchange.py); only the collective/compute
+    overlap differs.
     """
     grid = model.grid
     if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
@@ -283,8 +324,11 @@ def make_sharded_cov_stepper(model, setup, dt: float):
             f"got panel={setup.panel}, y={setup.sy}, x={setup.sx}. Use the "
             f"GSPMD path (use_shard_map: false) for other layouts."
         )
+    if overlap is None:
+        overlap = getattr(setup, "overlap_exchange", False)
     mesh = setup.mesh
     halo = grid.halo
+    n = grid.n
     program = CovShardProgram(grid)
     exchange = make_cov_shard_exchange(program)
     platform = getattr(mesh.devices.flat[0], "platform", "cpu")
@@ -295,6 +339,23 @@ def make_sharded_cov_stepper(model, setup, dt: float):
         limiter=model.limiter, interpret=(platform != "tpu"),
         n_faces=1, external_sym=True,
     )
+    if overlap:
+        from ..ops.pallas.swe_cov import (make_cov_rhs_band_local,
+                                          make_cov_rhs_interior_local)
+        from ..ops.pallas.swe_rhs import coord_rows
+
+        ex_start, ex_finish = make_cov_shard_exchange_phases(program)
+        rhs_interior = make_cov_rhs_interior_local(
+            n, halo, float(grid.dalpha), float(grid.radius),
+            model.gravity, model.omega, scheme=model.scheme,
+            limiter=model.limiter, interpret=(platform != "tpu"))
+        rhs_band = make_cov_rhs_band_local(
+            n, halo, float(grid.dalpha), float(grid.radius),
+            model.gravity, model.omega, scheme=model.scheme,
+            limiter=model.limiter)
+        xr_f, xfr_f, yc_f, yfc_f, _ = coord_rows(n, halo)
+        xr_i, xfr_i = xr_f[:, halo:halo + n], xfr_f[:, halo:halo + n]
+        yc_i, yfc_i = yc_f[halo:halo + n], yfc_f[halo:halo + n]
     frames_z = jnp.asarray(
         np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
 
@@ -322,8 +383,21 @@ def make_sharded_cov_stepper(model, setup, dt: float):
         def f(h_int, u_int):
             h_e = embed(h_int)
             u_e = embed(u_int)
-            h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
-            dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
+            if overlap:
+                # Wire first: all 4 stage ppermutes are functions of the
+                # pre-exchange strips.  The interior kernel depends on
+                # none of them, so the async collectives overlap it; the
+                # band pass then consumes the received strips.
+                recvs = ex_start(h_e, u_e, tabs)
+                dh_c, du_c = rhs_interior(
+                    fz, xr_i, xfr_i, yc_i, yfc_i, h_int, u_int,
+                    b_loc[:, halo:halo + n, halo:halo + n])
+                h_e, u_e, ssn, swe = ex_finish(h_e, u_e, recvs)
+                dh, du = rhs_band(fz, xr_f, xfr_f, yc_f, yfc_f,
+                                  h_e, u_e, b_loc, ssn, swe, dh_c, du_c)
+            else:
+                h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
+                dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
             if nu4 != 0.0:
                 # del^4 = lap(lap(.)) with an exchanged refill between,
                 # exactly the fused nu4 stepper's structure: the same
@@ -345,7 +419,7 @@ def make_sharded_cov_stepper(model, setup, dt: float):
 
         return ssprk3_sharded_body(f, state, dt)
 
-    shard_body = jax.shard_map(
+    shard_body = shard_map(
         body, mesh=mesh,
         in_specs=(pstate, ptab, P(axes[0]), P(axes[0])),
         out_specs=pstate,

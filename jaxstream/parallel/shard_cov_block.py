@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 from ..geometry.connectivity import (
     EDGE_E,
     EDGE_N,
@@ -57,7 +59,9 @@ from .shard_cov import (
 )
 from .shard_halo import _block_coords
 
-__all__ = ["CovBlockProgram", "make_sharded_cov_block_stepper"]
+__all__ = ["CovBlockProgram", "make_cov_block_exchange",
+           "make_cov_block_exchange_phases",
+           "make_sharded_cov_block_stepper"]
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
 
@@ -246,37 +250,63 @@ def _flip(row, rev):
     return jnp.where(rev > 0.5, jnp.flip(row, axis=-1), row)
 
 
-def make_cov_block_exchange(program: CovBlockProgram):
-    """``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)``.
+def make_cov_block_exchange_phases(program: CovBlockProgram):
+    """``(start, finish)`` — the block exchange split at the wire.
 
-    Local function for ``shard_map`` over the ``(6, s, s)`` mesh; the
-    blocks are local ``(1, m_loc, m_loc)`` / ``(2, 1, m_loc, m_loc)``
-    and ``t`` holds this device's table rows (leading dims 1).
+    Every payload (intra-panel neighbor shifts AND cube-edge stages) is
+    a function of the block's pre-exchange boundary strips, read once —
+    so ``start`` issues all of them immediately and ``finish`` applies
+    the ghost writes plus both seam-normal algebras.  The overlapped
+    stepper runs the interior-only RHS kernel between the two (see
+    :func:`jaxstream.parallel.shard_cov.make_cov_shard_exchange_phases`
+    for the face-tier twin).
     """
     n, halo = program.n_loc, program.halo
     joint = program.axis_names
 
-    def exchange(h_blk, u_blk, t):
+    def start(h_blk, u_blk, t):
         def tt(name):
             v = t[name]
             return v.reshape(v.shape[3:])      # drop (1, 1, 1) device dims
 
-        sym = jnp.zeros((4, n), jnp.float32)
         hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
                         for e in range(4)])                  # (4, halo, n)
         us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
                         for e in range(4)], axis=1)          # (2, 4, halo, n)
-        met_edge = tt("met_edge")                            # (4, 2, n)
 
-        # ---- intra-panel neighbors (same basis; no rotation) ------------
-        writers = [lambda b, st, e=e: write_strip(b, 0, e, st)
-                   for e in range(4)] + [lambda b, st: b]
+        intra = []
         for axname, perm, e_send, e_recv in program.intra_perms:
             if not perm:
                 continue
             payload = jnp.concatenate(
                 [hs[e_send][None], us[:, e_send]])           # (3, halo, n)
-            recv = lax.ppermute(payload, axname, perm)
+            intra.append((e_recv, lax.ppermute(payload, axname, perm)))
+
+        cube = []
+        for st, perm in enumerate(program.cube_perms):
+            rows = tuple(tt(name)[st] for name in CUBE_ROW_NAMES)
+            e_s, rev = rows[0], rows[1]
+            act = tt("active")[st]
+            u_send = jnp.take(us, e_s, axis=1)
+            payload = _flip(jnp.concatenate(
+                [jnp.take(hs, e_s, axis=0)[None], u_send]), rev)
+            cube.append((lax.ppermute(payload, joint, perm),
+                         u_send, rows, act))
+        return us, intra, cube
+
+    def finish(h_blk, u_blk, t, phase):
+        def tt(name):
+            v = t[name]
+            return v.reshape(v.shape[3:])
+
+        us, intra, cube = phase
+        sym = jnp.zeros((4, n), jnp.float32)
+        met_edge = tt("met_edge")                            # (4, 2, n)
+
+        # ---- intra-panel neighbors (same basis; no rotation) ------------
+        writers = [lambda b, st, e=e: write_strip(b, 0, e, st)
+                   for e in range(4)] + [lambda b, st: b]
+        for e_recv, recv in intra:
             blk3 = jnp.concatenate([h_blk[None], u_blk], axis=0)
             blk3 = writers[e_recv](blk3, recv)
             h_blk = blk3[0]
@@ -291,15 +321,8 @@ def make_cov_block_exchange(program: CovBlockProgram):
                             n_seam[None], sym)
 
         # ---- cube-edge stages (shared seam algebra, shard_cov.py) -------
-        for st, perm in enumerate(program.cube_perms):
-            rows = tuple(tt(name)[st] for name in CUBE_ROW_NAMES)
-            e_s, rev = rows[0], rows[1]
-            act = tt("active")[st]
-            u_send = jnp.take(us, e_s, axis=1)
-            payload = _flip(jnp.concatenate(
-                [jnp.take(hs, e_s, axis=0)[None], u_send]), rev)
-            recv = lax.ppermute(payload, joint, perm)
-
+        for recv, u_send, rows, act in cube:
+            e_s = rows[0]
             h_blk, u_blk, mine = apply_cov_cube_recv(
                 h_blk, u_blk, u_send, recv, rows,
                 jnp.where(act > 0.5, e_s, 4))
@@ -310,6 +333,21 @@ def make_cov_block_exchange(program: CovBlockProgram):
         sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]     # (1, 2, n)
         sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
         return h_blk, u_blk, sym_sn, sym_we
+
+    return start, finish
+
+
+def make_cov_block_exchange(program: CovBlockProgram):
+    """``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)``.
+
+    Local function for ``shard_map`` over the ``(6, s, s)`` mesh; the
+    blocks are local ``(1, m_loc, m_loc)`` / ``(2, 1, m_loc, m_loc)``
+    and ``t`` holds this device's table rows (leading dims 1).
+    """
+    start, finish = make_cov_block_exchange_phases(program)
+
+    def exchange(h_blk, u_blk, t):
+        return finish(h_blk, u_blk, t, start(h_blk, u_blk, t))
 
     return exchange
 
@@ -375,7 +413,7 @@ def make_block_corner_fill(program: CovBlockProgram):
     return corner_fill
 
 
-def make_sharded_cov_block_stepper(model, setup, dt: float):
+def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None):
     """``step(state, t) -> state`` for the covariant model on (6, s, s).
 
     State is the usual interior pytree ``{"h": (6, n, n),
@@ -384,6 +422,13 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
     (shard_cov.py), with the Laplacians' corner ghosts delivered by
     :func:`make_block_corner_fill` (neighbor strip end-patches; cube
     corners averaged face-locally like the oracle).
+
+    ``overlap`` (default: the setup's ``overlap_exchange`` flag): issue
+    every neighbor/cube-edge ppermute first, run the interior-only RHS
+    kernel on the block's ghost-free (n_loc-2h)^2 core while the
+    collectives are in flight, and finish with the boundary-band pass
+    (interior/band split of :mod:`jaxstream.ops.pallas.swe_cov`, same
+    schedule as the face tier).  Requires ``n_loc > 2*halo``.
     """
     grid = model.grid
     s = setup.sy
@@ -393,6 +438,8 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
             f"covariant block path needs a (panel=6, s, s) mesh with "
             f"s >= 2; got panel={setup.panel}, y={setup.sy}, x={setup.sx}"
         )
+    if overlap is None:
+        overlap = getattr(setup, "overlap_exchange", False)
     mesh = setup.mesh
     halo = grid.halo
     program = CovBlockProgram(grid, s)
@@ -407,6 +454,19 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
         model.gravity, model.omega, scheme=model.scheme,
         limiter=model.limiter, interpret=(platform != "tpu"),
     )
+    if overlap:
+        from ..ops.pallas.swe_cov import (make_cov_rhs_band_local,
+                                          make_cov_rhs_interior_local)
+
+        ex_start, ex_finish = make_cov_block_exchange_phases(program)
+        rhs_interior = make_cov_rhs_interior_local(
+            n_loc, halo, float(grid.dalpha), float(grid.radius),
+            model.gravity, model.omega, scheme=model.scheme,
+            limiter=model.limiter, interpret=(platform != "tpu"))
+        rhs_band = make_cov_rhs_band_local(
+            n_loc, halo, float(grid.dalpha), float(grid.radius),
+            model.gravity, model.omega, scheme=model.scheme,
+            limiter=model.limiter)
 
     axes = mesh.axis_names
     pstate = {"h": P(*axes), "u": P(None, *axes)}
@@ -447,9 +507,23 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
         def f(h_int, u_int):
             h_e = embed(h_int)
             u_e = embed(u_int)
-            h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
-            dh, du = rhs_local(fz, xr, xfr, yc, yfc, h_e, u_e, b_e,
-                               ssn, swe)
+            if overlap:
+                # Wire first: every payload is a function of the
+                # pre-exchange strips, so the interior kernel overlaps
+                # all in-flight collectives; the band pass consumes the
+                # received strips afterwards.
+                phase = ex_start(h_e, u_e, tabs)
+                i0, i1 = halo, halo + n_loc
+                dh_c, du_c = rhs_interior(
+                    fz, xr[:, i0:i1], xfr[:, i0:i1], yc[i0:i1],
+                    yfc[i0:i1], h_int, u_int, b_e[:, i0:i1, i0:i1])
+                h_e, u_e, ssn, swe = ex_finish(h_e, u_e, tabs, phase)
+                dh, du = rhs_band(fz, xr, xfr, yc, yfc, h_e, u_e, b_e,
+                                  ssn, swe, dh_c, du_c)
+            else:
+                h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
+                dh, du = rhs_local(fz, xr, xfr, yc, yfc, h_e, u_e, b_e,
+                                   ssn, swe)
             if nu4 != 0.0:
                 # del^4 = lap(lap(.)) with an exchanged refill between —
                 # the face tier's structure (shard_cov.py), per-block
@@ -476,7 +550,7 @@ def make_sharded_cov_block_stepper(model, setup, dt: float):
 
         return ssprk3_sharded_body(f, state, dt)
 
-    shard_body = jax.shard_map(
+    shard_body = shard_map(
         body, mesh=mesh,
         in_specs=(pstate, ptab, P(*axes)),
         out_specs=pstate,

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 from ..geometry.cubed_sphere import CubedSphereGrid
 from ..stepping import SCHEMES
 from .mesh import ShardingSetup
@@ -237,7 +239,7 @@ def make_sharded_stepper(model, setup: ShardingSetup, example_state,
     state_specs = jax.tree_util.tree_map(_face_spec, example_state)
     in_specs = (specs, state_specs, P())
 
-    smapped = jax.shard_map(
+    smapped = shard_map(
         local_step, mesh=mesh, in_specs=in_specs, out_specs=state_specs,
         check_vma=False,
     )

@@ -385,8 +385,9 @@ class Simulation:
             kw = dict(hs=b_ext, omega=p.omega, gravity=p.gravity,
                       scheme=tc.scheme, kappa=m.tt_kappa,
                       rounding=rounding)
-            tt_step = (make_tt_sphere_swe_sharded(g, tc.dt, rank, mesh,
-                                                  **kw)
+            tt_step = (make_tt_sphere_swe_sharded(
+                           g, tc.dt, rank, mesh,
+                           overlap_exchange=par.overlap_exchange, **kw)
                        if sharded else
                        make_tt_sphere_swe(g, tc.dt, rank, **kw))
             ua, ub = covariant_from_cartesian(g, fields["v"])

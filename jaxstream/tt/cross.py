@@ -206,8 +206,13 @@ def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
     rmax = min(n, m, R)
     l = min(k + oversample, rmax)
     with jax.default_matmul_precision("highest"):
-        key = jax.random.PRNGKey(_SKETCH_SEED)
-        Om = jax.random.normal(key, (m, l), P.dtype)
+        # Distinct subkeys for the two independent draws: the range
+        # sketch Om and the core-truncation subspace initializer V must
+        # not share randomness (with one key, V's k columns replicate
+        # the first k columns' pattern of Om's draw — a correlated
+        # start the subspace iteration then has to work away from).
+        key_om, key_v = jax.random.split(jax.random.PRNGKey(_SKETCH_SEED))
+        Om = jax.random.normal(key_om, (m, l), P.dtype)
         U = _ns_orth(P @ (Q @ Om), ns_iters)
         for _ in range(power):
             Z = Q.T @ (P.T @ U)                       # (m, l)
@@ -216,7 +221,7 @@ def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
         if l <= k:  # the basis already spans rank(M): exact, just pad
             A, B = _balanced(U, C, k)
             return A.astype(out_dtype), B.astype(out_dtype)
-        V = jax.random.normal(key, (m, k), P.dtype)
+        V = jax.random.normal(key_v, (m, k), P.dtype)
         for _ in range(subspace_iters):
             V = _ns_orth(C.T @ (C @ V), ns_iters)
         A = U @ (C @ V)                               # (n, k)
@@ -224,15 +229,45 @@ def rsvd_lowrank(P, Q, k: int, oversample: int = 8, power: int = 2,
         return A.astype(out_dtype), B.astype(out_dtype)
 
 
-def host_svd_lowrank(P, Q, k: int):
+#: Platforms whose runtimes are known to execute ``jax.pure_callback``.
+#: Plugin backends (e.g. the 'axon' PJRT plugin this image uses for its
+#: TPU) may lack host-callback support entirely and fail at RUN time
+#: with an opaque runtime error — exactly the backends this rung is
+#: pitched at, hence the explicit build-time gate below.
+_HOST_CALLBACK_PLATFORMS = frozenset({"cpu", "gpu", "cuda", "rocm", "tpu"})
+
+
+def host_svd_lowrank(P, Q, k: int, backend: str | None = None):
     """EXACT rank-``k`` truncation with the small factorization on the
     HOST (numpy/LAPACK, f64) via ``jax.pure_callback`` — the guaranteed
     stopgap rung for backends whose on-device linalg is unreliable.
     Bit-identical quality to the CPU svd tier; costs one host round
     trip per call (measured cost line in DESIGN.md).  Supports leading
     batch dims (numpy stacked linalg), so it vmaps via broadcast.
+
+    .. warning:: **Requires host-callback support in the executing
+       runtime.**  ``pure_callback`` is a host round trip per call: the
+       device runtime must be able to pause the program and call back
+       into Python.  Standard CPU/GPU/TPU runtimes can; out-of-tree
+       PJRT plugin backends often cannot, and without this gate the
+       failure surfaces as an obscure runtime error mid-run.  Pass
+       ``backend`` (the platform this rounding will execute on — same
+       contract as :func:`svd_lowrank`) when placing computation
+       explicitly; the default consults ``jax.default_backend()``.
     """
     import numpy as np
+
+    if backend is None:
+        backend = jax.default_backend()
+    if backend not in _HOST_CALLBACK_PLATFORMS:
+        raise NotImplementedError(
+            f"host_svd_lowrank executes a jax.pure_callback host round "
+            f"trip, and the {backend!r} backend is not known to support "
+            f"host callbacks (supported: "
+            f"{sorted(_HOST_CALLBACK_PLATFORMS)}). Use rounding='rsvd' "
+            f"(matmul-only, runs anywhere) or place this rounding on a "
+            f"CPU mesh."
+        )
 
     dt = P.dtype
     m = Q.shape[-1]
@@ -324,9 +359,19 @@ def aca_lowrank(P, Q, k: int):
     # through P's column scales.
     col_proxy = jnp.einsum("ij,j->i", jnp.abs(Q.T), jnp.sum(jnp.abs(P), 0))
     j0 = jnp.argmax(col_proxy)
-    U, V, _, _, _ = jax.lax.fori_loop(
-        0, k, body,
-        (U0, V0, j0, jnp.zeros((n,), bool), jnp.zeros((m,), bool)))
+    carry = (U0, V0, j0, jnp.zeros((n,), bool), jnp.zeros((m,), bool))
+    from ..utils.jax_compat import LEGACY_SHARD_MAP
+
+    if LEGACY_SHARD_MAP:
+        # jax 0.4.x: a vmapped while under shard_map trips an XLA
+        # hlo-verifier bug ("tile_assignment should have N devices") —
+        # the bound is static, so unroll the sweep instead (same ops,
+        # same order; only the loop construct differs).
+        for t in range(k):
+            carry = body(t, carry)
+        U, V = carry[0], carry[1]
+    else:
+        U, V, _, _, _ = jax.lax.fori_loop(0, k, body, carry)
     return U, V
 
 

@@ -44,12 +44,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 from ..parallel.halo import EDGE_E, EDGE_N, EDGE_S, EDGE_W
 from ..parallel.shard_halo import ShardHaloProgram
 from .sphere import _read_strip_fact
 
 __all__ = [
     "make_tt_strip_exchange",
+    "make_tt_strip_exchange_many",
     "make_tt_sphere_advection_sharded",
     "make_tt_sphere_diffusion_sharded",
     "make_tt_sphere_swe_sharded",
@@ -77,6 +80,65 @@ def shard_factored_state(state, mesh, axis_name: str = "panel"):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), state)
 
 
+def make_tt_strip_exchange_many(axis_name: str = "panel"):
+    """Batched device-local strip exchange: ONE schedule, many fields.
+
+    Returns ``exchange_many(pairs) -> [(gS, gN, gW, gE), ...]`` over a
+    list of LOCAL one-face factor pairs ``(A (1, n, r_i), B (1, r_i,
+    n))``.  All fields' canonical depth-1 strips are stacked into a
+    single ``(P, 1, n)`` payload per stage, so the 4-stage race-free
+    schedule's ICI latency chain is paid ONCE for the whole field set
+    instead of once per field — the factored-tier face of the
+    overlapped-exchange redesign (``parallelization.overlap_exchange``):
+    the SWE step's four exchanges (h + three Cartesian velocity
+    components) collapse to one, and every ppermute is issued up front
+    where only the strip reconstructions (O(n r) matvecs) precede it,
+    so the collectives fly under the step's Khatri-Rao/rounding work.
+    Per-field ghost values are bitwise-identical to the per-field
+    exchange (a ppermute of stacked payloads IS the stack of per-field
+    ppermutes).
+    """
+    program = ShardHaloProgram(axis_name)
+    edge_sel = program.edge_sel            # (6, 4) int32
+    rev_sel = jnp.asarray(program.rev_sel)  # (6, 4) bool
+
+    def exchange_many(pairs):
+        for A, B in pairs:
+            if A.shape[0] != 1:
+                raise ValueError(
+                    f"panel-sharded TT exchange expects one face per "
+                    f"device (local face extent 1); got {A.shape[0]} — "
+                    "run the single-device tier for other layouts")
+        f = lax.axis_index(axis_name)
+        esel = edge_sel[f]                  # (4,) traced
+        rsel = rev_sel[f]
+        # All fields' four canonical (1, n) strips (h=1), reconstructed
+        # once from the factors: (P, 4, 1, n).
+        strips = jnp.stack([
+            jnp.stack([_read_strip_fact(A, B, 0, e, 1) for e in range(4)])
+            for A, B in pairs])
+        recv = jnp.zeros_like(strips)
+        for s, perm in enumerate(program.perms):
+            st = jnp.take(strips, esel[s], axis=1)       # (P, 1, n)
+            st = jnp.where(rsel[s], jnp.flip(st, axis=-1), st)
+            st = lax.ppermute(st, axis_name, perm)
+            # The strip received in stage s belongs to the same edge I
+            # exchanged (edge pairs are bidirectional on the cube edge).
+            recv = recv.at[:, esel[s]].set(st)
+        # Placement transforms of sphere._route_strips: S/N canonical,
+        # W/E transposed; leading face axis restored as 1.
+        out = []
+        for p in range(len(pairs)):
+            gS = recv[p, EDGE_S][None]             # (1, 1, n)
+            gN = recv[p, EDGE_N][None]
+            gW = jnp.swapaxes(recv[p, EDGE_W], -2, -1)[None]   # (1, n, 1)
+            gE = jnp.swapaxes(recv[p, EDGE_E], -2, -1)[None]
+            out.append((gS, gN, gW, gE))
+        return out
+
+    return exchange_many
+
+
 def make_tt_strip_exchange(axis_name: str = "panel"):
     """Device-local factored strip exchange for use inside shard_map.
 
@@ -88,40 +150,13 @@ def make_tt_strip_exchange(axis_name: str = "panel"):
     edge pair reverses and one joint ``ppermute`` moves all six strips
     at once.  Output blocks match :func:`..sphere.tt_strip_ghosts`
     exactly (same canonicalization and placement transforms, leading
-    face axis of 1).
+    face axis of 1).  The single-field form of
+    :func:`make_tt_strip_exchange_many`.
     """
-    program = ShardHaloProgram(axis_name)
-    edge_sel = program.edge_sel            # (6, 4) int32
-    rev_sel = jnp.asarray(program.rev_sel)  # (6, 4) bool
+    exchange_many = make_tt_strip_exchange_many(axis_name)
 
     def exchange(pair):
-        A, B = pair
-        if A.shape[0] != 1:
-            raise ValueError(
-                f"panel-sharded TT exchange expects one face per device "
-                f"(local face extent 1); got {A.shape[0]} — run the "
-                "single-device tier for other layouts")
-        f = lax.axis_index(axis_name)
-        esel = edge_sel[f]                  # (4,) traced
-        rsel = rev_sel[f]
-        # All four canonical (1, n) strips (h=1), reconstructed once.
-        strips = jnp.stack(
-            [_read_strip_fact(A, B, 0, e, 1) for e in range(4)])
-        recv = jnp.zeros_like(strips)
-        for s, perm in enumerate(program.perms):
-            st = jnp.take(strips, esel[s], axis=0)
-            st = jnp.where(rsel[s], jnp.flip(st, axis=-1), st)
-            st = lax.ppermute(st, axis_name, perm)
-            # The strip received in stage s belongs to the same edge I
-            # exchanged (edge pairs are bidirectional on the cube edge).
-            recv = recv.at[esel[s]].set(st)
-        # Placement transforms of sphere._route_strips: S/N canonical,
-        # W/E transposed; leading face axis restored as 1.
-        gS = recv[EDGE_S][None]             # (1, 1, n)
-        gN = recv[EDGE_N][None]
-        gW = jnp.swapaxes(recv[EDGE_W], -2, -1)[None]   # (1, n, 1)
-        gE = jnp.swapaxes(recv[EDGE_E], -2, -1)[None]
-        return gS, gN, gW, gE
+        return exchange_many([pair])[0]
 
     return exchange
 
@@ -146,7 +181,7 @@ def _shard_step(build_local, mesh, axis_name: str):
     # carry from replicated zeros, which the varying-manual-axes checker
     # rejects against the axis-varying loop outputs; the computation is
     # per-device-pure so the check adds nothing here.
-    return jax.shard_map(step_local, mesh=mesh,
+    return shard_map(step_local, mesh=mesh,
                          in_specs=spec, out_specs=spec, check_vma=False)
 
 
@@ -171,13 +206,22 @@ def make_tt_sphere_diffusion_sharded(grid, kappa, dt, rank, mesh,
 
 
 def make_tt_sphere_swe_sharded(grid, dt, rank, mesh,
-                               axis_name: str = "panel", **kw):
+                               axis_name: str = "panel",
+                               overlap_exchange: bool = False, **kw):
     """Panel-sharded :func:`..sphere_swe.make_tt_sphere_swe`.
 
     ``batch_rounding`` defaults to False here regardless of backend:
     the device-local operands are one face, where the zero-padding
     traffic of the batched ACA sweep loses (the measured trade in
     DESIGN.md is for 6-face operands on one chip).
+
+    ``overlap_exchange``: route the step's four per-field exchanges
+    (h + three Cartesian velocity components) through ONE batched
+    4-stage schedule issued up front
+    (:func:`make_tt_strip_exchange_many`) — the ICI latency chain is
+    paid once per step instead of four times, and the collectives
+    overlap the step's face-local Khatri-Rao/rounding work.  Ghost
+    values are bitwise-identical to the serialized default.
     """
     from .sphere_swe import make_tt_sphere_swe
 
@@ -187,6 +231,9 @@ def make_tt_sphere_swe_sharded(grid, dt, rank, mesh,
     # mesh inside a TPU-enabled process must keep the CPU path).
     kw.setdefault("rounding_backend",
                   mesh.devices.flat[0].platform)
+    if overlap_exchange:
+        kw.setdefault("strip_ghosts_many",
+                      make_tt_strip_exchange_many(axis_name))
     return _shard_step(
         partial(make_tt_sphere_swe, grid, dt, rank, **kw),
         mesh, axis_name)

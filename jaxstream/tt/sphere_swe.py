@@ -171,6 +171,7 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                        rounding: str = "aca",
                        rounding_backend: str | None = None,
                        strip_ghosts=None,
+                       strip_ghosts_many=None,
                        face_slice=None) -> Callable:
     """Jit-able factored-panel SWE step.
 
@@ -183,6 +184,16 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
     via the :mod:`..sphere_diffusion` pair machinery, reusing the ghost
     lines the velocity exchange already produced.  h stays undissipated
     (mass is untouched).  The dense twin applies identical terms.
+
+    ``strip_ghosts_many``: optional batched form of the exchange
+    injection — ``strip_ghosts_many(pairs) -> [ghosts, ...]`` for a
+    LIST of factor pairs.  The step fetches all four ghost sets (h +
+    three Cartesian velocity components) through one call, so a
+    sharded implementation can ship them over ONE up-front 4-stage
+    ppermute schedule instead of four sequential ones
+    (:func:`jaxstream.tt.shard.make_tt_strip_exchange_many`, gated by
+    ``parallelization.overlap_exchange``).  Defaults to a loop over
+    ``strip_ghosts`` — identical values either way.
 
     ``rounding``: ``'aca'`` (cross approximation, no factorization
     kernels — the speed tier) or ``'svd'`` (exact best-rank-k
@@ -221,6 +232,8 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
     eN = jnp.zeros((1, n), dtype).at[0, n - 1].set(1.0)
     if strip_ghosts is None:
         strip_ghosts = lambda q: tt_strip_ghosts(q, 1)
+    if strip_ghosts_many is None:
+        strip_ghosts_many = lambda qs: [strip_ghosts(q) for q in qs]
 
     lap_pairs = None
     if kappa != 0.0:
@@ -251,8 +264,9 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
         # backends with unreliable on-device linalg.  Handles the
         # 6-face batch natively (numpy stacked linalg): one round trip
         # per operand, not per face.
-        rnd_many = lambda ops: [tuple(host_svd_lowrank(A, B, rank))
-                                for A, B in ops]
+        rnd_many = lambda ops: [
+            tuple(host_svd_lowrank(A, B, rank, backend=rounding_backend))
+            for A, B in ops]
     elif rounding != "aca":
         raise ValueError(f"rounding must be 'aca', 'svd', 'rsvd' or "
                          f"'host_svd', got {rounding!r}")
@@ -291,11 +305,18 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                     (eN.T[None] * ones, N[:, None, :] * inv2d)]
 
         # --- ghost primitives: h strips + Cartesian velocity strips ---
-        hl = resampled_ghost_lines(strip_ghosts(hp), ridx, rwgt)
+        # One batched fetch for all four fields: the velocity payloads
+        # are depth-1 strips of the (un-rounded) Khatri-Rao pairs —
+        # O(n r r_c) strip reconstructions, no rounding in between — so
+        # a sharded strip_ghosts_many can put every ppermute on the
+        # wire before any of the step's heavy face-local work starts.
+        vcs = [stack_pairs([kr(S["aax"][c], uap), kr(S["abx"][c], ubp)])
+               for c in range(3)]
+        ghosts = strip_ghosts_many([hp] + vcs)
+        hl = resampled_ghost_lines(ghosts[0], ridx, rwgt)
         vl = {X: [] for X in _EDGES}
         for c in range(3):
-            vc = stack_pairs([kr(S["aax"][c], uap), kr(S["abx"][c], ubp)])
-            lc = resampled_ghost_lines(strip_ghosts(vc), ridx, rwgt)
+            lc = resampled_ghost_lines(ghosts[1 + c], ridx, rwgt)
             for X in _EDGES:
                 vl[X].append(lc[X])
         G = _ghost_composites(hl, vl, ES_l, gravity)

@@ -1,0 +1,183 @@
+"""Per-stage cube-edge exchange latency probes (library half).
+
+The race-free 4-stage schedule puts FOUR sequential ``ppermute``s on
+every SSPRK3 stage's critical path; the overlapped-exchange redesign
+(``parallelization.overlap_exchange``) exists to hide exactly that
+chain under the interior RHS kernel.  These probes make the chain —
+and the win — measurable, per stage:
+
+  * :func:`probe_exchange` — for each of the 4 schedule stages, a
+    jitted shard_map program chains ``iters`` back-to-back
+    ``ppermute``s of a real-sized ``(3, halo, n)`` payload (each hop
+    depends on the last, so wall time / iters is the per-stage
+    wire+dispatch latency — the same methodology as a ping-pong
+    NCCL/ICI probe), plus the production 4-stage exchange (rotation +
+    seam symmetrization included) chained the same way.
+  * :func:`probe_step_rates` — steady-state steps/s of the explicit
+    covariant face stepper, serialized vs overlapped schedule.
+
+Consumed by ``scripts/comm_probe.py`` (the CLI), ``bench.py``'s
+multichip section, and the driver's MULTICHIP dryrun gate.  On CPU the
+numbers characterize dispatch/copy structure, not ICI — the probes'
+reason to exist is running unchanged on a real slice.
+"""
+
+from __future__ import annotations
+
+from .profiling import median_chain_seconds
+
+__all__ = ["probe_exchange", "probe_step_rates", "run_default_probe",
+           "format_report"]
+
+
+def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0):
+    """Full probe suite with the shared device/size policy.
+
+    The one place the selection lives (CLI, bench multichip, dryrun
+    gate all call through here): the DEFAULT platform's devices when at
+    least 6 exist (a real slice measures real ICI), else 6 virtual CPU
+    devices (structural dispatch-level numbers, platform-tagged in the
+    report); face size ``n`` defaults to a production-ish 96 on real
+    accelerators and 16 on the CPU smoke.  Returns the result dict
+    (``n``, ``devices``, ``platform``, stage/exchange latencies, step
+    rates).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import EARTH_RADIUS
+    from ..geometry.cubed_sphere import build_grid
+    from ..parallel.mesh import setup_sharding
+
+    device_type = "default" if len(jax.devices()) >= 6 else "cpu"
+    setup = setup_sharding({"parallelization": {
+        "num_devices": 6, "device_type": device_type,
+        "use_shard_map": True}})
+    platform = setup.mesh.devices.flat[0].platform
+    n = n or (96 if platform != "cpu" else 16)
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    result = {"n": n, "devices": setup.num_devices, "platform": platform}
+    result.update(probe_exchange(grid, setup.mesh, iters=iters))
+    result.update(probe_step_rates(grid, setup, steps=steps))
+    return result
+
+
+def probe_exchange(grid, mesh, iters: int = 100):
+    """Per-stage + full-exchange latency on a ``(panel=6,1,1)`` mesh.
+
+    Returns ``{"stage_us": [4 floats], "exchange_us": float}`` —
+    median microseconds per chained iteration.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.shard_cov import (CovShardProgram,
+                                      make_cov_shard_exchange)
+    from .jax_compat import shard_map
+
+    n, halo, m = grid.n, grid.halo, grid.m
+    program = CovShardProgram(grid)
+    axis = program.axis_name
+    axes = mesh.axis_names
+    sh = NamedSharding(mesh, P(axes[0]))
+
+    stage_us = []
+    for s, perm in enumerate(program.perms):
+        def chain(x, _perm=perm):
+            for _ in range(iters):
+                x = lax.ppermute(x, axis, _perm)
+            return x
+
+        fn = jax.jit(shard_map(
+            chain, mesh=mesh, in_specs=P(axes[0]), out_specs=P(axes[0]),
+            check_vma=False))
+        x = jax.device_put(jnp.zeros((6, 3, halo, n), jnp.float32), sh)
+        stage_us.append(1e6 * median_chain_seconds(fn, (x,), iters))
+
+    # Full production exchange (ghost writes + rotations + seam sym),
+    # chained through its own output so each iteration depends on the
+    # last.
+    exchange = make_cov_shard_exchange(program)
+    tables = {k: jax.device_put(v, sh) for k, v in program.tables.items()}
+    ex_iters = max(1, iters // 10)
+
+    def chain_ex(h_blk, u_blk, t):
+        for _ in range(ex_iters):
+            h_blk, u_blk, ssn, swe = exchange(h_blk, u_blk, t)
+            h_blk = h_blk + ssn[:, :1, :1]
+        return h_blk
+
+    fn = jax.jit(shard_map(
+        chain_ex, mesh=mesh,
+        in_specs=(P(axes[0]), P(None, axes[0]),
+                  {k: P(axes[0]) for k in tables}),
+        out_specs=P(axes[0]), check_vma=False))
+    h_blk = jax.device_put(jnp.zeros((6, m, m), jnp.float32), sh)
+    u_blk = jax.device_put(jnp.zeros((2, 6, m, m), jnp.float32),
+                           NamedSharding(mesh, P(None, axes[0])))
+    ex_us = 1e6 * median_chain_seconds(
+        fn, (h_blk, u_blk, tables), ex_iters)
+    return {"stage_us": [round(u, 2) for u in stage_us],
+            "exchange_us": round(ex_us, 2)}
+
+
+def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50):
+    """Steady-state steps/s of the explicit covariant face stepper,
+    serialized vs overlapped.  Returns ``{"serialized_steps_per_sec",
+    "overlap_steps_per_sec", "overlap_speedup"}``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import EARTH_GRAVITY, EARTH_OMEGA
+    from ..models.shallow_water_cov import CovariantShallowWater
+    from ..parallel.mesh import shard_state
+    from ..parallel.shard_cov import make_sharded_cov_stepper
+    from ..physics.initial_conditions import williamson_tc2
+
+    h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                  omega=EARTH_OMEGA)
+    ss = shard_state(setup, model.initial_state(h_ext, v_ext))
+
+    rates = {}
+    for key, overlap in (("serialized", False), ("overlap", True)):
+        step = make_sharded_cov_stepper(model, setup, dt, overlap=overlap)
+
+        # fori_loop, not a Python-unrolled window: the step traces ONCE
+        # however long the window (at the real-slice configuration an
+        # unrolled 50-step program is hundreds of kernels/ppermutes and
+        # can take minutes to compile); the carry dependency preserves
+        # the chained-latency methodology.
+        @jax.jit
+        def run(y, _step=step):
+            return jax.lax.fori_loop(
+                0, steps, lambda i, yy: _step(yy, jnp.float32(0.0)), y)
+
+        sec = median_chain_seconds(run, (ss,), steps, reps=3)
+        rates[f"{key}_steps_per_sec"] = round(1.0 / sec, 2)
+    rates["overlap_speedup"] = round(
+        rates["overlap_steps_per_sec"]
+        / rates["serialized_steps_per_sec"], 4)
+    return rates
+
+
+def format_report(result: dict) -> str:
+    """One human-readable line per measurement (CI-log friendly)."""
+    plat = result.get("platform")
+    tag = f" [{plat}]" if plat else ""
+    lines = []
+    st = result.get("stage_us")
+    if st:
+        lines.append(f"comm_probe{tag}: per-stage exchange latency "
+                     + "  ".join(f"stage{i}={u:.1f}us"
+                                 for i, u in enumerate(st))
+                     + f"  full-exchange={result['exchange_us']:.1f}us")
+    if "serialized_steps_per_sec" in result:
+        lines.append(
+            f"comm_probe{tag}: steps/s "
+            f"serialized={result['serialized_steps_per_sec']:.1f} "
+            f"overlap={result['overlap_steps_per_sec']:.1f} "
+            f"(x{result['overlap_speedup']:.3f})")
+    return "\n".join(lines)

@@ -1,0 +1,52 @@
+"""Version-portability shims for the narrow band of JAX APIs we use.
+
+The repo targets current JAX (``jax.shard_map``, ``pltpu.CompilerParams``)
+but must also run on the 0.4.x line some images ship, where those spell
+``jax.experimental.shard_map.shard_map(..., check_rep=...)`` and
+``pltpu.TPUCompilerParams``.  Every call site routes through this module
+so the rest of the codebase is written against ONE (the current) API.
+
+Only strictly-renamed APIs belong here — behavioral divergences must be
+handled (and documented) at the call site.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["LEGACY_SHARD_MAP", "shard_map", "tpu_compiler_params"]
+
+#: True on the 0.4.x line.  Besides the spelling differences shimmed
+#: below, that line's XLA trips an hlo-verifier bug ("tile_assignment
+#: should have N devices") on ``vmap(while)`` bodies inside shard_map —
+#: loops that can be statically unrolled should be when this is set
+#: (see cross.aca_lowrank).
+LEGACY_SHARD_MAP = not hasattr(jax, "shard_map")
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.5: experimental namespace, check_vma spelled check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f=None, /, *, mesh, in_specs, out_specs,
+                  check_vma: bool = True, **kw):
+        """``jax.shard_map`` signature adapter over the experimental API.
+
+        ``check_vma`` (varying-manual-axes checking) is the renamed
+        ``check_rep``; axis semantics are identical for the SPMD
+        programs this repo builds (no auto axes used).
+        """
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kw)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` under either spelling."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -29,7 +29,7 @@ import jax
 __all__ = [
     "HardwareRoof", "TPU_V4_CLASS", "TPU_V5E", "TPU_V5P",
     "cost_analysis", "analytic_cov_step_cost", "roofline", "Roofline",
-    "StepTimer", "steady_state_rate", "trace",
+    "StepTimer", "median_chain_seconds", "steady_state_rate", "trace",
 ]
 
 
@@ -206,6 +206,27 @@ def roofline(fn: Callable, *args, seconds: float,
     """Roofline point for one measured execution of ``fn(*args)``."""
     c = cost_analysis(fn, *args, **kwargs)
     return Roofline(c["flops"], c["bytes"], seconds, roof)
+
+
+def median_chain_seconds(fn, args, iters: int, reps: int = 5):
+    """Median wall seconds of one blocking ``fn(*args)`` call, / iters.
+
+    The latency-chain methodology of scripts/comm_probe.py: ``fn`` must
+    internally chain ``iters`` DEPENDENT repetitions of the measured
+    operation (each iteration consuming the previous one's output), so
+    one dispatch amortizes over the chain and the per-iteration figure
+    is the operation's true serial latency — the ping-pong structure
+    every collective microbenchmark uses.  The first call (compile) is
+    discarded; the median of ``reps`` timed calls is returned.
+    """
+    jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2] / iters
 
 
 def steady_state_rate(run, y, k1: int = 3000, k2: int = 15000):

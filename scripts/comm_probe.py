@@ -1,0 +1,65 @@
+"""Per-stage cube-edge exchange latency microbenchmark (CLI).
+
+Thin command-line front end over :mod:`jaxstream.utils.comm_probe` —
+see that module for the methodology (chained-dependency ppermute ping
+per schedule stage, full production exchange, overlap on/off
+steady-state step rates).
+
+Usage::
+
+    python scripts/comm_probe.py [n] [--iters K] [--steps K] [--json]
+
+Device selection: uses the DEFAULT platform's devices when at least 6
+exist (a real slice measures real ICI); otherwise falls back to 6
+virtual CPU devices (structural dispatch-level numbers only — the
+report tags every line with the platform so the two are never
+confused).  For the CPU fallback the host-device-count flag must be in
+place before JAX's CPU backend initializes; running this file as
+__main__ sets it before importing jax.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    args = [a for a in sys.argv[1:]]
+    n_arg = int(args[0]) if args and args[0].isdigit() else 0
+    iters = 100
+    steps = 30
+    as_json = "--json" in args
+    for i, a in enumerate(args):
+        if a in ("--iters", "--steps"):
+            if i + 1 >= len(args) or not args[i + 1].isdigit():
+                print(f"usage: comm_probe.py [n] [--iters K] [--steps K] "
+                      f"[--json] ({a} needs an integer value)",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            if a == "--iters":
+                iters = int(args[i + 1])
+            else:
+                steps = int(args[i + 1])
+
+    from jaxstream.utils import comm_probe
+
+    result = comm_probe.run_default_probe(iters=iters, steps=steps,
+                                          n=n_arg)
+    if as_json:
+        print(json.dumps(result))
+    else:
+        print(comm_probe.format_report(result))
+    return result
+
+
+if __name__ == "__main__":
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    main()

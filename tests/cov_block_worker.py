@@ -101,4 +101,35 @@ for k in ("h", "u"):
     assert err < 2e-4, ("nu4", k, err)
 
 print("COV_BLOCK_NU4_OK", flush=True)
+
+# ---- overlapped exchange on the block tier -------------------------------
+# parallelization.overlap_exchange: every neighbor/cube ppermute issued
+# up front, interior-only kernel on the (n_loc-2h)^2 core under the
+# in-flight collectives, boundary-band pass on the received strips.
+# Parity budget: ulp-level vs the serialized stepper (the split tiles
+# the fused kernel's arithmetic exactly; XLA re-fusion moves single
+# f32 ulps per step — see tests/test_overlap_exchange.py).
+from jaxstream.parallel.shard_cov_block import (  # noqa: E402
+    make_sharded_cov_block_stepper,
+)
+
+model_o = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                omega=EARTH_OMEGA, b_ext=b_ext)
+s0 = model_o.initial_state(h_ext, v_ext)
+ss = shard_state(setup, s0)
+step_ser = make_sharded_cov_block_stepper(model_o, setup, 300.0,
+                                          overlap=False)
+step_ovl = make_sharded_cov_block_stepper(model_o, setup, 300.0,
+                                          overlap=True)
+a = b = ss
+for _ in range(5):
+    a = step_ser(a, 0.0)
+    b = step_ovl(b, 0.0)
+for k in ("h", "u"):
+    x = np.asarray(a[k], dtype=np.float64)
+    y = np.asarray(b[k], dtype=np.float64)
+    rel = np.max(np.abs(y - x)) / (np.max(np.abs(x)) + 1e-300)
+    assert rel <= 1e-6, ("overlap", k, rel)
+print("COV_BLOCK_OVERLAP_OK", flush=True)
+
 print("COV_BLOCK_OK", flush=True)

@@ -66,8 +66,17 @@ def _run_sub(script):
     return r.stdout
 
 
+@pytest.mark.slow
 def test_block_exchange_matches_reference_24dev():
-    """s=2 block exchange under shard_map == global-array exchange."""
+    """s=2 block exchange under shard_map == global-array exchange.
+
+    Slow-marked (suite-budget reclaim): the 24-virtual-device
+    subprocess pays a fresh JAX import + 24-way compile (~1 min wall),
+    and the same exchange is covered at full depth by the other
+    24-device parities already in the slow tier.  (The multi-process
+    Gloo pod test was audited for the same treatment and has carried
+    the slow mark since it landed.)
+    """
     out = _run_sub(r"""
 import jax
 jax.config.update('jax_platforms', 'cpu')
@@ -76,6 +85,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jaxstream.parallel.halo import make_halo_exchanger
 from jaxstream.parallel.shard_halo import make_block_halo_program
+from jaxstream.utils.jax_compat import shard_map
 
 n, halo, s = 8, 2, 2
 n_loc = n // s
@@ -104,7 +114,7 @@ for lead in [(), (3,)]:
         return local_exchange(embed_local(x), es, rs, ac)
 
     es, rs, ac = (program.edge_sel, program.rev_sel, program.active)
-    smapped = jax.shard_map(
+    smapped = shard_map(
         run, mesh=mesh,
         in_specs=(pspec, tspec, tspec, tspec),
         out_specs=pspec, check_vma=False)

@@ -11,6 +11,7 @@ import pytest
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
@@ -172,8 +173,15 @@ def test_cov_pallas_rhs_parity():
                                        err_msg=f"{case}:{k}")
 
 
+@pytest.mark.slow
 def test_cov_pallas_step_conserves_mass():
-    """Short f32 kernel-backed run: mass drift at roundoff level."""
+    """Short f32 kernel-backed run: mass drift at roundoff level.
+
+    Slow-marked with the other interpret-mode fused parities: the
+    10-step interpret compile is ~1 min of the fast suite's budget and
+    the fast tier keeps kernel-backed mass coverage via the sharded
+    conservation test (test_shard_cov.py) and the overlap parities.
+    """
     n = 16
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
     h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
@@ -580,3 +588,59 @@ def test_cov_fused_nu4_ppm_combination():
         b = np.asarray(out[k], dtype=np.float64)
         scale = np.max(np.abs(a)) + 1e-300
         np.testing.assert_allclose(b, a, atol=5e-4 * scale, err_msg=k)
+
+
+def test_cov_split_nu4_fast_smoke_and_filter_counter():
+    """Fast-tier coverage for the PRODUCTION nu4 default (the split
+    once-per-step del^4 filter — every other parity for it is
+    slow-marked, so ``-m 'not slow'`` used to ship the default
+    unexercised): one interpret-mode step at C8 against the in-stage
+    kernel pair at the damp-scale budget, plus the filter-cycling
+    counter semantics (interval > 1 carries an integer ``filter_k`` —
+    reconstructing the index from f32-accumulated ``t/dt`` can skip or
+    double-apply the filter, the bug this pins)."""
+    from jaxstream.ops.pallas.swe_cov import make_fused_ssprk3_cov_split_nu4
+    from jaxstream.physics.initial_conditions import galewsky
+
+    n = 8
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext = galewsky(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    nu4 = 1.0e15
+    pal = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                omega=EARTH_OMEGA, nu4=nu4,
+                                backend="pallas_interpret")
+    state = pal.initial_state(h_ext, v_ext)
+    dt = 300.0
+    y0 = pal.compact_state(state)
+    # Oracle: the classic jnp in-stage nu4 path (cheap to build — the
+    # in-stage KERNEL pair oracle is the slow tier's job); the split
+    # form differs from in-stage at the damp scale, same budget as
+    # test_cov_split_nu4_matches_stage.
+    ref = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                omega=EARTH_OMEGA, nu4=nu4)
+    ys = jax.jit(ref.make_step(dt))(state, 0.0)
+    yp = pal.make_fused_step(dt, nu4_mode="split")(dict(y0), 0.0)
+    area = np.asarray(grid.interior(grid.area), np.float64)
+    m0 = float((area * np.asarray(state["h"], np.float64)).sum())
+    for k in ("h", "u"):
+        a = np.asarray(ys[k], dtype=np.float64)
+        b = np.asarray(yp[k], dtype=np.float64)
+        assert np.all(np.isfinite(b)), k
+        scale = np.max(np.abs(a)) + 1e-300
+        np.testing.assert_allclose(b, a, atol=2e-3 * scale, err_msg=k)
+    mass = float((area * np.asarray(yp["h"], np.float64)).sum())
+    assert abs(mass - m0) / abs(m0) < 1e-5
+
+    # ---- interval=2 filter cycling rides the integer carry counter --
+    step2 = make_fused_ssprk3_cov_split_nu4(
+        grid, EARTH_GRAVITY, EARTH_OMEGA, dt, pal.b_ext, nu4,
+        interpret=True, interval=2)
+    with pytest.raises(ValueError, match="filter_k"):
+        step2(dict(y0), 0.0)  # un-seeded carry: clear error, not t/dt
+    ya = step2(dict(y0, filter_k=jnp.int32(0)), 0.0)   # no filter yet
+    yb = step2(dict(y0, filter_k=jnp.int32(1)), 0.0)   # filter applies
+    assert int(ya["filter_k"]) == 1
+    assert int(yb["filter_k"]) == 0
+    assert np.all(np.isfinite(np.asarray(yb["h"], np.float64)))
+    # The filtered (k=1) step must differ from the unfiltered (k=0) one.
+    assert not np.array_equal(np.asarray(ya["h"]), np.asarray(yb["h"]))

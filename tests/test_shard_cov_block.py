@@ -25,4 +25,6 @@ def test_cov_block_24_devices_matches_oracle():
     )
     tail = "\n".join((res.stdout + res.stderr).splitlines()[-15:])
     assert res.returncode == 0, f"worker failed:\n{tail}"
+    assert "COV_BLOCK_NU4_OK" in res.stdout, tail
+    assert "COV_BLOCK_OVERLAP_OK" in res.stdout, tail
     assert "COV_BLOCK_OK" in res.stdout, tail

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from jaxstream.utils.jax_compat import shard_map
 from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
 from jaxstream.geometry.cubed_sphere import build_grid
 from jaxstream.models.shallow_water import ShallowWater
@@ -40,7 +41,7 @@ def _exchange_via_shard_map(setup, field, n, halo):
     params = shard_params(setup, dict(program.params))
     pspecs = jax.tree_util.tree_map(_face_spec, params)
     fspec = _face_spec(field)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, f: lex(f, p["edge_sel"], p["rev_sel"]),
         mesh=setup.mesh, in_specs=(pspecs, fspec), out_specs=fspec,
         check_vma=False,

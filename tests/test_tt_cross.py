@@ -80,3 +80,21 @@ def test_swe_cross_rounding_tracks_dense():
         h_tt = np.asarray(sw_unfactor(q[0]))
         err = np.max(np.abs(h_tt - h_ref)) / np.max(np.abs(h_ref))
         assert err < 1e-6, (mode, err)
+
+
+def test_host_svd_lowrank_gates_unsupported_backends():
+    """host_svd_lowrank is a jax.pure_callback host round trip; plugin
+    backends without host-callback support must be refused at BUILD
+    time with remediation text, not fail obscurely mid-run."""
+    import jax.numpy as jnp
+    import pytest
+
+    from jaxstream.tt.cross import host_svd_lowrank
+
+    P = jnp.ones((6, 3), jnp.float32)
+    Q = jnp.ones((3, 6), jnp.float32)
+    with pytest.raises(NotImplementedError, match="host callbacks"):
+        host_svd_lowrank(P, Q, 2, backend="axon")
+    # The supported platforms still build and run (CPU here).
+    A, B = host_svd_lowrank(P, Q, 2, backend="cpu")
+    assert A.shape == (6, 2) and B.shape == (2, 6)

@@ -12,6 +12,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from jaxstream.utils.jax_compat import shard_map
 from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
 from jaxstream.geometry.cubed_sphere import build_grid
 from jaxstream.physics import initial_conditions as ics
@@ -62,7 +63,7 @@ def test_sharded_strip_exchange_matches_global():
     ref = tt_strip_ghosts(q, 1)
 
     exchange = make_tt_strip_exchange()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         exchange, mesh=mesh,
         in_specs=jax.sharding.PartitionSpec("panel"),
         out_specs=jax.sharding.PartitionSpec("panel"))
