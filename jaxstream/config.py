@@ -58,6 +58,17 @@ class ParallelConfig:
     # split path is parity-tested against the serialized default on all
     # tiers; default off so the serialized exchange stays the reference.
     overlap_exchange: bool = False
+    # Temporal halo blocking: run `temporal_block` SSPRK3 steps per
+    # compiled block.  On the explicit one-face-per-device tier this is
+    # the deep-halo form — ONE exchange of width 3*k*halo strips per
+    # block, then 3*k exchange-free RK stages on shrinking windows
+    # (redundant ghost-band compute instead of collectives; seam values
+    # are then face-local continuations, consistent to the stencil's own
+    # O(d^2) — see docs/USAGE.md "Temporal halo blocking" for when k > 1
+    # loses).  On the single-device fused, block-mesh, and factored TT
+    # tiers the k steps are fused exactly (unchanged exchange data, one
+    # dispatch per block).  Default 1 = the serialized reference path.
+    temporal_block: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -168,7 +168,8 @@ class CovariantShallowWater(SWEBase):
                         carry_dtype=None, h_offset: float = 0.0,
                         h_scale: float = 1.0, u_scale: float = 1.0,
                         _ablate_seam: bool = False,
-                        nu4_mode: str = "split"):
+                        nu4_mode: str = "split",
+                        temporal_block: int = 1):
         """Fused SSPRK3: one Pallas kernel per stage (halo fill in-kernel,
         edge rotations/symmetrization on a packed strip carry,
         :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
@@ -186,13 +187,31 @@ class CovariantShallowWater(SWEBase):
         carry — cast the :meth:`compact_state` output to match.  bf16
         halves carry DMA; compute stays f32 (accuracy trade measured in
         DESIGN.md).  ``_ablate_seam`` disables seam imposition — for
-        perf measurement only (breaks conservation)."""
+        perf measurement only (breaks conservation).
+
+        ``temporal_block = k > 1``: the returned step advances k fused
+        SSPRK3 steps per call (``parallelization.temporal_block``) —
+        bitwise-identical to k separate calls on every path (the strip
+        routes are face-local on one device), with a ``steps_per_call``
+        attribute so integrators can account for it."""
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
         if nu4_mode not in ("split", "stage"):
             raise ValueError(f"nu4_mode must be 'split' or 'stage', "
                              f"got {nu4_mode!r}")
+        if temporal_block < 1:
+            raise ValueError(
+                f"temporal_block must be >= 1, got {temporal_block}")
         interpret = self.backend == "pallas_interpret"
+
+        def _blocked(step1):
+            if temporal_block == 1:
+                return step1
+            from ..stepping import blocked
+
+            step = blocked(step1, temporal_block, dt)
+            step.steps_per_call = temporal_block
+            return step
         if self.nu4 != 0.0:
             if not compact:
                 raise ValueError("nu4 > 0 requires the compact carry")
@@ -206,19 +225,20 @@ class CovariantShallowWater(SWEBase):
 
             mk = (make_fused_ssprk3_cov_split_nu4 if nu4_mode == "split"
                   else make_fused_ssprk3_cov_nu4)
-            return mk(
+            return _blocked(mk(
                 self.grid, self.gravity, self.omega, dt, self.b_ext,
                 self.nu4, scheme=self.scheme, limiter=self.limiter,
                 interpret=interpret,
-            )
+            ))
         from ..ops.pallas.swe_cov import (
-            make_fused_ssprk3_cov_compact, make_fused_ssprk3_cov_inkernel)
+            make_fused_ssprk3_cov_inkernel, make_fused_ssprk3_cov_multistep)
 
         if compact:
             import jax.numpy as jnp
 
-            return make_fused_ssprk3_cov_compact(
+            step = make_fused_ssprk3_cov_multistep(
                 self.grid, self.gravity, self.omega, dt, self.b_ext,
+                temporal_block,
                 scheme=self.scheme, limiter=self.limiter,
                 interpret=interpret,
                 carry_dtype=(jnp.float32 if carry_dtype is None
@@ -226,15 +246,18 @@ class CovariantShallowWater(SWEBase):
                 h_offset=h_offset, h_scale=h_scale, u_scale=u_scale,
                 seam=not _ablate_seam,
             )
+            if temporal_block > 1:
+                step.steps_per_call = temporal_block
+            return step
         if (carry_dtype is not None or h_offset or h_scale != 1.0
                 or u_scale != 1.0 or _ablate_seam):
             raise ValueError("carry_dtype/h_offset/u_scale/_ablate_seam "
                              "require the compact carry")
-        return make_fused_ssprk3_cov_inkernel(
+        return _blocked(make_fused_ssprk3_cov_inkernel(
             self.grid, self.gravity, self.omega, dt, self.b_ext,
             scheme=self.scheme, limiter=self.limiter,
             interpret=interpret,
-        )
+        ))
 
     def initial_state(self, h_ext, v_ext) -> State:
         """From extended Cartesian fields (the IC functions' output)."""

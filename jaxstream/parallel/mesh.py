@@ -47,6 +47,7 @@ class ShardingSetup:
     sx: int
     use_shard_map: bool = False
     overlap_exchange: bool = False
+    temporal_block: int = 1
 
     @property
     def scalar_spec(self) -> P:
@@ -121,11 +122,15 @@ def setup_sharding(config: Any = None) -> ShardingSetup:
             device_type=block.get("device_type", "cpu"),
             use_shard_map=block.get("use_shard_map", False),
             overlap_exchange=block.get("overlap_exchange", False),
+            temporal_block=block.get("temporal_block", 1),
         )
 
     t = par.tiles_per_edge
     if t < 1:
         raise ValueError(f"tiles_per_edge must be >= 1, got {t}")
+    if par.temporal_block < 1:
+        raise ValueError(
+            f"temporal_block must be >= 1, got {par.temporal_block}")
     num_tiles = 6 * t * t
     d = par.num_devices
     if d > num_tiles:
@@ -143,7 +148,8 @@ def setup_sharding(config: Any = None) -> ShardingSetup:
 
     if d == 1:
         log.info("sharding: single device (no mesh)")
-        return ShardingSetup(mesh=None, num_devices=1, panel=1, sy=1, sx=1)
+        return ShardingSetup(mesh=None, num_devices=1, panel=1, sy=1, sx=1,
+                             temporal_block=par.temporal_block)
 
     p, sy, sx = _factor_mesh(d, t)
     devs = np.array(_pick_devices(par.device_type, d)).reshape(p, sy, sx)
@@ -154,7 +160,8 @@ def setup_sharding(config: Any = None) -> ShardingSetup:
     )
     return ShardingSetup(mesh=mesh, num_devices=d, panel=p, sy=sy, sx=sx,
                          use_shard_map=par.use_shard_map,
-                         overlap_exchange=par.overlap_exchange)
+                         overlap_exchange=par.overlap_exchange,
+                         temporal_block=par.temporal_block)
 
 
 def shard_state(setup: ShardingSetup, state):

@@ -50,7 +50,8 @@ from ..geometry.cubed_sphere import FACE_AXES
 from .halo import read_strip, write_strip
 
 __all__ = ["CovShardProgram", "make_cov_shard_exchange",
-           "make_cov_shard_exchange_phases", "make_sharded_cov_stepper"]
+           "make_cov_shard_exchange_phases", "make_sharded_cov_stepper",
+           "make_sharded_cov_deep_stepper", "deep_extend_static"]
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
 
@@ -297,7 +298,258 @@ def make_cov_shard_exchange(program: CovShardProgram):
     return exchange
 
 
-def make_sharded_cov_stepper(model, setup, dt: float, overlap=None):
+def deep_extend_static(grid, field_ext, depth: int):
+    """Re-extend a static ``(6, M, M)`` field to ghost ``depth``.
+
+    The deep-halo blocked stepper's orography prep: interior values are
+    re-embedded at the deeper ring, edge ghosts filled by the plain
+    copy exchange at ``depth`` (the same continuation-point assignment
+    the state exchange uses), corners by the face-local average.  Pure
+    and cheap; run once at stepper-build time.
+    """
+    from .halo import make_halo_exchanger
+
+    n = grid.n
+    if field_ext is None:
+        return jnp.zeros((6, n + 2 * depth, n + 2 * depth), jnp.float32)
+    b_int = grid.interior(field_ext)
+    pad = [(0, 0)] * (b_int.ndim - 2) + [(depth, depth), (depth, depth)]
+    return make_halo_exchanger(n, depth)(jnp.pad(b_int, pad))
+
+
+def make_sharded_cov_deep_stepper(model, setup, dt: float,
+                                  temporal_block: int, overlap=None):
+    """Temporal halo blocking on the one-face-per-device tier.
+
+    ``block(state, t) -> state`` advancing ``temporal_block = k`` SSPRK3
+    steps per call with ONE deep halo exchange per block: the 4
+    race-free ppermute stages ship ``(3, 3*k*halo, n)`` strips (same
+    wire bytes per simulated step as the serialized path — 3k h-deep
+    exchanges collapse into one 3kh-deep exchange — but the per-stage
+    ICI latency chain is paid once per k steps instead of 12 times per
+    step), and the 3k RK stages then run exchange-free on shrinking
+    windows: stage i computes a ``(n + 2*(D - (i+1)h))^2`` window from
+    the ``(n + 2*(D - i*h))^2`` one, ``D = 3*k*halo`` — redundant
+    ghost-band compute instead of collectives (Putman & Lin 2007's
+    ghost-consumption argument applied across stages).
+
+    Composes with ``parallelization.overlap_exchange``: the block's one
+    deep exchange is issued through the start/finish phase split, and
+    with the flag on, stage 0's ghost-free ``(n-2h)^2`` interior core
+    is computed between the phases (it reads no exchanged value), so
+    the 4-ppermute chain flies under it; the rest of stage 0 is then
+    four rectangular ring windows stitched around the core — the PR-1
+    interior/band tiling generalized to the deep window (ulp-level vs
+    the single-window evaluation, the established split budget).
+
+    Approximation contract (why this tier is opt-in while the fused
+    k-step tiers are exact): panel-seam ghosts are face-local
+    *continuations* — the deep copy assigns neighbor values to
+    continuation points whose mismatch grows with depth, the band then
+    evolves under THIS panel's metric, and the bitwise seam
+    symmetrization is dropped (each side would compute it from its own
+    drifting band copy anyway).  All three effects are the same O(d^2)
+    class as the k=1 path's own ghost resampling, so the blocked
+    trajectory is consistent to truncation — but NOT to the 1e-6
+    ulp-budget the exact tiers hold, and cross-seam mass conservation
+    degrades from roundoff to truncation level.  Corner patches (three
+    panels meet; no unique continuation exists) use the face-local
+    edge-ghost average of :func:`jaxstream.parallel.halo._fill_corners`
+    at depth D.  docs/USAGE.md "Temporal halo blocking" quantifies the
+    redundant-compute fraction ``((n + 2*3kh)^2 - n^2) / n^2`` per
+    first stage and when k > 1 loses.
+    """
+    from ..geometry.cubed_sphere import build_grid
+    from ..ops.pallas.swe_cov import rhs_core_cov
+    from ..ops.pallas.swe_rhs import coord_rows, pick_recon
+    from ..ops.pallas.swe_step import SSPRK3_COEFFS
+    from .halo import _fill_corners
+
+    grid = model.grid
+    n, h = grid.n, grid.halo
+    k = int(temporal_block)
+    if k < 2:
+        raise ValueError(
+            f"deep stepper needs temporal_block >= 2, got {k} "
+            "(k=1 is make_sharded_cov_stepper's serialized path)")
+    S = 3  # SSPRK3 stages per step; each consumes `halo` of validity
+    D = S * k * h
+    if n < D:
+        raise ValueError(
+            f"temporal_block={k} needs n >= 3*k*halo = {D} (deep strips "
+            f"are read from the interior), got n={n}")
+    if float(getattr(model, "nu4", 0.0)) != 0.0:
+        raise ValueError(
+            "temporal_block > 1 on the face tier supports nu4 = 0 only "
+            "(the del^4 refill would need its own deep exchange)")
+    if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
+        raise ValueError(
+            f"deep blocked stepper needs a (panel=6, 1, 1) mesh; got "
+            f"panel={setup.panel}, y={setup.sy}, x={setup.sx}")
+    mesh = setup.mesh
+
+    # Deep-grid program: CovShardProgram is depth-agnostic — built on a
+    # halo=D grid it yields D-deep rotation tables and the same 4-stage
+    # schedule, so the exchange phases are reused verbatim.
+    gdeep = build_grid(n, halo=D, radius=float(grid.radius),
+                       dtype=jnp.float32)
+    program = CovShardProgram(gdeep)
+    ex_start, ex_finish = make_cov_shard_exchange_phases(program)
+
+    xr, xfr, yc, yfc, _ = coord_rows(n, D)
+    b_deep = deep_extend_static(grid, model.b_ext, D)
+    frames_z = jnp.asarray(
+        np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+
+    d = float(grid.dalpha)
+    kw = dict(halo=h, d=d, radius=float(grid.radius),
+              gravity=model.gravity, omega=model.omega)
+    # One reconstruction partial per stage output size (3k shrinking
+    # windows); all windows are square so one recon serves both axes.
+    recons = [pick_recon(model.scheme, h, n + 2 * (D - (i + 1) * h),
+                         model.limiter) for i in range(S * k)]
+    if overlap is None:
+        overlap = bool(getattr(setup, "overlap_exchange", False))
+    if overlap:
+        # Stage-0 split extents: interior core (n-2h)^2 plus the ring's
+        # S/N rows (depth D, full width) and W/E columns.
+        no = n + 2 * (D - h)
+        recon_core = pick_recon(model.scheme, h, n - 2 * h, model.limiter)
+        recon_D = pick_recon(model.scheme, h, D, model.limiter)
+        recon_no = pick_recon(model.scheme, h, no, model.limiter)
+    (_, _), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+
+    axes = mesh.axis_names
+    pstate = {"h": P(axes[0]), "u": P(None, axes[0])}
+    ptab = {kk: P(axes[0]) for kk in program.tables}
+
+    def crop(x, c):
+        return x[..., c:x.shape[-2] - c, c:x.shape[-1] - c]
+
+    def body(state, tabs, fz, b_loc):
+        def embed(x):
+            pad = [(0, 0)] * (x.ndim - 2) + [(D, D), (D, D)]
+            return jnp.pad(x, pad)
+
+        h_e = embed(state["h"])              # (1, n+2D, n+2D)
+        u_e = embed(state["u"])
+        fz3 = (fz[0, 0, 0], fz[0, 0, 1], fz[0, 0, 2])
+        b_l = b_loc[0]
+        core = None
+        if overlap:
+            # Wire first: stage 0's ghost-free (n-2h)^2 core reads only
+            # interior data, so it runs under the in-flight deep
+            # exchange (the PR-1 overlap schedule, once per block).
+            recvs = ex_start(h_e, u_e, tabs)
+            sl_i = slice(D, D + n)
+            core = rhs_core_cov(
+                fz3, xr[:, sl_i], xfr[:, sl_i], yc[sl_i], yfc[sl_i],
+                state["h"][0], state["u"][0, 0], state["u"][1, 0],
+                b_l[sl_i, sl_i], None, None,
+                n=(n - 2 * h, n - 2 * h), recon=recon_core, **kw)
+            h_e, u_e, _, _ = ex_finish(h_e, u_e, recvs)
+        else:
+            h_e, u_e, _, _ = ex_finish(h_e, u_e,
+                                       ex_start(h_e, u_e, tabs))
+        h_e = _fill_corners(h_e, D, n)
+        u_e = _fill_corners(u_e, D, n)
+
+        hc, uac, ubc = h_e[0], u_e[0, 0], u_e[1, 0]
+        stage = 0
+
+        def rhs_win(hf, ua, ub, i):
+            # Validity entering stage i is D - i*h: the operand window
+            # is the whole current array; coordinates/orography slice to
+            # the matching deep-extended offsets.
+            off = i * h
+            m_in = n + 2 * (D - i * h)
+            nv = m_in - 2 * h
+            sl = slice(off, off + m_in)
+            return rhs_core_cov(
+                fz3, xr[:, sl], xfr[:, sl], yc[sl], yfc[sl],
+                hf, ua, ub, b_l[sl, sl], None, None,
+                n=(nv, nv), recon=recons[i], **kw)
+
+        def rhs_stage0_ring(hf, ua, ub):
+            # Finish stage 0 around the precomputed core: four
+            # rectangular windows tile the deep ring exactly (S/N rows
+            # own the corners; W/E take the remaining rows), stitched
+            # into the full (n + 2*(D-h))^2 stage-0 tendency — the
+            # make_cov_rhs_band_local tiling at deep width.
+            def win(r0, r1, c0, c1, ry, rx):
+                # r0..c1 are OUTPUT ranges in deep coordinates; the
+                # operand window extends `h` beyond on every side.
+                sr = slice(r0 - h, r1 + h)
+                sc = slice(c0 - h, c1 + h)
+                return rhs_core_cov(
+                    fz3, xr[:, sc], xfr[:, sc], yc[sr], yfc[sr],
+                    hf[sr, sc], ua[sr, sc], ub[sr, sc], b_l[sr, sc],
+                    None, None, n=(r1 - r0, c1 - c0), recon=(ry, rx),
+                    **kw)
+
+            r_lo, r_hi = D + h, D + n - h       # core output rows
+            dS = win(h, D + h, h, h + no, recon_D, recon_no)
+            dN = win(D + n - h, n + 2 * D - h, h, h + no,
+                     recon_D, recon_no)
+            dW = win(r_lo, r_hi, h, D + h, recon_core, recon_D)
+            dE = win(r_lo, r_hi, D + n - h, n + 2 * D - h,
+                     recon_core, recon_D)
+
+            def stitch(i):
+                mid = jnp.concatenate([dW[i], core[i], dE[i]], axis=-1)
+                return jnp.concatenate([dS[i], mid, dN[i]], axis=-2)
+
+            return stitch(0), stitch(1), stitch(2)
+
+        for j in range(k):
+            h0, ua0, ub0 = hc, uac, ubc
+            if j == 0 and overlap:
+                dh, dua, dub = rhs_stage0_ring(hc, uac, ubc)
+            else:
+                dh, dua, dub = rhs_win(hc, uac, ubc, stage)
+            hc = crop(h0, h) + dt * dh
+            uac = crop(ua0, h) + dt * dua
+            ubc = crop(ub0, h) + dt * dub
+            stage += 1
+            dh, dua, dub = rhs_win(hc, uac, ubc, stage)
+            hc = a2 * crop(h0, 2 * h) + b2 * (crop(hc, h) + dt * dh)
+            uac = a2 * crop(ua0, 2 * h) + b2 * (crop(uac, h) + dt * dua)
+            ubc = a2 * crop(ub0, 2 * h) + b2 * (crop(ubc, h) + dt * dub)
+            stage += 1
+            dh, dua, dub = rhs_win(hc, uac, ubc, stage)
+            hc = a3 * crop(h0, 3 * h) + b3 * (crop(hc, h) + dt * dh)
+            uac = a3 * crop(ua0, 3 * h) + b3 * (crop(uac, h) + dt * dua)
+            ubc = a3 * crop(ub0, 3 * h) + b3 * (crop(ubc, h) + dt * dub)
+            stage += 1
+
+        return {"h": hc[None], "u": jnp.stack([uac[None], ubc[None]])}
+
+    shard_body = shard_map(
+        body, mesh=mesh,
+        in_specs=(pstate, ptab, P(axes[0]), P(axes[0])),
+        out_specs=pstate,
+        check_vma=False,
+    )
+
+    tables = {
+        kk: jax.device_put(v, NamedSharding(mesh, P(axes[0])))
+        for kk, v in program.tables.items()
+    }
+    fz_sh = jax.device_put(frames_z, NamedSharding(mesh, P(axes[0])))
+    b_sh = jax.device_put(b_deep, NamedSharding(mesh, P(axes[0])))
+
+    jitted = jax.jit(lambda state: shard_body(state, tables, fz_sh, b_sh))
+
+    def step(state, t):
+        del t
+        return jitted(state)
+
+    step.steps_per_call = k
+    return step
+
+
+def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
+                             temporal_block: int = 1):
     """``step(state, t) -> state`` for the covariant model under shard_map.
 
     Requires a ``(panel=6, 1, 1)`` mesh (one face per device).  State is
@@ -316,7 +568,18 @@ def make_sharded_cov_stepper(model, setup, dt: float, overlap=None):
     kernels' surroundings — <= 1e-6 relative over the multi-step parity
     runs in tests/test_overlap_exchange.py); only the collective/compute
     overlap differs.
+
+    ``temporal_block = k > 1`` dispatches to
+    :func:`make_sharded_cov_deep_stepper`: k steps per call behind ONE
+    3*k*halo-deep exchange (see its docstring for the approximation
+    contract; the k=1 path here stays the bitwise reference).  The
+    ``overlap`` argument is forwarded — there it schedules stage-0's
+    ghost-free core under the deep exchange.
     """
+    if temporal_block > 1:
+        return make_sharded_cov_deep_stepper(model, setup, dt,
+                                             temporal_block,
+                                             overlap=overlap)
     grid = model.grid
     if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
         raise ValueError(

@@ -413,7 +413,8 @@ def make_block_corner_fill(program: CovBlockProgram):
     return corner_fill
 
 
-def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None):
+def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None,
+                                   temporal_block: int = 1):
     """``step(state, t) -> state`` for the covariant model on (6, s, s).
 
     State is the usual interior pytree ``{"h": (6, n, n),
@@ -429,7 +430,22 @@ def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None):
     collectives are in flight, and finish with the boundary-band pass
     (interior/band split of :mod:`jaxstream.ops.pallas.swe_cov`, same
     schedule as the face tier).  Requires ``n_loc > 2*halo``.
+
+    ``temporal_block = k > 1``: k steps fused inside ONE shard_map body
+    per call (``steps_per_call`` attribute set) — one SPMD dispatch per
+    k steps, exchange data unchanged.  Exact by construction (same ops
+    per step; XLA cross-step re-fusion moves single ulps, the same
+    <= 1e-6 multi-step budget as the overlap split), unlike the face
+    tier's deep-halo form: the block mesh's sub-panel seams would be
+    exact under redundant recompute, but its cube-edge blocks carry the
+    panel-seam O(d^2) continuation problem plus an along-edge widening
+    of every deep strip into the neighbor blocks — the fused form keeps
+    this tier in the bitwise-reference family instead (composes with
+    ``overlap``, which already hides most of the per-stage latency).
     """
+    if temporal_block < 1:
+        raise ValueError(
+            f"temporal_block must be >= 1, got {temporal_block}")
     grid = model.grid
     s = setup.sy
     if setup.mesh is None or setup.panel != 6 or setup.sy != setup.sx \
@@ -548,7 +564,9 @@ def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None):
                 du = du - nu4 * l2u
             return dh, du
 
-        return ssprk3_sharded_body(f, state, dt)
+        for _ in range(temporal_block):
+            state = ssprk3_sharded_body(f, state, dt)
+        return state
 
     shard_body = shard_map(
         body, mesh=mesh,
@@ -563,9 +581,12 @@ def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None):
     }
     b_sh = jax.device_put(b_blocks, NamedSharding(mesh, P(*axes)))
 
-    @jax.jit
+    jitted = jax.jit(lambda state: shard_body(state, tables, b_sh))
+
     def step(state, t):
         del t
-        return shard_body(state, tables, b_sh)
+        return jitted(state)
 
+    if temporal_block > 1:
+        step.steps_per_call = temporal_block
     return step

@@ -35,13 +35,23 @@ __all__ = ["make_sharded_stepper", "make_stepper_for", "shard_params"]
 
 
 def make_stepper_for(model, setup, example_state, dt: float,
-                     scheme: str = "ssprk3"):
+                     scheme: str = "ssprk3", temporal_block: int = None):
     """Dispatch on the config's ``use_shard_map`` flag.
 
     Explicit ppermute path when requested (and the mesh fits), otherwise
     the GSPMD path: plain ``jit`` over the model step — sharded inputs
     make XLA infer the collectives (the reference's implicit model).
+
+    ``temporal_block = k > 1`` (default: the setup's) returns a stepper
+    advancing k steps per call (its ``steps_per_call`` attribute says
+    how many): the deep-halo blocked stepper on the covariant face tier
+    (ONE 3*k*halo-deep exchange per block), exact k-step fusion
+    elsewhere.  Callers that count steps must honor ``steps_per_call``.
     """
+    if temporal_block is None:
+        k = 1 if setup is None else getattr(setup, "temporal_block", 1)
+    else:
+        k = temporal_block
     if setup is not None and setup.use_shard_map:
         if hasattr(model, "exchange_u"):
             # Covariant formulation: its explicit paths carry the
@@ -58,10 +68,33 @@ def make_stepper_for(model, setup, example_state, dt: float,
                     f"only; got scheme={scheme!r}"
                 )
             if setup.panel == 6 and setup.sy == setup.sx and setup.sy > 1:
-                return make_sharded_cov_block_stepper(model, setup, dt)
-            return make_sharded_cov_stepper(model, setup, dt)
+                return make_sharded_cov_block_stepper(
+                    model, setup, dt, temporal_block=k)
+            return make_sharded_cov_stepper(model, setup, dt,
+                                            temporal_block=k)
+        if k > 1:
+            raise ValueError(
+                "parallelization.temporal_block > 1 is wired for the "
+                "covariant explicit tiers, the single-device fused "
+                "stepper, the GSPMD path, and the factored TT tier; the "
+                "Cartesian explicit shard_map path steps serially — set "
+                "temporal_block: 1 or model.name: shallow_water_cov")
         return make_sharded_stepper(model, setup, example_state, dt, scheme)
-    return jax.jit(model.make_step(dt, scheme))
+    base = model.make_step(dt, scheme)
+    if k > 1:
+        # GSPMD path: exact k-step fusion under one jit — one dispatch
+        # per block, collectives unchanged (XLA may still pipeline
+        # across the fused steps).
+        from ..stepping import blocked
+
+        jitted = jax.jit(blocked(base, k, dt))
+
+        def step(y, t):
+            return jitted(y, t)
+
+        step.steps_per_call = k
+        return step
+    return jax.jit(base)
 
 
 def _grid_arrays(grid: CubedSphereGrid):

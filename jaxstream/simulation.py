@@ -103,7 +103,7 @@ class Simulation:
                 self.state = shard_state(self.setup, self.state)
             self._step = make_stepper_for(
                 self.model, self.setup, self.state, cfg.time.dt,
-                cfg.time.scheme
+                cfg.time.scheme, temporal_block=par.temporal_block,
             )
         # Single-device Pallas SWE runs use the fused extended-state
         # SSPRK3 stepper (the bench flagship): extend/restrict happen once
@@ -114,6 +114,7 @@ class Simulation:
         m = self.model
         # nu4 > 0 is fused only where the model declares support (the
         # covariant model's two-kernel del^4 stage pair).
+        tb = cfg.parallelization.temporal_block
         if (self.setup is None and cfg.time.scheme == "ssprk3"
                 and getattr(m, "backend", "").startswith("pallas")
                 and (getattr(m, "nu4", 0.0) == 0.0
@@ -122,13 +123,29 @@ class Simulation:
             try:
                 # The stepper and its carry-prep are a matched pair: pick
                 # both here so they cannot drift apart.
+                def _mk_fused():
+                    """Fused step honoring temporal_block where the
+                    model knows the knob (covariant multistep factory);
+                    exact k-step fusion via stepping.blocked otherwise."""
+                    try:
+                        return m.make_fused_step(cfg.time.dt,
+                                                 temporal_block=tb)
+                    except TypeError:
+                        step = m.make_fused_step(cfg.time.dt)
+                        if tb > 1:
+                            from .stepping import blocked
+
+                            step = blocked(step, tb, cfg.time.dt)
+                            step.steps_per_call = tb
+                        return step
+
                 if hasattr(m, "compact_state"):
-                    self._fused_step = m.make_fused_step(cfg.time.dt)
+                    self._fused_step = _mk_fused()
                     self._fused_prep = m.compact_state
                     log.info("using compact fused SSPRK3 stepper "
                              "(interior-only carry)")
                 else:
-                    self._fused_step = m.make_fused_step(cfg.time.dt)
+                    self._fused_step = _mk_fused()
                     self._fused_prep = functools.partial(
                         m.extend_state, with_strips=True)
                     log.info("using fused extended-state SSPRK3 stepper")
@@ -366,6 +383,7 @@ class Simulation:
             else:
                 tt_step = make_tt_sphere_advection(
                     g, fields["wind"], tc.dt, rank, scheme=tc.scheme)
+            tt_step = self._tt_block(tt_step, par.temporal_block)
             keys = ("q",)
             pairs = (fac(g.interior(fields["q"])),)
             single = True
@@ -377,6 +395,7 @@ class Simulation:
             else:
                 tt_step = make_tt_sphere_diffusion(
                     g, p.diffusivity, tc.dt, rank, scheme=tc.scheme)
+            tt_step = self._tt_block(tt_step, par.temporal_block)
             keys = ("T",)
             pairs = (fac(g.interior(fields["T"])),)
             single = True
@@ -384,7 +403,8 @@ class Simulation:
             b_ext = fields["b_ext"]
             kw = dict(hs=b_ext, omega=p.omega, gravity=p.gravity,
                       scheme=tc.scheme, kappa=m.tt_kappa,
-                      rounding=rounding)
+                      rounding=rounding,
+                      temporal_block=par.temporal_block)
             tt_step = (make_tt_sphere_swe_sharded(
                            g, tc.dt, rank, mesh,
                            overlap_exchange=par.overlap_exchange, **kw)
@@ -420,7 +440,27 @@ class Simulation:
                     for kk, pair in zip(keys, out)
                     for i, s in ((0, "__ttA"), (1, "__ttB"))}
 
+        # The SWE factory fuses temporal_block steps internally (and
+        # _tt_block does it for the linear families), so the flat-dict
+        # wrapper advances that many steps per call.
+        if par.temporal_block > 1:
+            step.steps_per_call = par.temporal_block
         return state, step
+
+    @staticmethod
+    def _tt_block(tt_step, k: int):
+        """Exact k-step fusion of a single-pair TT step (the linear
+        families' form of ``parallelization.temporal_block`` — the SWE
+        factories take the knob natively)."""
+        if k <= 1:
+            return tt_step
+
+        def block(pair):
+            for _ in range(k):
+                pair = tt_step(pair)
+            return pair
+
+        return block
 
     def _tt_dense(self, key: str):
         """Reconstruct one factored prognostic to a dense (6, n, n)."""
@@ -501,12 +541,25 @@ class Simulation:
         fn = self._segment_cache.get(k)
         if fn is None:
             dt = self.config.time.dt
+            active = (self._fused_step if self._fused_step is not None
+                      else self._step)
+            # Temporal blocking: a blocked stepper advances
+            # steps_per_call steps per call, so the integrator runs
+            # k/spc calls of span spc*dt each (t advances identically
+            # — the block's sub-step times are sequential dt adds).
+            spc = getattr(active, "steps_per_call", 1)
+            if k % spc:
+                raise ValueError(
+                    f"segment of {k} steps is not a multiple of "
+                    f"parallelization.temporal_block={spc}; make "
+                    "io.history_stride/io.checkpoint_stride and the "
+                    "total step count multiples of temporal_block")
             if self._fused_step is not None:
                 m, fused = self.model, self._fused_step
 
                 prep = self._fused_prep
 
-                def fn(y, t, _k=k, _dt=dt):
+                def fn(y, t, _k=k // spc, _dt=dt * spc):
                     y_c = prep(y)
                     y_c, t = integrate(fused, y_c, t, _k, _dt)
                     return m.restrict_state(y_c), t
@@ -518,8 +571,8 @@ class Simulation:
                 # ~us-scale copies are invisible but a 4x-traced step
                 # graph would multiply compile time.
                 fn = jax.jit(
-                    lambda y, t: integrate(self._step, y, t, k, dt,
-                                           unroll=1)
+                    lambda y, t: integrate(self._step, y, t, k // spc,
+                                           dt * spc, unroll=1)
                 )
             self._segment_cache[k] = fn
         self.state, t = fn(self.state, self.t)

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import tree_util as jtu
 
-__all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper", "integrate"]
+__all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper",
+           "blocked", "integrate"]
 
 
 def _axpy(y, dt, k):
@@ -63,6 +64,29 @@ def make_stepper(rhs: Callable, dt: float, scheme: str = "ssprk3") -> Callable:
         return stepper(rhs, y, t, dt)
 
     return step
+
+
+def blocked(step: Callable, k: int, dt: float) -> Callable:
+    """Fuse ``k`` steps into one ``block(y, t) -> y`` (temporal blocking).
+
+    The returned block advances ``k * dt`` of model time per call with
+    sequential ``t + i*dt`` sub-step times — numerically identical to k
+    separate calls (same ops, same order); only the dispatch granularity
+    changes.  Drive it with ``integrate(block, y, t, nblocks, k*dt)``.
+    The per-tier *deep-halo* temporal blocking (exchange amortization,
+    ``parallelization.temporal_block``) lives in the sharded steppers;
+    this is the exact fusion used where the exchange data is local.
+    """
+    if k < 1:
+        raise ValueError(f"blocked: k must be >= 1, got {k}")
+
+    def block(y, t):
+        for _ in range(k):
+            y = step(y, t)
+            t = t + dt  # sequential adds: bitwise-identical to k calls
+        return y
+
+    return block
 
 
 def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,

@@ -207,8 +207,16 @@ def make_tt_sphere_diffusion_sharded(grid, kappa, dt, rank, mesh,
 
 def make_tt_sphere_swe_sharded(grid, dt, rank, mesh,
                                axis_name: str = "panel",
-                               overlap_exchange: bool = False, **kw):
+                               overlap_exchange: bool = False,
+                               temporal_block: int = 1, **kw):
     """Panel-sharded :func:`..sphere_swe.make_tt_sphere_swe`.
+
+    ``temporal_block = k > 1`` fuses k steps *inside* the shard_map
+    body (``parallelization.temporal_block``): one SPMD dispatch per k
+    steps.  The exchange/rounding sequence is unchanged (the TT ghost
+    lines are rebuilt from the rounded factors every stage either way),
+    so reconstructed fields stay bitwise-equal to k=1 — on this tier
+    temporal blocking amortizes dispatch, not collectives.
 
     ``batch_rounding`` defaults to False here regardless of backend:
     the device-local operands are one face, where the zero-padding
@@ -235,5 +243,6 @@ def make_tt_sphere_swe_sharded(grid, dt, rank, mesh,
         kw.setdefault("strip_ghosts_many",
                       make_tt_strip_exchange_many(axis_name))
     return _shard_step(
-        partial(make_tt_sphere_swe, grid, dt, rank, **kw),
+        partial(make_tt_sphere_swe, grid, dt, rank,
+                temporal_block=temporal_block, **kw),
         mesh, axis_name)

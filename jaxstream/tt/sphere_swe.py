@@ -172,7 +172,8 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
                        rounding_backend: str | None = None,
                        strip_ghosts=None,
                        strip_ghosts_many=None,
-                       face_slice=None) -> Callable:
+                       face_slice=None,
+                       temporal_block: int = 1) -> Callable:
     """Jit-able factored-panel SWE step.
 
     State: ``((hA, hB), (uaA, uaB), (ubA, ubB))`` — rank-``rank``
@@ -194,6 +195,15 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
     (:func:`jaxstream.tt.shard.make_tt_strip_exchange_many`, gated by
     ``parallelization.overlap_exchange``).  Defaults to a loop over
     ``strip_ghosts`` — identical values either way.
+
+    ``temporal_block = k > 1``: the returned step advances k SSPRK3
+    steps per call, fused inside one trace (under the sharded tier's
+    shard_map that is ONE collective program per k steps —
+    ``parallelization.temporal_block``).  The factored state is rounded
+    back to rank ``rank`` after every stage either way, so the k-step
+    block evaluates the *identical* exchange/rounding sequence as k
+    separate calls — reconstructed fields are bitwise-equal to the k=1
+    reference (tests/test_temporal_block.py).
 
     ``rounding``: ``'aca'`` (cross approximation, no factorization
     kernels — the speed tier) or ``'svd'`` (exact best-rank-k
@@ -372,7 +382,19 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
             [((scale * dt) * a, b) for a, b in pairs])
         return dh, sc(dua), sc(dub)
 
-    return _factored_stepper_multi(rhs3, rnd_many, scheme)
+    step1 = _factored_stepper_multi(rhs3, rnd_many, scheme)
+    if temporal_block == 1:
+        return step1
+    if temporal_block < 1:
+        raise ValueError(
+            f"temporal_block must be >= 1, got {temporal_block}")
+
+    def block(state):
+        for _ in range(temporal_block):
+            state = step1(state)
+        return state
+
+    return block
 
 
 def make_dense_sphere_swe(grid, dt: float,

@@ -26,11 +26,65 @@ from __future__ import annotations
 
 from .profiling import median_chain_seconds
 
-__all__ = ["probe_exchange", "probe_step_rates", "run_default_probe",
-           "format_report"]
+__all__ = ["temporal_block_plan", "probe_exchange", "probe_step_rates",
+           "run_default_probe", "format_report"]
+
+#: ppermutes per SSPRK3 step of the serialized face-tier exchange:
+#: 4 race-free schedule stages x 3 RK stages.
+SERIALIZED_PPERMUTES_PER_STEP = 12
 
 
-def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0):
+def temporal_block_plan(n: int, halo: int, temporal_block: int,
+                        rk_stages: int = 3) -> dict:
+    """Static exchange/compute accounting of temporal halo blocking.
+
+    Pure arithmetic — no devices, no jax — shared by the CLI report,
+    ``bench.py``'s JSON, and the non-slow schedule test.  For a k-step
+    block on the one-face-per-device tier the deep halo width is
+    ``D = rk_stages * k * halo`` (each RK stage consumes ``halo`` of
+    ghost validity) and stage ``i`` (0-based, of ``rk_stages*k``)
+    computes an ``(n + 2*(D - (i+1)*halo))^2`` window:
+
+    * ``ppermutes_per_step``: 4 schedule stages once per block / k
+      steps, vs the serialized 12 per step.
+    * ``payload_elems_per_step``: per-edge payload elements shipped per
+      simulated step each way (3 fields x D-deep x n strips once per
+      block) — equal to the serialized path's by construction (the k
+      exchanges collapse, they don't shrink).
+    * ``redundant_compute_fraction``: extra RHS cell-evaluations vs the
+      k=1 path, averaged over the block's ``rk_stages*k`` windows —
+      ``mean_i ((n + 2*(D - (i+1)h))^2 - n^2) / n^2``; the first-stage
+      (worst) term is ``((n + 2*(D - h))^2 - n^2) / n^2``, bounded by
+      the docs' headline ``((n + 2kh)^2 - n^2) / n^2`` with ``k``
+      counting exchange-free RHS evaluations (``rk_stages *
+      temporal_block``).
+    """
+    if temporal_block < 1:
+        raise ValueError(
+            f"temporal_block must be >= 1, got {temporal_block}")
+    k = temporal_block
+    D = rk_stages * k * halo
+    stages = rk_stages * k
+    windows = [n + 2 * (D - (i + 1) * halo) for i in range(stages)]
+    redundant = [(w * w - n * n) / float(n * n) for w in windows]
+    return {
+        "temporal_block": k,
+        "deep_halo_width": D,
+        "fits": n >= D,
+        "ppermutes_per_step": 4.0 / k,
+        "serialized_ppermutes_per_step": float(
+            SERIALIZED_PPERMUTES_PER_STEP),
+        "exchange_latency_ratio": (4.0 / k)
+            / SERIALIZED_PPERMUTES_PER_STEP,
+        "payload_elems_per_step": 3 * D * n * 4 / k,
+        "redundant_compute_fraction": sum(redundant) / stages,
+        "redundant_compute_fraction_first_stage": redundant[0],
+    }
+
+
+def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
+                      temporal_block: int = 0, devices=None,
+                      plan_only: bool = False):
     """Full probe suite with the shared device/size policy.
 
     The one place the selection lives (CLI, bench multichip, dryrun
@@ -40,25 +94,48 @@ def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0):
     report); face size ``n`` defaults to a production-ish 96 on real
     accelerators and 16 on the CPU smoke.  Returns the result dict
     (``n``, ``devices``, ``platform``, stage/exchange latencies, step
-    rates).
+    rates, and — when ``temporal_block > 1`` — the blocked-vs-serialized
+    rates plus the :func:`temporal_block_plan` accounting).
+
+    ``devices``: explicit device list overriding the policy (tests pass
+    fakes with a ``platform`` attribute).  ``plan_only=True`` stops
+    after the device/size/schedule selection — everything that needs no
+    compilation — so the plumbing is testable in milliseconds.
     """
-    import jax
+    from ..geometry.connectivity import build_connectivity, build_schedule
+
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    device_type = "default" if len(devices) >= 6 else "cpu"
+    platform = (getattr(devices[0], "platform", "cpu")
+                if device_type == "default" else "cpu")
+    n = n or (96 if platform != "cpu" else 16)
+    halo = 2
+    result = {"n": n, "devices": 6, "platform": platform}
+    result["schedule_stages"] = len(build_schedule(build_connectivity()))
+    if temporal_block > 1:
+        result["temporal_block_plan"] = temporal_block_plan(
+            n, halo, temporal_block)
+    if plan_only:
+        return result
+
     import jax.numpy as jnp
 
     from ..config import EARTH_RADIUS
     from ..geometry.cubed_sphere import build_grid
     from ..parallel.mesh import setup_sharding
 
-    device_type = "default" if len(jax.devices()) >= 6 else "cpu"
     setup = setup_sharding({"parallelization": {
         "num_devices": 6, "device_type": device_type,
         "use_shard_map": True}})
     platform = setup.mesh.devices.flat[0].platform
-    n = n or (96 if platform != "cpu" else 16)
-    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
-    result = {"n": n, "devices": setup.num_devices, "platform": platform}
+    result["platform"] = platform
+    grid = build_grid(n, halo=halo, radius=EARTH_RADIUS, dtype=jnp.float32)
     result.update(probe_exchange(grid, setup.mesh, iters=iters))
-    result.update(probe_step_rates(grid, setup, steps=steps))
+    result.update(probe_step_rates(grid, setup, steps=steps,
+                                   temporal_block=temporal_block))
     return result
 
 
@@ -123,10 +200,14 @@ def probe_exchange(grid, mesh, iters: int = 100):
             "exchange_us": round(ex_us, 2)}
 
 
-def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50):
+def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50,
+                     temporal_block: int = 0):
     """Steady-state steps/s of the explicit covariant face stepper,
     serialized vs overlapped.  Returns ``{"serialized_steps_per_sec",
-    "overlap_steps_per_sec", "overlap_speedup"}``."""
+    "overlap_steps_per_sec", "overlap_speedup"}`` — plus, when
+    ``temporal_block = k > 1`` fits the grid, the deep-halo blocked
+    stepper's rate (``temporal_block_steps_per_sec`` counts SIMULATED
+    steps: blocks/s x k) and its speedup over the serialized path."""
     import jax
     import jax.numpy as jnp
 
@@ -141,9 +222,18 @@ def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50):
                                   omega=EARTH_OMEGA)
     ss = shard_state(setup, model.initial_state(h_ext, v_ext))
 
+    variants = [("serialized", dict(overlap=False)),
+                ("overlap", dict(overlap=True))]
+    k = temporal_block
+    with_blocked = k > 1 and grid.n >= 3 * k * grid.halo
+    if with_blocked:
+        variants.append(("temporal_block", dict(temporal_block=k)))
+
     rates = {}
-    for key, overlap in (("serialized", False), ("overlap", True)):
-        step = make_sharded_cov_stepper(model, setup, dt, overlap=overlap)
+    for key, kw in variants:
+        step = make_sharded_cov_stepper(model, setup, dt, **kw)
+        spc = getattr(step, "steps_per_call", 1)
+        ncalls = max(1, steps // spc)
 
         # fori_loop, not a Python-unrolled window: the step traces ONCE
         # however long the window (at the real-slice configuration an
@@ -151,15 +241,22 @@ def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50):
         # can take minutes to compile); the carry dependency preserves
         # the chained-latency methodology.
         @jax.jit
-        def run(y, _step=step):
+        def run(y, _step=step, _ncalls=ncalls):
             return jax.lax.fori_loop(
-                0, steps, lambda i, yy: _step(yy, jnp.float32(0.0)), y)
+                0, _ncalls, lambda i, yy: _step(yy, jnp.float32(0.0)), y)
 
-        sec = median_chain_seconds(run, (ss,), steps, reps=3)
+        sec = median_chain_seconds(run, (ss,), ncalls * spc, reps=3)
         rates[f"{key}_steps_per_sec"] = round(1.0 / sec, 2)
     rates["overlap_speedup"] = round(
         rates["overlap_steps_per_sec"]
         / rates["serialized_steps_per_sec"], 4)
+    if with_blocked:
+        rates["temporal_block_speedup"] = round(
+            rates["temporal_block_steps_per_sec"]
+            / rates["serialized_steps_per_sec"], 4)
+    elif k > 1:
+        rates["temporal_block_skipped"] = (
+            f"n={grid.n} < 3*k*halo={3 * k * grid.halo}")
     return rates
 
 
@@ -175,9 +272,26 @@ def format_report(result: dict) -> str:
                                  for i, u in enumerate(st))
                      + f"  full-exchange={result['exchange_us']:.1f}us")
     if "serialized_steps_per_sec" in result:
-        lines.append(
+        line = (
             f"comm_probe{tag}: steps/s "
             f"serialized={result['serialized_steps_per_sec']:.1f} "
             f"overlap={result['overlap_steps_per_sec']:.1f} "
             f"(x{result['overlap_speedup']:.3f})")
+        if "temporal_block_steps_per_sec" in result:
+            line += (
+                f" temporal_block="
+                f"{result['temporal_block_steps_per_sec']:.1f} "
+                f"(x{result['temporal_block_speedup']:.3f})")
+        lines.append(line)
+    tb = result.get("temporal_block_plan")
+    if tb:
+        lines.append(
+            f"comm_probe{tag}: temporal_block k={tb['temporal_block']} "
+            f"deep_halo={tb['deep_halo_width']} "
+            f"exchanges/step={tb['ppermutes_per_step']:.2f} "
+            f"(vs {tb['serialized_ppermutes_per_step']:.0f}) "
+            f"redundant_compute="
+            f"{tb['redundant_compute_fraction']:.3f}"
+            f" (first stage "
+            f"{tb['redundant_compute_fraction_first_stage']:.3f})")
     return "\n".join(lines)

@@ -1,0 +1,98 @@
+"""Tier-hygiene lint for the test suite (CI/tooling satellite, round 6).
+
+Two invariants keep the fast tier-1 gate honest, both enforced here and
+run *inside* the gate via ``tests/test_check_tiers.py`` (the tier-1
+command is plain pytest, so a non-slow test wrapping this lint makes
+every gate run self-checking):
+
+1. **Marker registry**: every ``pytest.mark.<name>`` used under
+   ``tests/`` must be registered in ``pytest.ini``'s ``markers`` section
+   (or be a pytest builtin).  An unregistered marker is how a test
+   silently escapes the ``-m "not slow"`` deselection — e.g. a typo'd
+   ``@pytest.mark.slwo`` runs a 40 s parity in every fast gate.
+
+2. **Subprocess device tests are slow**: any test module that launches a
+   multi-device SUBPROCESS worker (the 24-virtual-device block-mesh and
+   multi-process pod parities — detected as ``subprocess`` usage next to
+   a worker-script reference or a forced host-device count) must carry
+   ``pytest.mark.slow``.  These are the suite's most expensive items
+   (~40-90 s each); the fast tier's time budget assumes they stay out.
+
+Exit status 0 = clean; 1 = violations (listed on stdout).
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import re
+import sys
+
+#: Markers pytest defines itself — always legal without registration.
+BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+}
+
+_MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_][A-Za-z0-9_]*)")
+_WORKER_RE = re.compile(
+    r"(_worker\.py|worker\.py\b|xla_force_host_platform_device_count)")
+
+
+def registered_markers(pytest_ini: str) -> set:
+    """Marker names registered in pytest.ini's ``markers`` option."""
+    cp = configparser.ConfigParser()
+    cp.read(pytest_ini)
+    raw = cp.get("pytest", "markers", fallback="")
+    names = set()
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        names.add(line.split(":", 1)[0].strip())
+    return names
+
+
+def lint_file(path: str, allowed: set):
+    """Yield violation strings for one test module."""
+    with open(path) as fh:
+        src = fh.read()
+    rel = os.path.relpath(path)
+    used = set(_MARK_RE.findall(src))
+    for name in sorted(used - allowed):
+        yield (f"{rel}: pytest.mark.{name} is not registered in "
+               f"pytest.ini (registered: {sorted(allowed - BUILTIN_MARKERS)}"
+               f" + builtins) — unregistered markers escape the "
+               f"-m 'not slow' tier gate")
+    if "subprocess" in src and _WORKER_RE.search(src) \
+            and "slow" not in used:
+        yield (f"{rel}: launches a multi-device subprocess worker but "
+               f"carries no pytest.mark.slow — subprocess device tests "
+               f"must stay out of the fast tier")
+
+
+def main(repo_root: str = None) -> int:
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    ini = os.path.join(root, "pytest.ini")
+    if not os.path.exists(ini):
+        print(f"check_tiers: no pytest.ini at {ini}")
+        return 1
+    allowed = registered_markers(ini) | BUILTIN_MARKERS
+    tests_dir = os.path.join(root, "tests")
+    violations = []
+    for name in sorted(os.listdir(tests_dir)):
+        if not name.endswith(".py") or not name.startswith("test_"):
+            continue
+        violations += list(lint_file(os.path.join(tests_dir, name),
+                                     allowed))
+    for v in violations:
+        print("check_tiers:", v)
+    if not violations:
+        print(f"check_tiers: OK ({len(allowed - BUILTIN_MARKERS)} "
+              f"registered markers; all subprocess device tests slow)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

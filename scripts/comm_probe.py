@@ -7,7 +7,12 @@ steady-state step rates).
 
 Usage::
 
-    python scripts/comm_probe.py [n] [--iters K] [--steps K] [--json]
+    python scripts/comm_probe.py [n] [--iters K] [--steps K]
+                                 [--temporal-block K] [--json]
+
+``--temporal-block K`` adds the deep-halo blocked stepper's rate and
+the static exchanges/step + redundant-compute accounting
+(:func:`jaxstream.utils.comm_probe.temporal_block_plan`).
 
 Device selection: uses the DEFAULT platform's devices when at least 6
 exist (a real slice measures real ICI); otherwise falls back to 6
@@ -31,23 +36,28 @@ def main():
     n_arg = int(args[0]) if args and args[0].isdigit() else 0
     iters = 100
     steps = 30
+    temporal_block = 0
     as_json = "--json" in args
     for i, a in enumerate(args):
-        if a in ("--iters", "--steps"):
+        if a in ("--iters", "--steps", "--temporal-block"):
             if i + 1 >= len(args) or not args[i + 1].isdigit():
                 print(f"usage: comm_probe.py [n] [--iters K] [--steps K] "
-                      f"[--json] ({a} needs an integer value)",
+                      f"[--temporal-block K] [--json] "
+                      f"({a} needs an integer value)",
                       file=sys.stderr)
                 raise SystemExit(2)
             if a == "--iters":
                 iters = int(args[i + 1])
-            else:
+            elif a == "--steps":
                 steps = int(args[i + 1])
+            else:
+                temporal_block = int(args[i + 1])
 
     from jaxstream.utils import comm_probe
 
     result = comm_probe.run_default_probe(iters=iters, steps=steps,
-                                          n=n_arg)
+                                          n=n_arg,
+                                          temporal_block=temporal_block)
     if as_json:
         print(json.dumps(result))
     else:

@@ -132,4 +132,26 @@ for k in ("h", "u"):
     assert rel <= 1e-6, ("overlap", k, rel)
 print("COV_BLOCK_OVERLAP_OK", flush=True)
 
+# ---- temporal blocking on the block tier ---------------------------------
+# parallelization.temporal_block: k steps fused inside ONE shard_map body
+# per call (exchange data unchanged — the block tier keeps the exact,
+# bitwise-family form; the deep-halo form is the face tier's).  Parity
+# budget: <= 1e-6 vs the serialized stepper (same ops per step; XLA
+# cross-step re-fusion moves single ulps, the overlap tests' budget).
+kb = 2
+step_blk = make_sharded_cov_block_stepper(model_o, setup, 300.0,
+                                          temporal_block=kb)
+assert step_blk.steps_per_call == kb
+a = b = ss
+for _ in range(2):                       # 2 blocks = 4 steps
+    b = step_blk(b, 0.0)
+for _ in range(2 * kb):
+    a = step_ser(a, 0.0)
+for k in ("h", "u"):
+    x = np.asarray(a[k], dtype=np.float64)
+    y = np.asarray(b[k], dtype=np.float64)
+    rel = np.max(np.abs(y - x)) / (np.max(np.abs(x)) + 1e-300)
+    assert rel <= 1e-6, ("temporal_block", k, rel)
+print("COV_BLOCK_TEMPORAL_OK", flush=True)
+
 print("COV_BLOCK_OK", flush=True)

@@ -1,0 +1,74 @@
+"""The tier-hygiene lint runs inside the tier-1 gate (round 6).
+
+``scripts/check_tiers.py`` asserts (1) every marker used under tests/
+is registered in pytest.ini and (2) multi-device subprocess parities
+carry ``slow``.  Wrapping it in a non-slow test makes the fast gate
+self-checking — a typo'd marker or an unmarked subprocess test fails
+the very gate it would otherwise silently bloat.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_tiers  # noqa: E402
+
+
+def test_repo_is_tier_clean(capsys):
+    rc = check_tiers.main(REPO)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_unregistered_marker_detected(tmp_path):
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Concatenated so THIS module doesn't itself trip the lint's regex.
+    (tests / "test_x.py").write_text(
+        "import pytest\n@pytest." + "mark.slwo\ndef test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+
+
+def test_subprocess_worker_without_slow_detected(tmp_path):
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_w.py").write_text(
+        "import subprocess\n"
+        "def test_pod():\n"
+        "    subprocess.run(['python', 'mh_worker.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module with the marker is clean.
+    (tests / "test_w.py").write_text(
+        "import subprocess, pytest\n"
+        "@pytest.mark.slow\n"
+        "def test_pod():\n"
+        "    subprocess.run(['python', 'mh_worker.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
+def test_builtin_markers_allowed(tmp_path):
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_b.py").write_text(
+        "import pytest\n"
+        "@pytest.mark.parametrize('x', [1])\n"
+        "@pytest.mark.skipif(False, reason='no')\n"
+        "def test_a(x):\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
+@pytest.mark.parametrize("name", ["slow"])
+def test_registered_markers_parsed(name):
+    allowed = check_tiers.registered_markers(
+        os.path.join(REPO, "pytest.ini"))
+    assert name in allowed

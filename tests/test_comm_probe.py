@@ -1,0 +1,101 @@
+"""Non-slow comm_probe schedule/plumbing coverage (round-6 satellite).
+
+Until now the probe suite was exercised only by the multichip dryrun
+(subprocess, slow): these tests pin the device/size selection policy,
+the temporal-block exchange accounting, and the report formatting —
+everything that needs no compilation — via ``plan_only=True`` and fake
+device lists, in milliseconds.
+"""
+
+import pytest
+
+from jaxstream.utils.comm_probe import (SERIALIZED_PPERMUTES_PER_STEP,
+                                        format_report, run_default_probe,
+                                        temporal_block_plan)
+
+
+class FakeDev:
+    def __init__(self, platform="tpu"):
+        self.platform = platform
+
+
+def test_plan_only_accelerator_policy():
+    """>= 6 devices of a real platform: default devices, production n."""
+    out = run_default_probe(devices=[FakeDev("tpu")] * 8, plan_only=True)
+    assert out["platform"] == "tpu"
+    assert out["n"] == 96
+    assert out["devices"] == 6
+    assert out["schedule_stages"] == 4     # race-free edge coloring
+
+
+def test_plan_only_cpu_fallback_policy():
+    """< 6 devices: the 6-virtual-CPU smoke at the small face size."""
+    out = run_default_probe(devices=[FakeDev("tpu")], plan_only=True)
+    assert out["platform"] == "cpu"
+    assert out["n"] == 16
+
+
+def test_plan_only_never_builds_mesh(monkeypatch):
+    """plan_only must not touch jax device/mesh machinery at all —
+    that is what makes it safe for the fast tier."""
+    import jaxstream.parallel.mesh as mesh_mod
+
+    def boom(*a, **k):
+        raise AssertionError("plan_only built a mesh")
+
+    monkeypatch.setattr(mesh_mod, "setup_sharding", boom)
+    out = run_default_probe(devices=[FakeDev("cpu")] * 6, plan_only=True,
+                            temporal_block=2)
+    assert "temporal_block_plan" in out
+
+
+def test_temporal_block_plan_accounting():
+    n, halo, k = 96, 2, 4
+    plan = temporal_block_plan(n, halo, k)
+    # Deep width: 3 RK stages x k steps x halo.
+    assert plan["deep_halo_width"] == 3 * k * halo
+    assert plan["fits"]
+    # 4 schedule ppermutes once per k steps vs 12 per step.
+    assert plan["ppermutes_per_step"] == pytest.approx(4.0 / k)
+    assert plan["serialized_ppermutes_per_step"] == \
+        SERIALIZED_PPERMUTES_PER_STEP
+    # Wire bytes per simulated step are conserved: the k exchanges
+    # collapse into one deep one, they don't shrink.
+    assert plan["payload_elems_per_step"] == pytest.approx(
+        SERIALIZED_PPERMUTES_PER_STEP * 3 * halo * n)
+    # Redundant fraction: mean over shrinking windows of
+    # ((n + 2*(D - (i+1)h))^2 - n^2) / n^2; first stage is the worst.
+    D = plan["deep_halo_width"]
+    first = ((n + 2 * (D - halo)) ** 2 - n * n) / n**2
+    assert plan["redundant_compute_fraction_first_stage"] == \
+        pytest.approx(first)
+    assert 0 < plan["redundant_compute_fraction"] < first
+
+
+def test_temporal_block_plan_k1_degenerates():
+    plan = temporal_block_plan(48, 2, 1)
+    assert plan["ppermutes_per_step"] == 4.0
+    assert plan["deep_halo_width"] == 6
+    with pytest.raises(ValueError):
+        temporal_block_plan(48, 2, 0)
+
+
+def test_plan_does_not_fit_small_faces():
+    plan = temporal_block_plan(16, 2, 4)     # D = 24 > 16
+    assert not plan["fits"]
+
+
+def test_format_report_includes_temporal_block_lines():
+    result = {
+        "platform": "cpu", "n": 16, "devices": 6,
+        "stage_us": [1.0, 2.0, 3.0, 4.0], "exchange_us": 10.0,
+        "serialized_steps_per_sec": 5.0, "overlap_steps_per_sec": 6.0,
+        "overlap_speedup": 1.2,
+        "temporal_block_steps_per_sec": 7.5,
+        "temporal_block_speedup": 1.5,
+        "temporal_block_plan": temporal_block_plan(16, 2, 2),
+    }
+    rep = format_report(result)
+    assert "temporal_block=7.5 (x1.500)" in rep
+    assert "exchanges/step=2.00" in rep
+    assert "redundant_compute=" in rep

@@ -27,4 +27,5 @@ def test_cov_block_24_devices_matches_oracle():
     assert res.returncode == 0, f"worker failed:\n{tail}"
     assert "COV_BLOCK_NU4_OK" in res.stdout, tail
     assert "COV_BLOCK_OVERLAP_OK" in res.stdout, tail
+    assert "COV_BLOCK_TEMPORAL_OK" in res.stdout, tail
     assert "COV_BLOCK_OK" in res.stdout, tail
