@@ -20,7 +20,13 @@ docstring and DESIGN.md "The time step".  The ``variants`` JSON field
 records the mixed16-carry rate (h int16 + u bf16, default gate band),
 the dt=90 empirical-max-stable rate (own 15-day gate each run), and
 the Galewsky-nu4 rate (day-6 physics gate); the dt=60-equivalent rate
-is a top-level field.
+is a top-level field.  The ``ensemble`` field reports the batched
+perturbed-IC ensemble section (``bench_ensemble``, TC5 C96 at the
+CFL-matched dt=300 — the members-x-moderate-resolution regime where
+batching pays): aggregate sim-days/sec/chip at B in {1, 4, 16} with
+B-scaled rooflines and the B=1 bitwise acceptance check.  ``python bench.py --smoke`` runs the
+C24 bitrot canary instead (no gates; wired into tier-1 via
+tests/test_bench_smoke.py).
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ def log(*a):
 
 
 def _roofline_json(steps_per_sec: float, n: int, scale: float = 1.0,
-                   bytes_scale: float = 1.0):
+                   bytes_scale: float = 1.0, ensemble: int = 1):
     """Roofline numbers for one covariant-fused-stepper rate, as JSON.
 
     The analytic kernel count against the VPU roof (Pallas custom calls
@@ -48,6 +54,11 @@ def _roofline_json(steps_per_sec: float, n: int, scale: float = 1.0,
     ``scale`` adjusts flops AND bytes for non-covariant rungs, while
     ``bytes_scale`` adjusts bytes alone (the 16-bit carry variants halve
     field DMA but not flops — coarse: strips/orography stay f32).
+    ``ensemble = B``: ``steps_per_sec`` counts BATCHED ensemble steps
+    (each advancing all B members) and the analytic cost scales flops
+    AND bytes by B together — intensity unchanged — so ensemble
+    variants report truthful throughput instead of a B-inflated AI
+    (jaxstream.utils.profiling.analytic_cov_step_cost's ensemble note).
     Returns None when the profiling helpers are unavailable (never
     fails a variant on this).
     """
@@ -55,7 +66,7 @@ def _roofline_json(steps_per_sec: float, n: int, scale: float = 1.0,
         from jaxstream.utils.profiling import (TPU_V5E_VPU, Roofline,
                                                analytic_cov_step_cost)
 
-        c = analytic_cov_step_cost(n)
+        c = analytic_cov_step_cost(n, ensemble=ensemble)
         r = Roofline(c["flops"] * scale, c["bytes"] * scale * bytes_scale,
                      1.0 / steps_per_sec, TPU_V5E_VPU)
         return {
@@ -73,17 +84,24 @@ def _roofline_json(steps_per_sec: float, n: int, scale: float = 1.0,
 
 
 def _variant_entry(sim_days_per_sec: float, steps_per_sec: float, n: int,
-                   scale: float = 1.0, bytes_scale: float = 1.0, **extra):
+                   scale: float = 1.0, bytes_scale: float = 1.0,
+                   ensemble: int = 1, **extra):
     """One ``variants`` JSON entry: rate + its own roofline numbers
     (round-6 satellite: the roofline is reported per variant, not just
     for the headline run).  ``scale`` adjusts the analytic covariant
     step cost for variants whose step does more work (e.g. the nu4
     stepper's extra filter kernel); ``bytes_scale`` for variants that
-    move fewer bytes at the same flops (16-bit carries)."""
+    move fewer bytes at the same flops (16-bit carries); ``ensemble=B``
+    marks ``steps_per_sec`` as batched B-member steps (the roofline
+    bills B members of flops AND bytes per step — truthful intensity)
+    and ``sim_days_per_sec`` as AGGREGATE across members."""
     e = {"sim_days_per_sec": round(sim_days_per_sec, 4),
          "steps_per_sec": round(steps_per_sec, 2),
          "vs_baseline": round(sim_days_per_sec / BASELINE_PER_CHIP, 4)}
-    rl = _roofline_json(steps_per_sec, n, scale, bytes_scale)
+    if ensemble > 1:
+        e["members"] = ensemble
+        e["member_steps_per_sec"] = round(steps_per_sec * ensemble, 2)
+    rl = _roofline_json(steps_per_sec, n, scale, bytes_scale, ensemble)
     if rl is not None:
         e["roofline"] = rl
     e.update(extra)
@@ -618,6 +636,208 @@ def bench_galewsky(n=384, dt=60.0, nu4=1.0e14):
     return v, rate
 
 
+def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
+                   k1=2000, k2=8000, gates=True):
+    """Batched ensemble section: aggregate throughput for B members.
+
+    The many-concurrent-simulations workload (perturbed-IC TC5
+    ensembles): one batched stepper call advances all B members, the
+    member axis folded into the fused stage kernels' grid
+    (make_fused_ssprk3_cov_compact(ensemble=B)) so small per-member
+    grids stop paying per-call dispatch/DMA glue once per member.
+
+    Default configuration: **C96 at the CFL-matched dt=300** — the TC5
+    gate config — not the headline C384.  Ensembles are a
+    members-x-moderate-resolution workload by nature, and that is
+    where batching pays: at C96 the per-member step is small enough
+    that fixed per-call glue (dispatch, DMA setup, router op dispatch)
+    is a large step-time fraction, so folding B members into one
+    launch buys aggregate throughput; at C384 a single member already
+    fills the VPU and B mostly amortizes the residual glue.  (Pass
+    n=384 to measure that regime explicitly.)
+    Reports, per B: batched ensemble-steps/s, member-steps/s, AGGREGATE
+    sim-days/sec/chip (the serving metric — total simulated days
+    delivered across members), and a B-scaled roofline (flops AND bytes
+    x B: truthful intensity).  Also records the B=1 batched-vs-unbatched
+    bitwise check (the batching acceptance criterion) and the batched-
+    exchange payload accounting for the largest B.  Falls back to the
+    vmapped classic stepper (impl tag) where the fused kernels don't
+    compile, so the section runs end-to-end on any backend; ``gates``
+    off skips the physical-range checks (the --smoke mode).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
+    from jaxstream.physics.initial_conditions import (perturbed_ensemble,
+                                                      williamson_tc5)
+    from jaxstream.stepping import integrate
+    from jaxstream.utils.profiling import steady_state_rate
+
+    out = {"dt": dt, "case": "tc5", "members": list(members)}
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
+
+    impl = "fused_kernel"
+    step1j = y1 = None
+    stepB_cache = {}
+    try:
+        model = CovariantShallowWater(
+            grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext,
+            backend="pallas")
+        step1j = jax.jit(model.make_fused_step(dt))
+        y1 = model.compact_state(model.initial_state(h_ext, v_ext))
+        jax.block_until_ready(step1j(y1, jnp.float32(0.0)))
+    except Exception as e:
+        log(f"bench ensemble: fused stepper unavailable "
+            f"({type(e).__name__}: {e}); using vmapped classic stepper")
+        impl = "vmap_classic"
+        model = CovariantShallowWater(
+            grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext)
+    out["impl"] = impl
+
+    if impl == "fused_kernel":
+        # B=1 batched path must be bitwise-identical to the unbatched
+        # stepper (the acceptance criterion of the member-axis fold).
+        # The B=1 stepper is cached for the rate loop below; one jitted
+        # unbatched stepper serves warm-up and check (the relay pays
+        # ~1-40 s per compile — don't trace twice).
+        try:
+            stepB_cache[1] = model.make_fused_step(dt, ensemble=1)
+            yb1 = model.ensemble_compact_state(
+                model.stack_ensemble([model.initial_state(h_ext, v_ext)]))
+            ob = jax.jit(stepB_cache[1])(yb1, jnp.float32(0.0))
+            o1 = step1j(y1, jnp.float32(0.0))
+            same = all(bool(jnp.all(
+                (ob[k][:, 0] if k == "u" else ob[k][0]) == o1[k]))
+                for k in o1)
+            out["b1_bitwise"] = bool(same)
+            log(f"bench ensemble: B=1 batched vs unbatched "
+                f"bitwise={same}")
+        except Exception as e:
+            out["b1_bitwise"] = f"unavailable ({type(e).__name__}: {e})"
+
+    h_b = perturbed_ensemble(grid, h_ext, max(members), seed=0,
+                             amplitude=1e-3)
+
+    def mk_run(stepB):
+        return jax.jit(lambda y, k: integrate(stepB, y, 0.0, k, dt)[0],
+                       donate_argnums=0)
+
+    rates = {}
+    for B in members:
+        try:
+            states = [model.initial_state(h_b[i], v_ext)
+                      for i in range(B)]
+
+            def build_carry():
+                # Fresh carry per measurement attempt: runB DONATES its
+                # input, so a retry can never reuse consumed buffers
+                # (the per-member `states` are untouched by stacking).
+                b = model.stack_ensemble(states)
+                return (model.ensemble_compact_state(b)
+                        if impl == "fused_kernel" else b)
+
+            if impl == "fused_kernel":
+                stepB = stepB_cache.get(B)
+                if stepB is None:
+                    stepB = model.make_fused_step(dt, ensemble=B)
+            else:
+                from jaxstream.parallel.sharded_model import \
+                    make_stepper_for
+
+                stepB = make_stepper_for(model, None, build_carry(), dt,
+                                         "ssprk3", ensemble=B)
+            runB = mk_run(stepB)
+            yB = runB(build_carry(), warm)
+            jax.block_until_ready(yB["h"])
+            try:
+                rate, outB = steady_state_rate(
+                    lambda y, k: runB(y, k), yB, k1=k1, k2=k2)
+            except Exception:
+                # Tiny smoke windows can land t2 <= t1; one plain
+                # window (on a rebuilt, re-warmed carry — yB was
+                # donated by the failed attempt) is accurate enough
+                # for a bitrot canary.
+                yB = runB(build_carry(), warm)
+                jax.block_until_ready(yB["h"])
+                t0 = time.perf_counter()
+                outB = runB(yB, k2)
+                jax.block_until_ready(outB["h"])
+                rate = k2 / (time.perf_counter() - t0)
+            hB = np.asarray(outB["h"], np.float64)
+            finite = bool(np.all(np.isfinite(hB)))
+            ok = finite and (not gates
+                             or (3000.0 < hB.min() and hB.max() < 6500.0))
+            if not ok:
+                log(f"bench ensemble B={B}: gate breached (finite="
+                    f"{finite}, h=[{hB.min():.0f},{hB.max():.0f}]) — "
+                    "entry reported as 0")
+                rates[B] = None
+                out[f"B{B}"] = {"sim_days_per_sec": 0.0}
+                continue
+            agg = rate * B * dt / 86400.0
+            rates[B] = agg
+            out[f"B{B}"] = _variant_entry(agg, rate, n, ensemble=B,
+                                          dt=dt)
+            log(f"bench ensemble B={B}: {rate:.2f} ensemble-steps/s "
+                f"({rate * B:.1f} member-steps/s) -> {agg:.4f} "
+                "aggregate sim-days/sec/chip")
+        except Exception as e:
+            log(f"bench ensemble B={B} unavailable "
+                f"({type(e).__name__}: {e})")
+            rates[B] = None
+            out[f"B{B}"] = {"skipped": f"{type(e).__name__}: {e}"}
+    b0, bN = members[0], members[-1]
+    if rates.get(b0) and rates.get(bN):
+        out["agg_speedup"] = {"vs": f"B{bN}/B{b0}",
+                              "x": round(rates[bN] / rates[b0], 4)}
+        log(f"bench ensemble: aggregate throughput B{bN}/B{b0} = "
+            f"{rates[bN] / rates[b0]:.3f}x")
+    try:
+        from jaxstream.utils.comm_probe import batched_exchange_plan
+
+        out["batched_exchange_plan"] = batched_exchange_plan(n, 2, bN)
+    except Exception as e:
+        log(f"bench ensemble: exchange plan unavailable ({e})")
+    return out
+
+
+def bench_smoke(n=24, dt=600.0):
+    """``--smoke``: C24, a handful of steps, NO accuracy gates.
+
+    A cheap end-to-end pass through bench's machinery — grid + TC5 ICs,
+    rung probing with fallback, the batched ensemble section at
+    B in {1, 2}, variant/roofline JSON assembly, exchange-plan
+    accounting — wired into a non-slow test (tests/test_bench_smoke.py)
+    so bench bitrot is caught by the tier-1 gate instead of the next
+    offline TPU run.  Prints exactly ONE JSON line, like main().
+    """
+    t0 = time.perf_counter()
+    try:
+        ens = bench_ensemble(n=n, dt=dt, members=(1, 2), warm=1,
+                             k1=2, k2=6, gates=False)
+    except Exception as e:
+        log(f"bench smoke: ensemble section failed "
+            f"({type(e).__name__}: {e})")
+        ens = {"skipped": f"{type(e).__name__}: {e}"}
+    b1 = ens.get("B1", {})
+    ok = isinstance(b1, dict) and b1.get("sim_days_per_sec", 0.0) > 0.0
+    print(json.dumps({
+        "metric": f"bench_smoke_TC5_C{n}",
+        "smoke": True,
+        "value": b1.get("sim_days_per_sec", 0.0)
+                 if isinstance(b1, dict) else 0.0,
+        "unit": "sim-days/sec (B=1, smoke window — NOT a benchmark)",
+        "ok": bool(ok),
+        "ensemble": ens,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0 if ok else 1
+
+
 def bench_multichip():
     """Multichip steady-state section: per-stage exchange latency and
     steps/s with the overlapped exchange off vs on, on a 6-device
@@ -645,16 +865,18 @@ def bench_multichip():
 
             cpu = jax.devices()[0].platform == "cpu"
             # temporal_block 2 on the CPU smoke (n=16 fits 3*2*2=12-deep
-            # halos), 4 at the real-slice n=96.
+            # halos), 4 at the real-slice n=96; batched-ensemble rate at
+            # a small B either way (one extra stepper compile).
             out = comm_probe.run_default_probe(
                 iters=30 if cpu else 100, steps=10 if cpu else 50,
-                temporal_block=2 if cpu else 4)
+                temporal_block=2 if cpu else 4, members=2 if cpu else 4)
         else:
             script = os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "scripts", "comm_probe.py")
             r = subprocess.run(
                 [_sys.executable, script, "--iters", "30", "--steps",
-                 "10", "--temporal-block", "2", "--json"],
+                 "10", "--temporal-block", "2", "--members", "2",
+                 "--json"],
                 capture_output=True, text=True, timeout=1200)
             if r.returncode != 0:
                 tail = "\n".join((r.stdout + r.stderr).splitlines()[-5:])
@@ -671,9 +893,16 @@ def bench_multichip():
 
 
 def main():
+    if "--smoke" in sys.argv[1:]:
+        raise SystemExit(bench_smoke())
     gates_ok = accuracy_gates()
     value, variants = bench_tc5()
     multichip = bench_multichip()
+    try:
+        ensemble = bench_ensemble()
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench ensemble: unavailable ({type(e).__name__}: {e})")
+        ensemble = {"skipped": f"{type(e).__name__}: {e}"}
     try:
         vg, rg = bench_galewsky()
         # scale 4/3: the split-nu4 step runs 4 kernels (3 RK stages +
@@ -692,6 +921,7 @@ def main():
             "and suppressing all variant lines")
         value = 0.0
         variants = {}
+        ensemble = {"suppressed": "accuracy/stability gate breach"}
     # dt is part of the metric's definition (sim-days/sec = steps/s * dt);
     # emit it top-level, with the dt=60-equivalent rate adjacent, so
     # cross-round comparisons of `value` are self-describing.
@@ -706,6 +936,7 @@ def main():
         "roofline": (_roofline_json(value * 86400.0 / BENCH_DT, 384)
                      if value > 0 else None),
         "variants": variants,
+        "ensemble": ensemble,
         "multichip": multichip,
     }))
 

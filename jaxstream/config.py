@@ -23,6 +23,7 @@ __all__ = [
     "PhysicsConfig",
     "TimeConfig",
     "IOConfig",
+    "EnsembleConfig",
     "Config",
     "load_config",
 ]
@@ -58,6 +59,14 @@ class ParallelConfig:
     # split path is parity-tested against the serialized default on all
     # tiers; default off so the serialized exchange stays the reference.
     overlap_exchange: bool = False
+    # Donate the state carry to the compiled segment loops (XLA aliases
+    # input/output state instead of double-buffering every prognostic).
+    # On accelerators a donated buffer is CONSUMED: references a caller
+    # holds to sim.state (or a previous run()'s return value) become
+    # invalid once the next segment runs.  Set false to keep every
+    # intermediate state alive at the cost of one extra state copy of
+    # HBM residency.
+    donate_state: bool = True
     # Temporal halo blocking: run `temporal_block` SSPRK3 steps per
     # compiled block.  On the explicit one-face-per-device tier this is
     # the deep-halo form — ONE exchange of width 3*k*halo strips per
@@ -123,6 +132,21 @@ class IOConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class EnsembleConfig:
+    """Perturbed-IC ensemble block — the many-concurrent-simulations
+    workload (Williamson TC5 / Galewsky perturbed ensembles).  With
+    ``members > 1`` the run advances all members per step through the
+    batched steppers (member axis folded into the kernel grid on the
+    fused path; one ppermute carries every member's halo strips on the
+    sharded tiers — docs/USAGE.md "Ensembles")."""
+    members: int = 1          # ensemble size (1 = plain single run)
+    seed: int = 0             # perturbation generator seed (deterministic)
+    # Relative height-perturbation amplitude of members 1..B-1 (member 0
+    # stays unperturbed): dh = amplitude * mean|h| * smooth mode.
+    amplitude: float = 1.0e-3
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grid: GridConfig = GridConfig()
     parallelization: ParallelConfig = ParallelConfig()
@@ -130,6 +154,7 @@ class Config:
     model: ModelConfig = ModelConfig()
     time: TimeConfig = TimeConfig()
     io: IOConfig = IOConfig()
+    ensemble: EnsembleConfig = EnsembleConfig()
 
 
 _SECTIONS = {
@@ -139,6 +164,7 @@ _SECTIONS = {
     "model": ModelConfig,
     "time": TimeConfig,
     "io": IOConfig,
+    "ensemble": EnsembleConfig,
 }
 
 

@@ -44,7 +44,16 @@ from ..parallel.vector_halo import make_vector_halo_exchanger
 from .base import State
 from .shallow_water import SWEBase
 
-__all__ = ["CovariantShallowWater"]
+__all__ = ["CovariantShallowWater", "ENSEMBLE_STATE_AXES",
+           "ENSEMBLE_CARRY_AXES"]
+
+#: Member-axis position per leaf of the batched interior state
+#: ``{"h": (B, 6, n, n), "u": (2, B, 6, n, n)}`` (u's component axis
+#: precedes the member axis so the trailing (6, n, n) layout every
+#: face-indexed consumer assumes is preserved).
+ENSEMBLE_STATE_AXES = {"h": 0, "u": 1}
+#: Ditto for the batched compact fused-stepper carry.
+ENSEMBLE_CARRY_AXES = {"h": 0, "u": 1, "strips_sn": 0, "strips_we": 0}
 
 
 class CovariantShallowWater(SWEBase):
@@ -116,6 +125,38 @@ class CovariantShallowWater(SWEBase):
         return {"h": state["h"], "u": state["u"],
                 "strips_sn": sn, "strips_we": we}
 
+    @staticmethod
+    def stack_ensemble(states) -> State:
+        """A list of interior states -> one batched ensemble state
+        ``{"h": (B, 6, n, n), "u": (2, B, 6, n, n)}`` (member-axis
+        layout per :data:`ENSEMBLE_STATE_AXES`)."""
+        return {"h": jnp.stack([s["h"] for s in states], axis=0),
+                "u": jnp.stack([s["u"] for s in states], axis=1)}
+
+    def member_state(self, batched: State, i: int) -> State:
+        """One member's interior state out of a batched ensemble state."""
+        return {"h": batched["h"][i], "u": batched["u"][:, i]}
+
+    def ensemble_compact_state(self, batched: State) -> State:
+        """Batched interior state -> the batched compact carry.
+
+        The strip pack runs on the member axis folded into the face
+        axis ((B, 6, ...) -> (B*6, ...) contiguous reshape) — the same
+        layout trick the batched stage kernels use — then unfolds, so
+        each member's strips are bitwise the unbatched pack's.
+        """
+        from ..ops.pallas.swe_cov import pack_strips_cov_split
+
+        g = self.grid
+        h, u = batched["h"], batched["u"]
+        B = h.shape[0]
+        sn, we = pack_strips_cov_split(
+            h.reshape((B * 6,) + h.shape[2:]),
+            u.reshape((2, B * 6) + u.shape[3:]), g.n, g.halo)
+        return {"h": h, "u": u,
+                "strips_sn": sn.reshape((B, 6) + sn.shape[1:]),
+                "strips_we": we.reshape((B, 6) + we.shape[1:])}
+
     def encode_carry(self, y: State, carry_dtype=None,
                      h_offset: float = 0.0, h_scale: float = 1.0,
                      u_scale: float = 1.0) -> State:
@@ -169,7 +210,9 @@ class CovariantShallowWater(SWEBase):
                         h_scale: float = 1.0, u_scale: float = 1.0,
                         _ablate_seam: bool = False,
                         nu4_mode: str = "split",
-                        temporal_block: int = 1):
+                        temporal_block: int = 1,
+                        ensemble: int = 0,
+                        ensemble_impl: str = "kernel"):
         """Fused SSPRK3: one Pallas kernel per stage (halo fill in-kernel,
         edge rotations/symmetrization on a packed strip carry,
         :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
@@ -193,7 +236,20 @@ class CovariantShallowWater(SWEBase):
         SSPRK3 steps per call (``parallelization.temporal_block``) —
         bitwise-identical to k separate calls on every path (the strip
         routes are face-local on one device), with a ``steps_per_call``
-        attribute so integrators can account for it."""
+        attribute so integrators can account for it.
+
+        ``ensemble = B > 0``: the step runs B perturbed-IC members per
+        call over the batched compact carry (member-axis layout
+        :data:`ENSEMBLE_CARRY_AXES`; initialise with
+        :meth:`ensemble_compact_state`).  ``ensemble_impl`` picks the
+        execution strategy: ``'kernel'`` (production) folds the member
+        axis into the stage kernels' grid — one launch per stage for
+        the whole ensemble (:func:`...make_fused_ssprk3_cov_compact`
+        with ``ensemble=B``); ``'vmap'`` is the vmapped reference path
+        (B per-member kernel launches, bitwise the same values) kept as
+        the parity oracle and the portability fallback.  Compact carry
+        and nu4 = 0 only.
+        """
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
         if nu4_mode not in ("split", "stage"):
@@ -202,6 +258,22 @@ class CovariantShallowWater(SWEBase):
         if temporal_block < 1:
             raise ValueError(
                 f"temporal_block must be >= 1, got {temporal_block}")
+        if ensemble < 0:
+            raise ValueError(f"ensemble must be >= 0, got {ensemble}")
+        if ensemble:
+            if ensemble_impl not in ("kernel", "vmap"):
+                raise ValueError(f"ensemble_impl must be 'kernel' or "
+                                 f"'vmap', got {ensemble_impl!r}")
+            if not compact:
+                raise ValueError(
+                    "ensemble > 0 requires the compact carry (the "
+                    "extended-state stepper has no batched form)")
+            if self.nu4 != 0.0:
+                raise ValueError(
+                    "ensemble > 0 supports nu4 = 0 only (the del^4 "
+                    "filter kernels are not batched yet); run "
+                    "ensemble_impl='vmap' over a nu4 stepper manually "
+                    "if needed")
         interpret = self.backend == "pallas_interpret"
 
         def _blocked(step1):
@@ -236,6 +308,7 @@ class CovariantShallowWater(SWEBase):
         if compact:
             import jax.numpy as jnp
 
+            kernel_ensemble = ensemble if ensemble_impl == "kernel" else 0
             step = make_fused_ssprk3_cov_multistep(
                 self.grid, self.gravity, self.omega, dt, self.b_ext,
                 temporal_block,
@@ -244,8 +317,13 @@ class CovariantShallowWater(SWEBase):
                 carry_dtype=(jnp.float32 if carry_dtype is None
                              else carry_dtype),
                 h_offset=h_offset, h_scale=h_scale, u_scale=u_scale,
-                seam=not _ablate_seam,
+                seam=not _ablate_seam, ensemble=kernel_ensemble,
             )
+            if ensemble and ensemble_impl == "vmap":
+                from ..stepping import vmap_ensemble
+
+                step = vmap_ensemble(step, ENSEMBLE_CARRY_AXES)
+                step.ensemble = ensemble
             if temporal_block > 1:
                 step.steps_per_call = temporal_block
             return step

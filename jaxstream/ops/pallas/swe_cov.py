@@ -1422,11 +1422,23 @@ def make_cov_strip_router_split(grid, prescale_sym: bool = False):
     return route
 
 
-def _cov_blockspecs(n, halo):
-    """The shared BlockSpec set of the compact-carry stage kernels."""
+def _cov_blockspecs(n, halo, groups: int = 6):
+    """The shared BlockSpec set of the compact-carry stage kernels.
+
+    ``groups``: total kernel-grid extent.  The default 6 is the plain
+    one-face-per-grid-step layout; the batched ensemble steppers fold
+    the member axis into the face axis (``groups = 6 * B``, member-major
+    ``(B, 6) -> B*6``) so ONE kernel launch sweeps every member's faces
+    — the per-call dispatch/DMA-setup glue is paid once per ensemble
+    step instead of once per member.  Static per-face operands (frame
+    z-components, orography) stay 6-deep in HBM and index ``f % 6``;
+    per-member state indexes ``f`` directly.
+    """
     m = n + 2 * halo
     h = halo
-    fz_spec = pl.BlockSpec((1, 1, 3), lambda f: (f, 0, 0),
+    face = (lambda f: (f, 0, 0)) if groups == 6 else \
+        (lambda f: (f % 6, 0, 0))
+    fz_spec = pl.BlockSpec((1, 1, 3), face,
                            memory_space=pltpu.SMEM)
     coord_specs = [
         pl.BlockSpec((1, m), lambda f: (0, 0), memory_space=pltpu.VMEM),
@@ -1438,7 +1450,7 @@ def _cov_blockspecs(n, halo):
                           memory_space=pltpu.VMEM)
     ui_blk = pl.BlockSpec((2, 1, n, n), lambda f: (0, f, 0, 0),
                           memory_space=pltpu.VMEM)
-    be_blk = pl.BlockSpec((1, m, m), lambda f: (f, 0, 0),
+    be_blk = pl.BlockSpec((1, m, m), face,
                           memory_space=pltpu.VMEM)
     gsn_blk = pl.BlockSpec((1, 6 * h + 2, n), lambda f: (f, 0, 0),
                            memory_space=pltpu.VMEM)
@@ -1570,6 +1582,7 @@ def make_cov_stage_compact(
     seam: bool = True,
     sym_prescaled: bool = False,
     manual_dma: bool | None = None,
+    groups: int = 6,
 ):
     """One fused covariant RK stage over interior-only state.
 
@@ -1595,6 +1608,12 @@ def make_cov_stage_compact(
     then makes u quantization ~8x finer than bf16.  ``seam=False``
     ablates the symmetrized-seam imposition (measurement only: breaks
     cross-panel conservation).
+
+    ``groups``: kernel-grid extent (see :func:`_cov_blockspecs`) — 6 for
+    the single-state stepper, ``6 * B`` for the batched ensemble carry
+    with the member axis folded into the face axis.  The kernel body is
+    identical per grid step either way, so the ``B = 1`` batched stage
+    is bitwise-equal to the plain one.
 
     ``manual_dma`` (measurement knob, default OFF — measured a dead
     end on v5e): the h/u carry arrives as ANY-space refs and each
@@ -1643,11 +1662,20 @@ def make_cov_stage_compact(
 
     plain_f32 = (cdt_h == jnp.float32 and cdt_u == jnp.float32
                  and not with_off and not with_scale and not with_hscale)
+    if groups < 6 or groups % 6:
+        raise ValueError(
+            f"groups must be a positive multiple of 6 (6 * ensemble "
+            f"members), got {groups}")
     if manual_dma is None:
         manual_dma = False
     elif manual_dma and not plain_f32:
         raise ValueError("manual_dma needs a plain f32 carry (the DMA "
                          "engine cannot widen or rescale)")
+    if manual_dma and groups != 6:
+        raise ValueError("manual_dma is wired for the single-state "
+                         "stepper only (its fetch-ahead hardcodes the "
+                         "6-face grid); use the block pipeline for "
+                         "ensemble carries")
     if manual_dma and n % 128 != 0:
         raise ValueError(
             f"manual_dma needs n % 128 == 0 (got n={n}): the ANY-space "
@@ -1810,7 +1838,7 @@ def make_cov_stage_compact(
             emit(ub_int, None, dub, uo_ref, 2, lead=(1,))
 
     (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
-     ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
+     ssn_blk, swe_blk) = _cov_blockspecs(n, halo, groups)
 
     in_specs = [fz_spec] + coord_specs
     if with_y0:
@@ -1824,7 +1852,7 @@ def make_cov_stage_compact(
     call = pl.pallas_call(
         kernel,
         grid_spec=pl.GridSpec(
-            grid=(6,),
+            grid=(groups,),
             in_specs=in_specs,
             out_specs=[hi_blk, ui_blk, ssn_blk, swe_blk],
             scratch_shapes=(
@@ -1842,10 +1870,10 @@ def make_cov_stage_compact(
                    if manual_dma else [])),
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((6, n, n), cdt_h),
-            jax.ShapeDtypeStruct((2, 6, n, n), cdt_u),
-            jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
-            jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+            jax.ShapeDtypeStruct((groups, n, n), cdt_h),
+            jax.ShapeDtypeStruct((2, groups, n, n), cdt_u),
+            jax.ShapeDtypeStruct((groups, 6 * h, n), jnp.float32),
+            jax.ShapeDtypeStruct((groups, n, 6 * h), jnp.float32),
         ],
         compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
@@ -1878,6 +1906,7 @@ def make_fused_ssprk3_cov_compact(
     h_scale: float = 1.0,
     u_scale: float = 1.0,
     seam: bool = True,
+    ensemble: int = 0,
 ):
     """``step(y, t) -> y`` over ``y = {h, u, strips_sn, strips_we}``.
 
@@ -1886,32 +1915,80 @@ def make_fused_ssprk3_cov_compact(
     Initialise the carry with :meth:`CovariantShallowWater.compact_state`
     (encode ``h``/``u`` per ``carry_dtype``/``h_offset`` — see
     :meth:`CovariantShallowWater.encode_carry`).
+
+    ``ensemble = B > 0``: the carry gains a leading member axis —
+    ``{h: (B, 6, n, n), u: (2, B, 6, n, n), strips_sn: (B, 6, 6h, n),
+    strips_we: (B, 6, n, 6h)}`` — and each stage runs as ONE kernel
+    launch over a ``6 * B`` grid (the member axis folded into the face
+    axis, :func:`_cov_blockspecs`), with the strip router vmapped over
+    members (its gathers/rotations batch into single whole-ensemble XLA
+    ops).  Per-member arithmetic is the plain stepper's, op for op, so
+    the ``B = 1`` batched step is bitwise-identical to the unbatched one
+    (tested); what changes is dispatch and DMA-setup amortization —
+    small per-member grids stop paying the fixed per-call glue that
+    dominates below ~C128.  Initialise with
+    :meth:`CovariantShallowWater.ensemble_compact_state`.
     """
     from .swe_step import SSPRK3_COEFFS
 
+    B = int(ensemble)
     route = make_cov_strip_router_split(grid, prescale_sym=seam)
+    if B:
+        # Member-mapped router: the static row-gather and 2x2 rotation
+        # multiply-adds batch into single whole-ensemble XLA ops.
+        route = jax.vmap(route)
     mk = lambda a, b: make_cov_stage_compact(
         grid.n, grid.halo, float(grid.dalpha), float(grid.radius), gravity,
         omega, dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
         carry_dtype=carry_dtype, h_offset=h_offset, h_scale=h_scale,
         u_scale=u_scale, seam=seam, sym_prescaled=seam,
+        groups=6 * max(B, 1),
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)
     stage2 = mk(a2, b2)
     stage3 = mk(a3, b3)
 
+    if not B:
+        def step(y, t):
+            del t
+            h0, u0 = y["h"], y["u"]
+            gsn, gwe = route(y["strips_sn"], y["strips_we"])
+            h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
+            gsn, gwe = route(sn1, we1)
+            h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
+            gsn, gwe = route(sn2, we2)
+            h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
+            return {"h": h3, "u": u3, "strips_sn": sn3, "strips_we": we3}
+
+        return step
+
+    # Batched ensemble step: fold (B, 6) -> B*6 around the stage kernels
+    # (free reshapes — leading axes are contiguous), unfold for the
+    # vmapped router.  ONE pallas_call per stage sweeps all members.
+    def fold(x, lead=0):
+        s = x.shape
+        return x.reshape(s[:lead] + (B * 6,) + s[lead + 2:])
+
+    def unfold(x, lead=0):
+        s = x.shape
+        return x.reshape(s[:lead] + (B, 6) + s[lead + 1:])
+
     def step(y, t):
         del t
-        h0, u0 = y["h"], y["u"]
+        h0, u0 = fold(y["h"]), fold(y["u"], 1)
         gsn, gwe = route(y["strips_sn"], y["strips_we"])
-        h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
-        gsn, gwe = route(sn1, we1)
-        h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
-        gsn, gwe = route(sn2, we2)
-        h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
-        return {"h": h3, "u": u3, "strips_sn": sn3, "strips_we": we3}
+        h1, u1, sn1, we1 = stage1(h0, u0, fold(gsn), fold(gwe), b_ext)
+        gsn, gwe = route(unfold(sn1), unfold(we1))
+        h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, fold(gsn), fold(gwe),
+                                  b_ext)
+        gsn, gwe = route(unfold(sn2), unfold(we2))
+        h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, fold(gsn), fold(gwe),
+                                  b_ext)
+        return {"h": unfold(h3), "u": unfold(u3, 1),
+                "strips_sn": unfold(sn3), "strips_we": unfold(we3)}
 
+    step.ensemble = B
     return step
 
 
@@ -1930,6 +2007,7 @@ def make_fused_ssprk3_cov_multistep(
     h_scale: float = 1.0,
     u_scale: float = 1.0,
     seam: bool = True,
+    ensemble: int = 0,
 ):
     """``block(y, t) -> y`` running ``temporal_block`` fused SSPRK3 steps.
 
@@ -1953,7 +2031,7 @@ def make_fused_ssprk3_cov_multistep(
     step1 = make_fused_ssprk3_cov_compact(
         grid, gravity, omega, dt, b_ext, scheme=scheme, limiter=limiter,
         interpret=interpret, carry_dtype=carry_dtype, h_offset=h_offset,
-        h_scale=h_scale, u_scale=u_scale, seam=seam,
+        h_scale=h_scale, u_scale=u_scale, seam=seam, ensemble=ensemble,
     )
     if temporal_block == 1:
         return step1
@@ -1965,6 +2043,8 @@ def make_fused_ssprk3_cov_multistep(
     # three copies across the temporal_block call sites).
     block = blocked(step1, temporal_block, dt)
     block.steps_per_call = temporal_block
+    if ensemble:
+        block.ensemble = int(ensemble)
     return block
 
 

@@ -33,7 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Config, ParallelConfig
 from ..utils.logging import get_logger
 
-__all__ = ["ShardingSetup", "setup_sharding", "shard_state"]
+__all__ = ["ShardingSetup", "setup_sharding", "shard_state",
+           "setup_ensemble_sharding", "shard_ensemble_state"]
 
 log = get_logger(__name__)
 
@@ -48,6 +49,10 @@ class ShardingSetup:
     use_shard_map: bool = False
     overlap_exchange: bool = False
     temporal_block: int = 1
+    #: member-axis extent of the device mesh (ensemble runs on the 2-D
+    #: ``('panel', 'member')`` mesh from :func:`setup_ensemble_sharding`;
+    #: 1 everywhere else).
+    member: int = 1
 
     @property
     def scalar_spec(self) -> P:
@@ -61,6 +66,25 @@ class ShardingSetup:
         if self.mesh is None:
             return None
         return NamedSharding(self.mesh, self.spec_for(ndim))
+
+    def ensemble_spec_for(self, ndim: int) -> P:
+        """PartitionSpec for a batched-ensemble array whose last 4 axes
+        are ``(B, 6, ny, nx)`` (member axis immediately before the face
+        axis, the :data:`...shallow_water_cov.ENSEMBLE_STATE_AXES`
+        layout).  On the ``('panel', 'member')`` mesh the member axis
+        shards over 'member'; on the plain ``('panel', 'y', 'x')`` mesh
+        it is replicated (members stacked locally per face device)."""
+        axes = self.mesh.axis_names if self.mesh is not None else ()
+        if "member" in axes:
+            tail = ("member", "panel", None, None)
+        else:
+            tail = (None, "panel", "y", "x")
+        return P(*((None,) * (ndim - 4) + tail))
+
+    def ensemble_sharding_for(self, ndim: int):
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, self.ensemble_spec_for(ndim))
 
 
 def _pick_devices(kind: str, count: int):
@@ -106,24 +130,26 @@ def _factor_mesh(num_devices: int, tiles_per_edge: int):
     return p, sy, sx
 
 
+def _coerce_parallel_config(config: Any) -> ParallelConfig:
+    """Config / ParallelConfig / raw reference-style dict / None -> the
+    ParallelConfig — ONE coercion for every mesh entry point, so a new
+    field cannot be silently dropped in one of them."""
+    if isinstance(config, Config):
+        return config.parallelization
+    if isinstance(config, ParallelConfig):
+        return config
+    if config is None:
+        return ParallelConfig()
+    # raw dict, reference-style: config['parallelization'].get(...)
+    block = dict(config.get("parallelization", {}))
+    known = {f.name for f in dataclasses.fields(ParallelConfig)}
+    return ParallelConfig(**{k: v for k, v in block.items()
+                             if k in known})
+
+
 def setup_sharding(config: Any = None) -> ShardingSetup:
     """Build the device mesh + shardings from a Config (or its dict form)."""
-    if isinstance(config, Config):
-        par = config.parallelization
-    elif isinstance(config, ParallelConfig):
-        par = config
-    elif config is None:
-        par = ParallelConfig()
-    else:  # raw dict, reference-style: config['parallelization'].get(...)
-        block = dict(config.get("parallelization", {}))
-        par = ParallelConfig(
-            tiles_per_edge=block.get("tiles_per_edge", 1),
-            num_devices=block.get("num_devices", 6),
-            device_type=block.get("device_type", "cpu"),
-            use_shard_map=block.get("use_shard_map", False),
-            overlap_exchange=block.get("overlap_exchange", False),
-            temporal_block=block.get("temporal_block", 1),
-        )
+    par = _coerce_parallel_config(config)
 
     t = par.tiles_per_edge
     if t < 1:
@@ -171,3 +197,66 @@ def shard_state(setup: ShardingSetup, state):
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, setup.sharding_for(a.ndim)), state
     )
+
+
+def setup_ensemble_sharding(config: Any = None,
+                            members: int = 1) -> ShardingSetup:
+    """2-D ``('panel', 'member')`` device mesh for batched ensemble runs.
+
+    The ensemble workload has two data-parallel axes: the six cube faces
+    (halo exchange along it) and the ``B`` perturbed-IC members (no
+    communication at all).  This factors ``num_devices = 6 * m`` into a
+    ``(panel=6, member=m)`` mesh — faces exchange over 'panel' exactly as
+    on the face tier (a ``ppermute`` naming only 'panel' is per-member-
+    column automatically), members scatter over 'member' with zero
+    wire traffic.  Each device then holds ``B / m`` members of one face,
+    and the batched exchange ships their strips in ONE ppermute per
+    schedule stage.
+
+    When to prefer member-sharding over face-sharding: with more than 6
+    devices the face tier is out of axes (tiles_per_edge intra-face
+    blocks add seam traffic), while extra member shards are free —
+    docs/USAGE.md "Ensembles" quantifies the trade.  ``members`` must be
+    divisible by ``m`` so every device carries the same member count.
+    """
+    par = _coerce_parallel_config(config)
+    if members < 1:
+        raise ValueError(f"members must be >= 1, got {members}")
+    d = par.num_devices
+    if d == 1:
+        log.info("ensemble sharding: single device (no mesh), %d members "
+                 "stacked locally", members)
+        return ShardingSetup(mesh=None, num_devices=1, panel=1, sy=1, sx=1,
+                             temporal_block=par.temporal_block)
+    if d % 6:
+        raise ValueError(
+            f"ensemble sharding factors num_devices as 6 faces x m member "
+            f"shards; num_devices={d} is not a multiple of 6. Valid "
+            f"counts: 6, 12, 18, ... (or num_devices: 1 for the "
+            f"single-device batched stepper).")
+    m = d // 6
+    if members % m:
+        raise ValueError(
+            f"ensemble.members={members} is not divisible by the member-"
+            f"shard count {m} (= num_devices/6); every device must carry "
+            f"the same number of members. Use members that {m} divides, "
+            f"or fewer devices.")
+    devs = np.array(_pick_devices(par.device_type, d)).reshape(6, m)
+    mesh = Mesh(devs, ("panel", "member"))
+    log.info("ensemble sharding: %d %s devices as mesh panel=6 member=%d "
+             "(%d members -> %d per device)", d, par.device_type, m,
+             members, members // m)
+    return ShardingSetup(mesh=mesh, num_devices=d, panel=6, sy=1, sx=1,
+                         use_shard_map=par.use_shard_map,
+                         overlap_exchange=par.overlap_exchange,
+                         temporal_block=par.temporal_block, member=m)
+
+
+def shard_ensemble_state(setup: ShardingSetup, state):
+    """device_put a batched ensemble state (leaves ``(.., B, 6, ny, nx)``
+    in the member-before-face layout) with the ensemble specs."""
+    if setup.mesh is None:
+        return state
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, setup.ensemble_sharding_for(a.ndim)),
+        state)

@@ -50,8 +50,10 @@ from ..geometry.cubed_sphere import FACE_AXES
 from .halo import read_strip, write_strip
 
 __all__ = ["CovShardProgram", "make_cov_shard_exchange",
-           "make_cov_shard_exchange_phases", "make_sharded_cov_stepper",
-           "make_sharded_cov_deep_stepper", "deep_extend_static"]
+           "make_cov_shard_exchange_phases",
+           "make_cov_shard_exchange_batched",
+           "make_sharded_cov_stepper", "make_sharded_cov_deep_stepper",
+           "make_sharded_cov_ensemble_stepper", "deep_extend_static"]
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
 
@@ -298,6 +300,28 @@ def make_cov_shard_exchange(program: CovShardProgram):
     return exchange
 
 
+def make_cov_shard_exchange_batched(program: CovShardProgram):
+    """Batched ensemble form of :func:`make_cov_shard_exchange`.
+
+    ``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)`` over
+    a LOCAL member-batched face block — ``h_blk (B, 1, M, M)``, ``u_blk
+    (2, B, 1, M, M)`` — implemented as ``jax.vmap`` of the single-member
+    exchange over the member axis.  The payload of each of the 4
+    schedule stages batches into ONE ``lax.ppermute`` carrying all
+    members' strips stacked as ``(B, 3, halo, n)`` (vmap's collective
+    batching rule — verified as exactly 4 ppermute eqns in the jaxpr),
+    so the per-stage ICI latency chain is paid once per ensemble step
+    instead of once per member: collective launch latency amortizes
+    B-fold at unchanged per-member wire bytes.  The receive algebra
+    (rotations, ghost writes, seam symmetrization) is mapped per member
+    with identical per-element arithmetic, so every member's ghosts and
+    sym strips are bitwise-equal to a per-member exchange loop (tested
+    in tests/test_ensemble.py).
+    """
+    return jax.vmap(make_cov_shard_exchange(program),
+                    in_axes=(0, 1, None), out_axes=(0, 1, 0, 0))
+
+
 def deep_extend_static(grid, field_ext, depth: int):
     """Re-extend a static ``(6, M, M)`` field to ghost ``depth``.
 
@@ -318,7 +342,8 @@ def deep_extend_static(grid, field_ext, depth: int):
 
 
 def make_sharded_cov_deep_stepper(model, setup, dt: float,
-                                  temporal_block: int, overlap=None):
+                                  temporal_block: int, overlap=None,
+                                  donate: bool = False):
     """Temporal halo blocking on the one-face-per-device tier.
 
     ``block(state, t) -> state`` advancing ``temporal_block = k`` SSPRK3
@@ -538,7 +563,8 @@ def make_sharded_cov_deep_stepper(model, setup, dt: float,
     fz_sh = jax.device_put(frames_z, NamedSharding(mesh, P(axes[0])))
     b_sh = jax.device_put(b_deep, NamedSharding(mesh, P(axes[0])))
 
-    jitted = jax.jit(lambda state: shard_body(state, tables, fz_sh, b_sh))
+    jitted = jax.jit(lambda state: shard_body(state, tables, fz_sh, b_sh),
+                     donate_argnums=(0,) if donate else ())
 
     def step(state, t):
         del t
@@ -548,8 +574,104 @@ def make_sharded_cov_deep_stepper(model, setup, dt: float,
     return step
 
 
+def _make_cov_face_rhs(model, grid, program: CovShardProgram, overlap,
+                       platform):
+    """Per-face local RHS closure of the explicit face tier.
+
+    Returns ``f(h_int, u_int, tabs, fz, b_loc) -> (dh, du)`` — embed,
+    4-stage ppermute exchange (phase-split under ``overlap``), fused
+    covariant Pallas RHS kernel, optional del^4 — the single source of
+    the face-tier stage arithmetic, shared by the serialized/overlapped
+    stepper and the batched ensemble stepper (which vmaps it over the
+    member axis: the ppermutes batch into single all-member collectives
+    and the per-member math stays op-identical).
+    """
+    halo, n = grid.halo, grid.n
+    exchange = make_cov_shard_exchange(program)
+    from ..ops.pallas.swe_cov import make_cov_rhs_pallas
+
+    rhs_local = make_cov_rhs_pallas(
+        grid, model.gravity, model.omega, scheme=model.scheme,
+        limiter=model.limiter, interpret=(platform != "tpu"),
+        n_faces=1, external_sym=True,
+    )
+    if overlap:
+        from ..ops.pallas.swe_cov import (make_cov_rhs_band_local,
+                                          make_cov_rhs_interior_local)
+        from ..ops.pallas.swe_rhs import coord_rows
+
+        ex_start, ex_finish = make_cov_shard_exchange_phases(program)
+        rhs_interior = make_cov_rhs_interior_local(
+            n, halo, float(grid.dalpha), float(grid.radius),
+            model.gravity, model.omega, scheme=model.scheme,
+            limiter=model.limiter, interpret=(platform != "tpu"))
+        rhs_band = make_cov_rhs_band_local(
+            n, halo, float(grid.dalpha), float(grid.radius),
+            model.gravity, model.omega, scheme=model.scheme,
+            limiter=model.limiter)
+        xr_f, xfr_f, yc_f, yfc_f, _ = coord_rows(n, halo)
+        xr_i, xfr_i = xr_f[:, halo:halo + n], xfr_f[:, halo:halo + n]
+        yc_i, yfc_i = yc_f[halo:halo + n], yfc_f[halo:halo + n]
+
+    def embed(x):
+        pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
+        return jnp.pad(x, pad)
+
+    nu4 = float(getattr(model, "nu4", 0.0))
+    if nu4 != 0.0:
+        from ..ops.pallas.swe_cov import lap_core
+        from ..ops.pallas.swe_rhs import coord_rows
+        from .halo import _fill_corners
+
+        x_row, xf_row, x_col, xf_col, _ = coord_rows(grid.n, halo)
+        lap1 = functools.partial(
+            lap_core, x_row, xf_row, x_col, xf_col,
+            n=grid.n, halo=halo, d=float(grid.dalpha),
+            radius=float(grid.radius))
+
+    def f(h_int, u_int, tabs, fz, b_loc):
+        h_e = embed(h_int)
+        u_e = embed(u_int)
+        if overlap:
+            # Wire first: all 4 stage ppermutes are functions of the
+            # pre-exchange strips.  The interior kernel depends on
+            # none of them, so the async collectives overlap it; the
+            # band pass then consumes the received strips.
+            recvs = ex_start(h_e, u_e, tabs)
+            dh_c, du_c = rhs_interior(
+                fz, xr_i, xfr_i, yc_i, yfc_i, h_int, u_int,
+                b_loc[:, halo:halo + n, halo:halo + n])
+            h_e, u_e, ssn, swe = ex_finish(h_e, u_e, recvs)
+            dh, du = rhs_band(fz, xr_f, xfr_f, yc_f, yfc_f,
+                              h_e, u_e, b_loc, ssn, swe, dh_c, du_c)
+        else:
+            h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
+            dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
+        if nu4 != 0.0:
+            # del^4 = lap(lap(.)) with an exchanged refill between,
+            # exactly the fused nu4 stepper's structure: the same
+            # strip exchange applies (lap of a covariant pair is a
+            # covariant pair), and the Laplace-Beltrami cross-terms
+            # need the ghost corners (face-local averaging).
+            def lap3(he, ue):
+                he = _fill_corners(he, halo, grid.n)
+                ue = _fill_corners(ue, halo, grid.n)
+                return (lap1(he[0])[None],
+                        jnp.stack([lap1(ue[0, 0])[None],
+                                   lap1(ue[1, 0])[None]]))
+            l1h, l1u = lap3(h_e, u_e)
+            l1h_e, l1u_e, _, _ = exchange(embed(l1h), embed(l1u), tabs)
+            l2h, l2u = lap3(l1h_e, l1u_e)
+            dh = dh - nu4 * l2h
+            du = du - nu4 * l2u
+        return dh, du
+
+    return f
+
+
 def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
-                             temporal_block: int = 1):
+                             temporal_block: int = 1,
+                             donate: bool = False):
     """``step(state, t) -> state`` for the covariant model under shard_map.
 
     Requires a ``(panel=6, 1, 1)`` mesh (one face per device).  State is
@@ -579,7 +701,8 @@ def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
     if temporal_block > 1:
         return make_sharded_cov_deep_stepper(model, setup, dt,
                                              temporal_block,
-                                             overlap=overlap)
+                                             overlap=overlap,
+                                             donate=donate)
     grid = model.grid
     if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
         raise ValueError(
@@ -590,35 +713,9 @@ def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
     if overlap is None:
         overlap = getattr(setup, "overlap_exchange", False)
     mesh = setup.mesh
-    halo = grid.halo
-    n = grid.n
     program = CovShardProgram(grid)
-    exchange = make_cov_shard_exchange(program)
     platform = getattr(mesh.devices.flat[0], "platform", "cpu")
-    from ..ops.pallas.swe_cov import make_cov_rhs_pallas
-
-    rhs_local = make_cov_rhs_pallas(
-        grid, model.gravity, model.omega, scheme=model.scheme,
-        limiter=model.limiter, interpret=(platform != "tpu"),
-        n_faces=1, external_sym=True,
-    )
-    if overlap:
-        from ..ops.pallas.swe_cov import (make_cov_rhs_band_local,
-                                          make_cov_rhs_interior_local)
-        from ..ops.pallas.swe_rhs import coord_rows
-
-        ex_start, ex_finish = make_cov_shard_exchange_phases(program)
-        rhs_interior = make_cov_rhs_interior_local(
-            n, halo, float(grid.dalpha), float(grid.radius),
-            model.gravity, model.omega, scheme=model.scheme,
-            limiter=model.limiter, interpret=(platform != "tpu"))
-        rhs_band = make_cov_rhs_band_local(
-            n, halo, float(grid.dalpha), float(grid.radius),
-            model.gravity, model.omega, scheme=model.scheme,
-            limiter=model.limiter)
-        xr_f, xfr_f, yc_f, yfc_f, _ = coord_rows(n, halo)
-        xr_i, xfr_i = xr_f[:, halo:halo + n], xfr_f[:, halo:halo + n]
-        yc_i, yfc_i = yc_f[halo:halo + n], yfc_f[halo:halo + n]
+    f_loc = _make_cov_face_rhs(model, grid, program, overlap, platform)
     frames_z = jnp.asarray(
         np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
 
@@ -626,61 +723,9 @@ def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
     pstate = {"h": P(axes[0]), "u": P(None, axes[0])}
     ptab = {k: P(axes[0]) for k in program.tables}
 
-    def embed(x):
-        pad = [(0, 0)] * (x.ndim - 2) + [(halo, halo), (halo, halo)]
-        return jnp.pad(x, pad)
-
-    nu4 = float(getattr(model, "nu4", 0.0))
-    if nu4 != 0.0:
-        from ..ops.pallas.swe_cov import lap_core
-        from ..ops.pallas.swe_rhs import coord_rows
-        from .halo import _fill_corners
-
-        x_row, xf_row, x_col, xf_col, _ = coord_rows(grid.n, halo)
-        lap1 = functools.partial(
-            lap_core, x_row, xf_row, x_col, xf_col,
-            n=grid.n, halo=halo, d=float(grid.dalpha),
-            radius=float(grid.radius))
-
     def body(state, tabs, fz, b_loc):
-        def f(h_int, u_int):
-            h_e = embed(h_int)
-            u_e = embed(u_int)
-            if overlap:
-                # Wire first: all 4 stage ppermutes are functions of the
-                # pre-exchange strips.  The interior kernel depends on
-                # none of them, so the async collectives overlap it; the
-                # band pass then consumes the received strips.
-                recvs = ex_start(h_e, u_e, tabs)
-                dh_c, du_c = rhs_interior(
-                    fz, xr_i, xfr_i, yc_i, yfc_i, h_int, u_int,
-                    b_loc[:, halo:halo + n, halo:halo + n])
-                h_e, u_e, ssn, swe = ex_finish(h_e, u_e, recvs)
-                dh, du = rhs_band(fz, xr_f, xfr_f, yc_f, yfc_f,
-                                  h_e, u_e, b_loc, ssn, swe, dh_c, du_c)
-            else:
-                h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
-                dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
-            if nu4 != 0.0:
-                # del^4 = lap(lap(.)) with an exchanged refill between,
-                # exactly the fused nu4 stepper's structure: the same
-                # strip exchange applies (lap of a covariant pair is a
-                # covariant pair), and the Laplace-Beltrami cross-terms
-                # need the ghost corners (face-local averaging).
-                def lap3(he, ue):
-                    he = _fill_corners(he, halo, grid.n)
-                    ue = _fill_corners(ue, halo, grid.n)
-                    return (lap1(he[0])[None],
-                            jnp.stack([lap1(ue[0, 0])[None],
-                                       lap1(ue[1, 0])[None]]))
-                l1h, l1u = lap3(h_e, u_e)
-                l1h_e, l1u_e, _, _ = exchange(embed(l1h), embed(l1u), tabs)
-                l2h, l2u = lap3(l1h_e, l1u_e)
-                dh = dh - nu4 * l2h
-                du = du - nu4 * l2u
-            return dh, du
-
-        return ssprk3_sharded_body(f, state, dt)
+        return ssprk3_sharded_body(
+            lambda h, u: f_loc(h, u, tabs, fz, b_loc), state, dt)
 
     shard_body = shard_map(
         body, mesh=mesh,
@@ -696,9 +741,113 @@ def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
     fz_sh = jax.device_put(frames_z, NamedSharding(mesh, P(axes[0])))
     b_sh = jax.device_put(model.b_ext, NamedSharding(mesh, P(axes[0])))
 
-    @jax.jit
+    # donate=True aliases the ping-pong state carry (donate_argnums)
+    # so XLA stops double-buffering every prognostic; default off
+    # because parity/test callers legitimately step one initial state
+    # through several steppers (a donated buffer dies on first use).
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, t):
         del t
         return shard_body(state, tables, fz_sh, b_sh)
 
+    return step
+
+
+def make_sharded_cov_ensemble_stepper(model, setup, dt: float,
+                                      members: int, overlap=None,
+                                      temporal_block: int = 1,
+                                      donate: bool = False):
+    """Batched ensemble stepper on the explicit covariant face tier.
+
+    ``step(state, t) -> state`` over the batched interior state
+    ``{"h": (B, 6, n, n), "u": (2, B, 6, n, n)}`` (member-before-face
+    layout), advancing all ``B = members`` perturbed-IC members one
+    SSPRK3 step (or ``temporal_block`` exactly-fused steps) per call.
+
+    Execution: the single-member face-tier stage closure
+    (:func:`_make_cov_face_rhs` — the serialized/overlapped stepper's
+    own arithmetic) is ``jax.vmap``-ed over the member axis inside the
+    ``shard_map`` body.  Collective batching turns each of the 4
+    schedule stages' ppermutes into ONE collective carrying all local
+    members' strips stacked ``(B_loc, 3, halo, n)`` — per-stage launch
+    latency is paid once per ensemble step instead of once per member,
+    per-member wire bytes unchanged — and the per-face Pallas RHS
+    kernel batches into a single launch with a leading member grid
+    axis.  Per-member values are bitwise-equal to the single-member
+    stepper run B times (vmap maps, it does not reassociate).
+
+    Meshes: the plain face tier ``(panel=6, 1, 1)`` (members stacked
+    locally per device) or :func:`..mesh.setup_ensemble_sharding`'s 2-D
+    ``('panel', 'member')`` mesh, where each device carries
+    ``members / setup.member`` members and the member axis adds zero
+    wire traffic.  ``temporal_block = k > 1`` fuses k steps in one
+    SPMD dispatch (exact — the face tier's deep-halo approximation is
+    NOT applied here; the batched exchange already amortizes the
+    latency the deep form trades accuracy for).
+    """
+    grid = model.grid
+    if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
+        raise ValueError(
+            f"ensemble face stepper needs a (panel=6, ...) face mesh "
+            f"(optionally x member); got panel={setup.panel}, "
+            f"y={setup.sy}, x={setup.sx}")
+    if members < 1:
+        raise ValueError(f"members must be >= 1, got {members}")
+    mshard = getattr(setup, "member", 1)
+    if members % mshard:
+        raise ValueError(
+            f"members={members} not divisible by the mesh's member-"
+            f"shard count {mshard}")
+    if temporal_block < 1:
+        raise ValueError(
+            f"temporal_block must be >= 1, got {temporal_block}")
+    if overlap is None:
+        overlap = getattr(setup, "overlap_exchange", False)
+    mesh = setup.mesh
+    program = CovShardProgram(grid)
+    platform = getattr(mesh.devices.flat[0], "platform", "cpu")
+    f_loc = _make_cov_face_rhs(model, grid, program, overlap, platform)
+    frames_z = jnp.asarray(
+        np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+
+    axes = mesh.axis_names
+    member_ax = "member" if "member" in axes else None
+    pstate = {"h": P(member_ax, "panel"),
+              "u": P(None, member_ax, "panel")}
+    ptab = {k: P("panel") for k in program.tables}
+    maxes = {"h": 0, "u": 1}
+
+    def body(state, tabs, fz, b_loc):
+        def one(st):
+            for _ in range(temporal_block):
+                st = ssprk3_sharded_body(
+                    lambda h, u: f_loc(h, u, tabs, fz, b_loc), st, dt)
+            return st
+
+        return jax.vmap(one, in_axes=(maxes,), out_axes=maxes)(state)
+
+    shard_body = shard_map(
+        body, mesh=mesh,
+        in_specs=(pstate, ptab, P("panel"), P("panel")),
+        out_specs=pstate,
+        check_vma=False,
+    )
+
+    tables = {
+        k: jax.device_put(v, NamedSharding(mesh, P("panel")))
+        for k, v in program.tables.items()
+    }
+    fz_sh = jax.device_put(frames_z, NamedSharding(mesh, P("panel")))
+    b_sh = jax.device_put(model.b_ext, NamedSharding(mesh, P("panel")))
+
+    jitted = jax.jit(lambda state: shard_body(state, tables, fz_sh, b_sh),
+                     donate_argnums=(0,) if donate else ())
+
+    def step(state, t):
+        del t
+        return jitted(state)
+
+    step.ensemble = int(members)
+    if temporal_block > 1:
+        step.steps_per_call = temporal_block
     return step

@@ -61,6 +61,7 @@ from .shard_halo import _block_coords
 
 __all__ = ["CovBlockProgram", "make_cov_block_exchange",
            "make_cov_block_exchange_phases",
+           "make_cov_block_exchange_batched",
            "make_sharded_cov_block_stepper"]
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
@@ -352,6 +353,25 @@ def make_cov_block_exchange(program: CovBlockProgram):
     return exchange
 
 
+def make_cov_block_exchange_batched(program: CovBlockProgram):
+    """Batched ensemble form of :func:`make_cov_block_exchange`.
+
+    ``exchange(h_blk, u_blk, t) -> (h_blk, u_blk, sym_sn, sym_we)`` over
+    member-batched local blocks ``(B, 1, m_loc, m_loc)`` /
+    ``(2, B, 1, m_loc, m_loc)`` — ``jax.vmap`` of the single-member
+    block exchange, so every intra-panel neighbor shift AND cube-edge
+    schedule stage issues ONE ``ppermute`` carrying all members' strips
+    stacked ``(B, 3, halo, n_loc)``.  Per-member ghosts/seam normals are
+    bitwise the per-member loop's (the receive algebra vmaps
+    elementwise); the collective launch count per ensemble step drops
+    B-fold at unchanged per-member wire bytes — the block-mesh face of
+    the batched-exchange design (see shard_cov.py's twin).
+    """
+    exchange1 = make_cov_block_exchange(program)
+    return jax.vmap(exchange1, in_axes=(0, 1, None),
+                    out_axes=(0, 1, 0, 0))
+
+
 def make_block_corner_fill(program: CovBlockProgram):
     """``corner_fill(blk3, t) -> blk3`` — fill the four h x h ghost
     corners of a stacked ``(3, m_loc, m_loc)`` block (h, u_a, u_b) from
@@ -414,7 +434,8 @@ def make_block_corner_fill(program: CovBlockProgram):
 
 
 def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None,
-                                   temporal_block: int = 1):
+                                   temporal_block: int = 1,
+                                   donate: bool = False):
     """``step(state, t) -> state`` for the covariant model on (6, s, s).
 
     State is the usual interior pytree ``{"h": (6, n, n),
@@ -581,7 +602,8 @@ def make_sharded_cov_block_stepper(model, setup, dt: float, overlap=None,
     }
     b_sh = jax.device_put(b_blocks, NamedSharding(mesh, P(*axes)))
 
-    jitted = jax.jit(lambda state: shard_body(state, tables, b_sh))
+    jitted = jax.jit(lambda state: shard_body(state, tables, b_sh),
+                     donate_argnums=(0,) if donate else ())
 
     def step(state, t):
         del t

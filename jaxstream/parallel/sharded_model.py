@@ -35,7 +35,8 @@ __all__ = ["make_sharded_stepper", "make_stepper_for", "shard_params"]
 
 
 def make_stepper_for(model, setup, example_state, dt: float,
-                     scheme: str = "ssprk3", temporal_block: int = None):
+                     scheme: str = "ssprk3", temporal_block: int = None,
+                     ensemble: int = 0, donate: bool = False):
     """Dispatch on the config's ``use_shard_map`` flag.
 
     Explicit ppermute path when requested (and the mesh fits), otherwise
@@ -47,6 +48,15 @@ def make_stepper_for(model, setup, example_state, dt: float,
     how many): the deep-halo blocked stepper on the covariant face tier
     (ONE 3*k*halo-deep exchange per block), exact k-step fusion
     elsewhere.  Callers that count steps must honor ``steps_per_call``.
+
+    ``ensemble = B > 0``: the returned stepper advances a member-batched
+    state (``{"h": (B, 6, n, n), "u": (2, B, 6, n, n)}``-layout) — the
+    explicit covariant face tier uses the batched-exchange ensemble
+    stepper (one ppermute per schedule stage for ALL members), the
+    GSPMD path vmaps the model step over the member axis and lets XLA
+    batch the inferred collectives.  ``donate=True`` donates the state
+    carry at the top-level jit (callers must then treat each input
+    state as consumed).
     """
     if temporal_block is None:
         k = 1 if setup is None else getattr(setup, "temporal_block", 1)
@@ -59,7 +69,8 @@ def make_stepper_for(model, setup, example_state, dt: float,
             # and run the Pallas RHS kernel per device (SSPRK3 only) —
             # one face per device, or sub-panel blocks (tiles_per_edge
             # > 1) on the (6, s, s) mesh.
-            from .shard_cov import make_sharded_cov_stepper
+            from .shard_cov import (make_sharded_cov_ensemble_stepper,
+                                    make_sharded_cov_stepper)
             from .shard_cov_block import make_sharded_cov_block_stepper
 
             if scheme != "ssprk3":
@@ -67,11 +78,27 @@ def make_stepper_for(model, setup, example_state, dt: float,
                     "the explicit covariant shard path implements ssprk3 "
                     f"only; got scheme={scheme!r}"
                 )
+            if ensemble:
+                if setup.sy * setup.sx != 1:
+                    raise ValueError(
+                        "batched ensemble stepping is wired for the "
+                        "face tier (one face per device, optionally x "
+                        "member shards); set tiles_per_edge: 1 — got a "
+                        f"{setup.sy}x{setup.sx} sub-panel split")
+                return make_sharded_cov_ensemble_stepper(
+                    model, setup, dt, ensemble, temporal_block=k,
+                    donate=donate)
             if setup.panel == 6 and setup.sy == setup.sx and setup.sy > 1:
                 return make_sharded_cov_block_stepper(
-                    model, setup, dt, temporal_block=k)
+                    model, setup, dt, temporal_block=k, donate=donate)
             return make_sharded_cov_stepper(model, setup, dt,
-                                            temporal_block=k)
+                                            temporal_block=k,
+                                            donate=donate)
+        if ensemble:
+            raise ValueError(
+                "batched ensemble stepping is wired for the covariant "
+                "explicit tiers and the GSPMD/single-device paths; set "
+                "model.name: shallow_water_cov or use_shard_map: false")
         if k > 1:
             raise ValueError(
                 "parallelization.temporal_block > 1 is wired for the "
@@ -81,20 +108,44 @@ def make_stepper_for(model, setup, example_state, dt: float,
                 "temporal_block: 1 or model.name: shallow_water_cov")
         return make_sharded_stepper(model, setup, example_state, dt, scheme)
     base = model.make_step(dt, scheme)
+    if ensemble:
+        # GSPMD/single-device ensemble: vmap the model step over the
+        # member axis; XLA batches any inferred collectives across
+        # members for free.  Layout rule (the ENSEMBLE_STATE_AXES
+        # convention): vector fields ("u" covariant / "v" Cartesian)
+        # keep their component axis first, member second; scalars lead
+        # with the member axis.
+        from ..stepping import blocked, vmap_ensemble
+
+        axes = {kk: (1 if kk in ("u", "v") else 0)
+                for kk in example_state}
+        vstep = vmap_ensemble(base, axes)
+        if k > 1:
+            vstep = blocked(vstep, k, dt)
+        jitted = jax.jit(vstep, donate_argnums=(0,) if donate else ())
+
+        def step(y, t):
+            return jitted(y, t)
+
+        step.ensemble = int(ensemble)
+        if k > 1:
+            step.steps_per_call = k
+        return step
     if k > 1:
         # GSPMD path: exact k-step fusion under one jit — one dispatch
         # per block, collectives unchanged (XLA may still pipeline
         # across the fused steps).
         from ..stepping import blocked
 
-        jitted = jax.jit(blocked(base, k, dt))
+        jitted = jax.jit(blocked(base, k, dt),
+                         donate_argnums=(0,) if donate else ())
 
         def step(y, t):
             return jitted(y, t)
 
         step.steps_per_call = k
         return step
-    return jax.jit(base)
+    return jax.jit(base, donate_argnums=(0,) if donate else ())
 
 
 def _grid_arrays(grid: CubedSphereGrid):

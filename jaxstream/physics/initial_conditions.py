@@ -30,6 +30,7 @@ __all__ = [
     "williamson_tc5",
     "williamson_tc6",
     "galewsky",
+    "perturbed_ensemble",
 ]
 
 
@@ -200,6 +201,45 @@ def williamson_tc6(
     h = jnp.asarray(gh / gravity, dtype=grid.sqrtg.dtype)
     vec = zonal_meridional_to_cartesian(grid, u, v)
     return h, vec
+
+
+def perturbed_ensemble(
+    grid: CubedSphereGrid,
+    h_ext,
+    members: int,
+    seed: int = 0,
+    amplitude: float = 1.0e-3,
+):
+    """Perturbed-IC height ensemble for batched runs: ``(B, 6, M, M)``.
+
+    Member 0 is the unperturbed ``h_ext``; members ``1..B-1`` add a
+    smooth large-scale perturbation ``amplitude * mean|h| * mode`` with
+    ``mode`` a random unit-normalized combination of three ``l = 1``
+    spherical modes ``ghat_j . rhat`` (the gentlest fields that still
+    decorrelate trajectories — the standard perturbed-IC recipe for TC5
+    / Galewsky spread studies).  Everything is evaluated analytically at
+    extended cell centers in float64 (ghosts exact, like every IC in
+    this module) with a deterministic ``numpy`` generator, so a given
+    ``(seed, members)`` pair reproduces bit-identical ICs across runs
+    and processes.  The wind is left unperturbed — height-only
+    perturbations keep members balanced to the same order as the base
+    state, so no member needs its own spin-up.
+    """
+    if members < 1:
+        raise ValueError(f"members must be >= 1, got {members}")
+    h = _np(h_ext)
+    rhat = _np(grid.xyz) / grid.radius               # (3, 6, M, M)
+    rng = np.random.default_rng(seed)
+    href = float(np.mean(np.abs(h)))
+    out = [h]
+    for _ in range(members - 1):
+        g = rng.standard_normal((3, 3))
+        g /= np.linalg.norm(g, axis=1, keepdims=True)
+        w = rng.standard_normal(3)
+        mode = np.einsum("jk,k...->...", g * w[:, None], rhat)
+        mode /= max(float(np.abs(mode).max()), 1e-300)
+        out.append(h + amplitude * href * mode)
+    return jnp.asarray(np.stack(out), dtype=grid.sqrtg.dtype)
 
 
 def galewsky(

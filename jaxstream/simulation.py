@@ -40,10 +40,11 @@ from .io.history import HistoryWriter, save_geometry
 from .models.advection import TracerAdvection
 from .models.diffusion import ThermalDiffusion
 from .models.shallow_water import ShallowWater
-from .parallel.mesh import setup_sharding, shard_state
+from .parallel.mesh import (setup_ensemble_sharding, setup_sharding,
+                            shard_ensemble_state, shard_state)
 from .parallel.sharded_model import make_stepper_for
 from .physics import initial_conditions as ics
-from .stepping import integrate
+from .stepping import integrate, jit_integrate
 from .utils import diagnostics as diag
 from .utils.logging import get_logger
 
@@ -87,6 +88,23 @@ class Simulation:
         self.t = 0.0
         self.step_count = 0
         self.setup = None
+        self.members = cfg.ensemble.members
+        self._classic_run = None
+        if self.members < 1:
+            raise ValueError(
+                f"ensemble.members must be >= 1, got {self.members}")
+        if self.members > 1:
+            if cfg.model.numerics != "dense":
+                raise ValueError(
+                    "ensemble.members > 1 runs the dense tier only; set "
+                    "model.numerics: dense (the factored TT state has no "
+                    "batched stepper yet)")
+            if cfg.io.history_stride > 0 or cfg.io.checkpoint_stride > 0:
+                raise ValueError(
+                    "ensemble.members > 1 does not write history/"
+                    "checkpoints yet (the IO layers assume unbatched "
+                    "states); set io.history_stride: 0 and "
+                    "io.checkpoint_stride: 0")
         if cfg.model.numerics == "tt":
             self.model = None
             self.state, self._step = self._build_tt()
@@ -98,13 +116,25 @@ class Simulation:
             self.model, self.state = self._build_model_and_state()
 
             par = cfg.parallelization
-            if par.num_devices > 1:
-                self.setup = setup_sharding(cfg)
-                self.state = shard_state(self.setup, self.state)
-            self._step = make_stepper_for(
-                self.model, self.setup, self.state, cfg.time.dt,
-                cfg.time.scheme, temporal_block=par.temporal_block,
-            )
+            if self.members > 1:
+                self.state = self._build_ensemble_state()
+                if par.num_devices > 1:
+                    self.setup = setup_ensemble_sharding(cfg, self.members)
+                    self.state = shard_ensemble_state(self.setup,
+                                                      self.state)
+                self._step = make_stepper_for(
+                    self.model, self.setup, self.state, cfg.time.dt,
+                    cfg.time.scheme, temporal_block=par.temporal_block,
+                    ensemble=self.members,
+                )
+            else:
+                if par.num_devices > 1:
+                    self.setup = setup_sharding(cfg)
+                    self.state = shard_state(self.setup, self.state)
+                self._step = make_stepper_for(
+                    self.model, self.setup, self.state, cfg.time.dt,
+                    cfg.time.scheme, temporal_block=par.temporal_block,
+                )
         # Single-device Pallas SWE runs use the fused extended-state
         # SSPRK3 stepper (the bench flagship): extend/restrict happen once
         # per compiled segment, so the strip carry stays on device between
@@ -115,7 +145,28 @@ class Simulation:
         # nu4 > 0 is fused only where the model declares support (the
         # covariant model's two-kernel del^4 stage pair).
         tb = cfg.parallelization.temporal_block
-        if (self.setup is None and cfg.time.scheme == "ssprk3"
+        if (self.members > 1 and self.setup is None
+                and cfg.time.scheme == "ssprk3"
+                and getattr(m, "backend", "").startswith("pallas")
+                and getattr(m, "nu4", 0.0) == 0.0
+                and hasattr(m, "ensemble_compact_state")):
+            # Batched ensemble fast path: the member axis folds into the
+            # stage kernels' grid, so all B members ride one kernel
+            # launch per stage (jaxstream.ops.pallas.swe_cov).
+            try:
+                self._fused_step = m.make_fused_step(
+                    cfg.time.dt, temporal_block=tb, ensemble=self.members)
+                self._fused_prep = m.ensemble_compact_state
+                log.info("using batched ensemble fused SSPRK3 stepper "
+                         "(%d members per kernel launch)", self.members)
+            except Exception as e:
+                log.warning(
+                    "batched fused stepper unavailable (%s: %s); falling "
+                    "back to the vmapped classic path",
+                    type(e).__name__, e,
+                )
+        elif (self.members == 1 and self.setup is None
+                and cfg.time.scheme == "ssprk3"
                 and getattr(m, "backend", "").startswith("pallas")
                 and (getattr(m, "nu4", 0.0) == 0.0
                      or getattr(m, "fused_supports_nu4", False))
@@ -239,6 +290,30 @@ class Simulation:
         else:
             h, v = ics.galewsky(g, p.gravity, p.omega)
         return {"h": h, "v": v, "b_ext": b_ext}
+
+    def _build_ensemble_state(self):
+        """Batched perturbed-IC ensemble state ``{"h": (B, 6, n, n),
+        "u"|"v": (c, B, 6, n, n)}`` — member 0 unperturbed, members
+        1..B-1 from :func:`...initial_conditions.perturbed_ensemble`
+        (height-only, deterministic in ``ensemble.seed``)."""
+        cfg = self.config
+        ens = cfg.ensemble
+        name = cfg.model.initial_condition
+        family = IC_FAMILY.get(name)
+        if family != "shallow_water":
+            raise ValueError(
+                f"ensemble.members > 1 supports the shallow-water family "
+                f"(tc2/tc5/tc6/galewsky); initial_condition={name!r} "
+                f"drives {family!r}")
+        fields = self._ic_fields(name, family)
+        h_b = ics.perturbed_ensemble(self.grid, fields["h"], ens.members,
+                                     seed=ens.seed,
+                                     amplitude=ens.amplitude)
+        states = [self.model.initial_state(h_b[i], fields["v"])
+                  for i in range(ens.members)]
+        vkey = "u" if "u" in states[0] else "v"
+        return {"h": jnp.stack([s["h"] for s in states]),
+                vkey: jnp.stack([s[vkey] for s in states], axis=1)}
 
     def _build_tt(self):
         """The factored-panel ("Numerics (TT)", pdf p.7) solver tier.
@@ -554,6 +629,15 @@ class Simulation:
                     f"parallelization.temporal_block={spc}; make "
                     "io.history_stride/io.checkpoint_stride and the "
                     "total step count multiples of temporal_block")
+            # Both paths DONATE the state carry (round-7 satellite,
+            # parallelization.donate_state to opt out): segments are
+            # ping-pong by construction (self.state is always replaced
+            # by the result), so XLA aliases the input and output state
+            # instead of double-buffering every prognostic array for
+            # the whole loop.  Accelerator callers holding their own
+            # reference to sim.state across run() calls must copy it
+            # (np.asarray) first — donation consumes the buffers.
+            donate = self.config.parallelization.donate_state
             if self._fused_step is not None:
                 m, fused = self.model, self._fused_step
 
@@ -564,16 +648,21 @@ class Simulation:
                     y_c, t = integrate(fused, y_c, t, _k, _dt)
                     return m.restrict_state(y_c), t
 
-                fn = jax.jit(fn)
+                fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
             else:
                 # unroll=1: the generic tiers' steps are ms-scale (TT
                 # roundings, classic jnp), where the while-carry's
                 # ~us-scale copies are invisible but a 4x-traced step
-                # graph would multiply compile time.
-                fn = jax.jit(
-                    lambda y, t: integrate(self._step, y, t, k // spc,
-                                           dt * spc, unroll=1)
-                )
+                # graph would multiply compile time.  One jit_integrate
+                # executable serves every segment length (nsteps rides
+                # as a traced operand).
+                if self._classic_run is None:
+                    self._classic_run = jit_integrate(
+                        self._step, dt * spc, unroll=1, donate=donate)
+                run = self._classic_run
+
+                def fn(y, t, _k=k // spc):
+                    return run(y, t, _k)
             self._segment_cache[k] = fn
         self.state, t = fn(self.state, self.t)
         self.t = float(t)
@@ -615,6 +704,22 @@ class Simulation:
                 out["energy"] = float(
                     diag.total_energy(g, h, v, p.gravity, b_int))
             return out
+        if "h" in s and self.members > 1:
+            # Member-0 invariants plus the ensemble's height spread (the
+            # quantity a perturbed-IC run exists to grow): per-cell
+            # cross-member std, reported at its max.
+            p = self.config.physics
+            vkey = "u" if "u" in s else "v"
+            s0 = {"h": s["h"][0], vkey: s[vkey][:, 0]}
+            out["mass_m0"] = float(diag.total_mass(g, s0["h"]))
+            b = self.model.b_ext
+            b_int = g.interior(b) if b is not None else 0.0
+            v = s0["v"] if "v" in s0 else self.model.to_cartesian(s0)
+            out["energy_m0"] = float(
+                diag.total_energy(g, s0["h"], v, p.gravity, b_int))
+            out["h_spread_max"] = float(jnp.max(jnp.std(
+                s["h"].astype(jnp.float32), axis=0)))
+            return out
         if "h" in s:
             p = self.config.physics
             out["mass"] = float(diag.total_mass(g, s["h"]))
@@ -643,7 +748,11 @@ class Simulation:
 
         Returns the final state.  History/checkpoints fire on their
         configured strides; everything between strides is one compiled
-        device loop.
+        device loop.  The returned state is ``self.state`` itself and —
+        with the default ``parallelization.donate_state: true`` — will
+        be CONSUMED by the first segment of any later ``run()`` on an
+        accelerator: copy it (``np.asarray``) before continuing the
+        simulation if you need to keep it.
         """
         total = self.total_steps() if nsteps is None else nsteps
         start = self.step_count

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from jax import tree_util as jtu
 
 __all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper",
-           "blocked", "integrate"]
+           "blocked", "integrate", "integrate_with_history",
+           "vmap_ensemble", "jit_integrate", "jit_integrate_with_history"]
 
 
 def _axpy(y, dt, k):
@@ -89,6 +90,26 @@ def blocked(step: Callable, k: int, dt: float) -> Callable:
     return block
 
 
+def vmap_ensemble(step: Callable, axes) -> Callable:
+    """Vmapped reference path for batched ensemble stepping.
+
+    ``axes`` is a pytree matching the carry giving each leaf's member-
+    axis position (e.g. ``{"h": 0, "u": 1}`` for the SWE interior state,
+    where ``u``'s component axis precedes the member axis).  Returns
+    ``vstep(y, t) -> y`` mapping ``step`` over the member axis with the
+    time scalar broadcast.  This is the semantics oracle the batched
+    kernel/exchange paths are tested against — vmap guarantees
+    per-member arithmetic identical to B separate calls — and the
+    fallback when a tier has no natively batched stepper.  Attributes
+    (``steps_per_call``) carry over.
+    """
+    vstep = jax.vmap(step, in_axes=(axes, None), out_axes=axes)
+    spc = getattr(step, "steps_per_call", 1)
+    if spc != 1:
+        vstep.steps_per_call = spc
+    return vstep
+
+
 def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,
               unroll: int = 4):
     """Run ``nsteps`` under one compiled ``lax.fori_loop``.
@@ -153,3 +174,38 @@ def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float
     if rem:  # don't silently drop the trailing nsteps % stride steps
         y, t = jax.lax.fori_loop(0, rem, body, (y, t))
     return y, t, hist
+
+
+def jit_integrate(step: Callable, dt: float, unroll: int = 4,
+                  donate: bool = True) -> Callable:
+    """One compiled ``run(y0, t0, nsteps) -> (y, t)`` over :func:`integrate`.
+
+    The state carry is DONATED (``donate_argnums=0``): without it XLA
+    must keep both the input and output state alive across the loop —
+    double-buffering every prognostic array — because the caller might
+    still hold the input.  Integration carries are ping-pong by nature
+    (the caller always replaces its state with the result), so donation
+    lets XLA alias the two and halves the state's HBM residency.
+    ``nsteps`` rides as a traced operand, so one executable serves any
+    window length.  Callers must treat the passed-in state as consumed
+    (re-donating an already-donated buffer is a runtime error on
+    accelerators; CPU ignores donation).
+    """
+    fn = lambda y0, t0, nsteps: integrate(step, y0, t0, nsteps, dt,
+                                          unroll=unroll)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def jit_integrate_with_history(step: Callable, dt: float, stride: int,
+                               snapshot: Callable,
+                               donate: bool = True) -> Callable:
+    """``run(y0, t0, nsteps) -> (y, t, hist)`` over
+    :func:`integrate_with_history`, state carry donated as in
+    :func:`jit_integrate`.  ``nsteps`` is static here (the scan length
+    must be concrete), so a new window length compiles a new program —
+    use a fixed stride-aligned window for steady output cadences.
+    """
+    fn = lambda y0, t0, nsteps: integrate_with_history(
+        step, y0, t0, nsteps, dt, stride, snapshot)
+    return jax.jit(fn, static_argnums=2,
+                   donate_argnums=(0,) if donate else ())

@@ -53,6 +53,7 @@ from .sphere import _read_strip_fact
 __all__ = [
     "make_tt_strip_exchange",
     "make_tt_strip_exchange_many",
+    "make_tt_ensemble_exchange",
     "make_tt_sphere_advection_sharded",
     "make_tt_sphere_diffusion_sharded",
     "make_tt_sphere_swe_sharded",
@@ -137,6 +138,34 @@ def make_tt_strip_exchange_many(axis_name: str = "panel"):
         return out
 
     return exchange_many
+
+
+def make_tt_ensemble_exchange(axis_name: str = "panel"):
+    """Ensemble form of :func:`make_tt_strip_exchange_many`.
+
+    Returns ``exchange(member_pairs) -> [[(gS, gN, gW, gE), ...], ...]``
+    over a list of B members, each a list of that member's local factor
+    pairs (e.g. the factored SWE's ``(h, ua, ub)``).  All members'
+    fields flatten into ONE :func:`make_tt_strip_exchange_many`
+    schedule, so the whole ensemble's strips ride a single 4-stage
+    ppermute chain — per-stage payload ``(B * P, 1, n)`` — and the ICI
+    latency chain is paid once per ensemble step instead of once per
+    member.  Per-field ghosts are bitwise-identical to a per-member
+    exchange loop (a ppermute of stacked payloads IS the stack of
+    per-member ppermutes; tested in tests/test_ensemble.py).
+    """
+    exchange_many = make_tt_strip_exchange_many(axis_name)
+
+    def exchange(member_pairs):
+        sizes = [len(m) for m in member_pairs]
+        out = exchange_many([p for m in member_pairs for p in m])
+        res, i = [], 0
+        for s in sizes:
+            res.append(out[i:i + s])
+            i += s
+        return res
+
+    return exchange
 
 
 def make_tt_strip_exchange(axis_name: str = "panel"):

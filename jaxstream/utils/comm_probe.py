@@ -26,7 +26,8 @@ from __future__ import annotations
 
 from .profiling import median_chain_seconds
 
-__all__ = ["temporal_block_plan", "probe_exchange", "probe_step_rates",
+__all__ = ["temporal_block_plan", "batched_exchange_plan",
+           "probe_exchange", "probe_step_rates",
            "run_default_probe", "format_report"]
 
 #: ppermutes per SSPRK3 step of the serialized face-tier exchange:
@@ -82,9 +83,50 @@ def temporal_block_plan(n: int, halo: int, temporal_block: int,
     }
 
 
+def batched_exchange_plan(n: int, halo: int, members: int,
+                          rk_stages: int = 3,
+                          dtype_bytes: int = 4) -> dict:
+    """Static exchange accounting of the batched ensemble exchange.
+
+    Pure arithmetic — no devices, no jax — the batched-exchange twin of
+    :func:`temporal_block_plan`, shared by the CLI report, bench.py's
+    ensemble section, and the non-slow plumbing test.  A B-member
+    ensemble step on the face tier issues the SAME 12 ppermutes per
+    step as a single member (4 schedule stages x ``rk_stages``) with
+    every payload stacked ``(B, 3, halo, n)``; a per-member loop would
+    issue ``12 * B``.  Per-member wire bytes are unchanged by
+    construction — stacking amortizes collective LAUNCH latency, it
+    does not compress anything.
+
+    Keys: ``ppermutes_per_step`` (whole ensemble), ``ppermutes_per_
+    member_step`` (12/B), ``serialized_ppermutes_per_member_step`` (12),
+    ``launch_latency_ratio`` (1/B), ``payload_bytes_per_ppermute``
+    (each way, per edge), ``wire_bytes_per_member_step`` (invariant
+    in B).
+    """
+    if members < 1:
+        raise ValueError(f"members must be >= 1, got {members}")
+    if halo < 1 or n < 1:
+        raise ValueError(f"need n >= 1 and halo >= 1, got n={n}, "
+                         f"halo={halo}")
+    B = members
+    per_step = 4 * rk_stages
+    payload = B * 3 * halo * n * dtype_bytes
+    return {
+        "members": B,
+        "ppermutes_per_step": float(per_step),
+        "ppermutes_per_member_step": per_step / B,
+        "serialized_ppermutes_per_member_step": float(per_step),
+        "launch_latency_ratio": 1.0 / B,
+        "payload_bytes_per_ppermute": payload,
+        "wire_bytes_per_member_step": per_step * 3 * halo * n
+            * dtype_bytes,
+    }
+
+
 def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
-                      temporal_block: int = 0, devices=None,
-                      plan_only: bool = False):
+                      temporal_block: int = 0, members: int = 0,
+                      devices=None, plan_only: bool = False):
     """Full probe suite with the shared device/size policy.
 
     The one place the selection lives (CLI, bench multichip, dryrun
@@ -118,6 +160,9 @@ def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
     if temporal_block > 1:
         result["temporal_block_plan"] = temporal_block_plan(
             n, halo, temporal_block)
+    if members > 1:
+        result["batched_exchange_plan"] = batched_exchange_plan(
+            n, halo, members)
     if plan_only:
         return result
 
@@ -135,7 +180,8 @@ def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
     grid = build_grid(n, halo=halo, radius=EARTH_RADIUS, dtype=jnp.float32)
     result.update(probe_exchange(grid, setup.mesh, iters=iters))
     result.update(probe_step_rates(grid, setup, steps=steps,
-                                   temporal_block=temporal_block))
+                                   temporal_block=temporal_block,
+                                   members=members))
     return result
 
 
@@ -201,13 +247,17 @@ def probe_exchange(grid, mesh, iters: int = 100):
 
 
 def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50,
-                     temporal_block: int = 0):
+                     temporal_block: int = 0, members: int = 0):
     """Steady-state steps/s of the explicit covariant face stepper,
     serialized vs overlapped.  Returns ``{"serialized_steps_per_sec",
     "overlap_steps_per_sec", "overlap_speedup"}`` — plus, when
     ``temporal_block = k > 1`` fits the grid, the deep-halo blocked
     stepper's rate (``temporal_block_steps_per_sec`` counts SIMULATED
-    steps: blocks/s x k) and its speedup over the serialized path."""
+    steps: blocks/s x k) and its speedup over the serialized path, and,
+    when ``members = B > 1``, the batched ensemble stepper's rate
+    (``ensemble_member_steps_per_sec`` counts MEMBER-steps: calls/s x B
+    — one call advances every member) with its per-member speedup over
+    the serialized single-member path."""
     import jax
     import jax.numpy as jnp
 
@@ -257,6 +307,30 @@ def probe_step_rates(grid, setup, dt: float = 300.0, steps: int = 50,
     elif k > 1:
         rates["temporal_block_skipped"] = (
             f"n={grid.n} < 3*k*halo={3 * k * grid.halo}")
+
+    B = members
+    if B > 1:
+        from ..parallel.shard_cov import make_sharded_cov_ensemble_stepper
+
+        estep = make_sharded_cov_ensemble_stepper(model, setup, dt, B)
+        ssb = {"h": jnp.stack([ss["h"]] * B),
+               "u": jnp.stack([ss["u"]] * B, axis=1)}
+        from ..parallel.mesh import shard_ensemble_state
+
+        ssb = shard_ensemble_state(setup, ssb)
+        ncalls = max(1, steps // 4)
+
+        @jax.jit
+        def runb(y):
+            return jax.lax.fori_loop(
+                0, ncalls, lambda i, yy: estep(yy, jnp.float32(0.0)), y)
+
+        sec = median_chain_seconds(runb, (ssb,), ncalls, reps=3)
+        rates["ensemble_members"] = B
+        # One call advances every member: member-steps/s = B / call sec.
+        rates["ensemble_member_steps_per_sec"] = round(B / sec, 2)
+        rates["ensemble_per_member_speedup"] = round(
+            (B / sec) / rates["serialized_steps_per_sec"], 4)
     return rates
 
 
@@ -283,6 +357,22 @@ def format_report(result: dict) -> str:
                 f"{result['temporal_block_steps_per_sec']:.1f} "
                 f"(x{result['temporal_block_speedup']:.3f})")
         lines.append(line)
+    if "ensemble_member_steps_per_sec" in result:
+        lines.append(
+            f"comm_probe{tag}: ensemble B={result['ensemble_members']} "
+            f"member-steps/s="
+            f"{result['ensemble_member_steps_per_sec']:.1f} "
+            f"(x{result['ensemble_per_member_speedup']:.3f} per member "
+            f"vs serialized)")
+    be = result.get("batched_exchange_plan")
+    if be:
+        lines.append(
+            f"comm_probe{tag}: batched exchange B={be['members']} "
+            f"ppermutes/member-step="
+            f"{be['ppermutes_per_member_step']:.2f} "
+            f"(vs {be['serialized_ppermutes_per_member_step']:.0f}) "
+            f"payload/ppermute={be['payload_bytes_per_ppermute']} B "
+            f"wire/member-step={be['wire_bytes_per_member_step']} B")
     tb = result.get("temporal_block_plan")
     if tb:
         lines.append(

@@ -110,7 +110,8 @@ _RECON_FLOPS = {"none": 6, "minmod": 14, "mc": 19, "vanleer": 16}
 
 def analytic_cov_step_cost(n: int, *, limiter: str = "mc",
                            dtype_bytes: int = 4, stages: int = 3,
-                           n_faces: int = 6) -> Dict[str, float]:
+                           n_faces: int = 6,
+                           ensemble: int = 1) -> Dict[str, float]:
     """Analytic flops/bytes for ONE fused covariant SSPRK3 step at C``n``.
 
     Pallas custom calls are invisible to :func:`cost_analysis`; this is
@@ -121,11 +122,24 @@ def analytic_cov_step_cost(n: int, *, limiter: str = "mc",
     amortized ~9 field-passes/stage — plus the strip traffic
     (~4*n*(halo+...) per face, <1% at C384, folded into the field count).
 
+    ``ensemble = B``: cost of one step of the batched B-member stepper
+    (``make_fused_ssprk3_cov_compact(ensemble=B)``) — ONE such step
+    advances every member, so flops AND bytes scale by B together and
+    the arithmetic intensity is unchanged.  Scaling both here (rather
+    than letting callers multiply flops alone) is what keeps ensemble
+    rooflines truthful: B-scaled flops against single-member bytes
+    would report a B-inflated intensity that no hardware counter
+    would ever reproduce.  (The per-face orography re-read per member
+    is real extra traffic the model already charges — b rides the
+    per-stage field-pass count.)
+
     Returns ``{"flops", "bytes", "ai", "flops_per_cell_stage"}``.
     """
+    if ensemble < 1:
+        raise ValueError(f"ensemble must be >= 1, got {ensemble}")
     recon = _RECON_FLOPS.get(limiter, _RECON_FLOPS["mc"])
     per_cell_stage = 2 * (17 + recon) + 9 + 44 + 12
-    cells = n_faces * n * n
+    cells = n_faces * n * n * ensemble
     flops = float(per_cell_stage * cells * stages)
     # field passes: stage1 reads y(3)+b(1) writes 3 = 7;
     # stages 2,3 read y(3)+y0(3)+b(1) write 3 = 10  -> 27 per 3 stages.

@@ -8,11 +8,15 @@ steady-state step rates).
 Usage::
 
     python scripts/comm_probe.py [n] [--iters K] [--steps K]
-                                 [--temporal-block K] [--json]
+                                 [--temporal-block K] [--members B]
+                                 [--json]
 
 ``--temporal-block K`` adds the deep-halo blocked stepper's rate and
 the static exchanges/step + redundant-compute accounting
 (:func:`jaxstream.utils.comm_probe.temporal_block_plan`).
+``--members B`` adds the batched ensemble stepper's member-steps/s and
+the batched-exchange payload/ppermute accounting
+(:func:`jaxstream.utils.comm_probe.batched_exchange_plan`).
 
 Device selection: uses the DEFAULT platform's devices when at least 6
 exist (a real slice measures real ICI); otherwise falls back to 6
@@ -37,12 +41,13 @@ def main():
     iters = 100
     steps = 30
     temporal_block = 0
+    members = 0
     as_json = "--json" in args
     for i, a in enumerate(args):
-        if a in ("--iters", "--steps", "--temporal-block"):
+        if a in ("--iters", "--steps", "--temporal-block", "--members"):
             if i + 1 >= len(args) or not args[i + 1].isdigit():
                 print(f"usage: comm_probe.py [n] [--iters K] [--steps K] "
-                      f"[--temporal-block K] [--json] "
+                      f"[--temporal-block K] [--members B] [--json] "
                       f"({a} needs an integer value)",
                       file=sys.stderr)
                 raise SystemExit(2)
@@ -50,6 +55,8 @@ def main():
                 iters = int(args[i + 1])
             elif a == "--steps":
                 steps = int(args[i + 1])
+            elif a == "--members":
+                members = int(args[i + 1])
             else:
                 temporal_block = int(args[i + 1])
 
@@ -57,7 +64,8 @@ def main():
 
     result = comm_probe.run_default_probe(iters=iters, steps=steps,
                                           n=n_arg,
-                                          temporal_block=temporal_block)
+                                          temporal_block=temporal_block,
+                                          members=members)
     if as_json:
         print(json.dumps(result))
     else:

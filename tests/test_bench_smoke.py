@@ -1,0 +1,47 @@
+"""bench.py --smoke wired into the tier-1 gate (round-7 CI satellite).
+
+The full benchmark only runs offline on a TPU, so bench bitrot (an
+import drift, a renamed helper, a JSON-assembly typo) historically
+surfaced rounds later.  ``bench_smoke`` is the C24/no-gates canary:
+this test drives it through ``main()``'s ``--smoke`` flag IN-PROCESS
+(subprocess startup would pay ~15 s of interpreter+jax boot for no
+extra coverage) and checks the one-line JSON contract the driver
+scrapes.
+"""
+
+import io
+import json
+import sys
+
+import numpy as np
+
+
+def test_bench_smoke_runs_and_reports(monkeypatch, capsys):
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    import bench
+
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--smoke"])
+    code = None
+    try:
+        bench.main()
+    except SystemExit as e:
+        code = e.code
+    assert code == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "smoke must print exactly ONE JSON line"
+    rec = json.loads(out[0])
+    assert rec["smoke"] is True
+    assert rec["ok"] is True
+    assert rec["metric"].startswith("bench_smoke_TC5_C")
+    ens = rec["ensemble"]
+    assert ens["impl"] in ("fused_kernel", "vmap_classic")
+    for key in ("B1", "B2"):
+        assert ens[key]["sim_days_per_sec"] > 0.0, key
+        assert np.isfinite(ens[key]["sim_days_per_sec"])
+    # B=2 advances two members per step; a correct batched path beats
+    # B=1 aggregate comfortably (measured ~2x on CPU).  The 0.9 floor
+    # only guards against a batched step that silently advances one
+    # member — wall-clock noise on a loaded CI box must not flake this.
+    assert (ens["B2"]["sim_days_per_sec"]
+            >= 0.9 * ens["B1"]["sim_days_per_sec"])
+    assert ens["batched_exchange_plan"]["members"] == 2
