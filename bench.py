@@ -45,6 +45,37 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _argv_value(flag: str) -> str:
+    """Value following ``flag`` in argv, or '' (no argparse: the JSON
+    contract is one stdout line and the flag surface is tiny)."""
+    argv = sys.argv[1:]
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return ""
+
+
+def _open_telemetry(path: str):
+    """Structured-sink handle for ``--telemetry PATH`` (jaxstream.obs).
+
+    The benchmark's rates land as schema-valid ``bench`` records in the
+    same JSONL format Simulation emits, so scripts/telemetry_report.py
+    reads either.  Never fails the benchmark — a sink problem logs to
+    stderr and returns None.
+    """
+    if not path:
+        return None
+    try:
+        from jaxstream.obs.sink import TelemetrySink, run_manifest
+
+        return TelemetrySink(path, run_manifest(
+            config={"harness": "bench.py", "argv": sys.argv[1:]}))
+    except Exception as e:
+        log(f"bench: telemetry sink unavailable ({type(e).__name__}: {e})")
+        return None
+
+
 def _roofline_json(steps_per_sec: float, n: int, scale: float = 1.0,
                    bytes_scale: float = 1.0, ensemble: int = 1):
     """Roofline numbers for one covariant-fused-stepper rate, as JSON.
@@ -805,7 +836,7 @@ def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
     return out
 
 
-def bench_smoke(n=24, dt=600.0):
+def bench_smoke(n=24, dt=600.0, telemetry=""):
     """``--smoke``: C24, a handful of steps, NO accuracy gates.
 
     A cheap end-to-end pass through bench's machinery — grid + TC5 ICs,
@@ -825,7 +856,7 @@ def bench_smoke(n=24, dt=600.0):
         ens = {"skipped": f"{type(e).__name__}: {e}"}
     b1 = ens.get("B1", {})
     ok = isinstance(b1, dict) and b1.get("sim_days_per_sec", 0.0) > 0.0
-    print(json.dumps({
+    rec = {
         "metric": f"bench_smoke_TC5_C{n}",
         "smoke": True,
         "value": b1.get("sim_days_per_sec", 0.0)
@@ -834,7 +865,22 @@ def bench_smoke(n=24, dt=600.0):
         "ok": bool(ok),
         "ensemble": ens,
         "wall_s": round(time.perf_counter() - t0, 1),
-    }))
+    }
+    sink = _open_telemetry(telemetry)
+    if sink is not None:
+        for key in ("B1", "B2"):
+            b = ens.get(key, {})
+            if isinstance(b, dict) and "sim_days_per_sec" in b:
+                sink.write({"kind": "bench",
+                            "metric": f"{rec['metric']}_{key}",
+                            "value": b["sim_days_per_sec"],
+                            "unit": "sim-days/sec (smoke window)",
+                            "steps_per_sec": b.get("steps_per_sec")})
+        sink.write({"kind": "bench", "metric": rec["metric"],
+                    "value": rec["value"], "unit": rec["unit"],
+                    "ok": rec["ok"], "wall_s": rec["wall_s"]})
+        sink.close()
+    print(json.dumps(rec))
     return 0 if ok else 1
 
 
@@ -893,8 +939,9 @@ def bench_multichip():
 
 
 def main():
+    telemetry = _argv_value("--telemetry")
     if "--smoke" in sys.argv[1:]:
-        raise SystemExit(bench_smoke())
+        raise SystemExit(bench_smoke(telemetry=telemetry))
     gates_ok = accuracy_gates()
     value, variants = bench_tc5()
     multichip = bench_multichip()
@@ -926,6 +973,20 @@ def main():
     # emit it top-level, with the dt=60-equivalent rate adjacent, so
     # cross-round comparisons of `value` are self-describing.
     dt60 = variants.pop("dt60_equivalent", round(value * 60.0 / BENCH_DT, 4))
+    sink = _open_telemetry(telemetry)
+    if sink is not None:
+        sink.write({"kind": "bench",
+                    "metric": "sim_days_per_sec_per_chip_TC5_C384",
+                    "value": round(value, 4),
+                    "unit": "sim-days/sec/chip", "dt": BENCH_DT,
+                    "gates_ok": bool(gates_ok)})
+        for name, v in variants.items():
+            if isinstance(v, dict) and "sim_days_per_sec" in v:
+                sink.write({"kind": "bench", "metric": f"variant_{name}",
+                            "value": v["sim_days_per_sec"],
+                            "unit": "sim-days/sec/chip",
+                            "steps_per_sec": v.get("steps_per_sec")})
+        sink.close()
     print(json.dumps({
         "metric": "sim_days_per_sec_per_chip_TC5_C384",
         "value": round(value, 4),

@@ -24,6 +24,7 @@ __all__ = [
     "TimeConfig",
     "IOConfig",
     "EnsembleConfig",
+    "ObservabilityConfig",
     "Config",
     "load_config",
 ]
@@ -147,6 +148,29 @@ class EnsembleConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """In-loop run telemetry (jaxstream.obs) — off by default, and when
+    off the run is bit-for-bit today's behavior.  With ``interval > 0``
+    the compiled segment loops compute the configured invariant ladder
+    on device every ``interval`` steps into a small buffer fetched with
+    ONE device->host transfer per segment (docs/USAGE.md
+    "Observability")."""
+    # Comma-separated metric names (jaxstream.obs.metrics.METRICS), or
+    # 'default' for the model family's ladder — SWE: mass, energy,
+    # [enstrophy,] h_min, h_max, max_speed, cfl, nonfinite_count.
+    metrics: str = "default"
+    interval: int = 0         # steps between in-loop samples; 0 = off
+    sink: str = ""            # JSONL path for manifest/segment records; '' = none
+    # Guard policy on a NaN/Inf sample or CFL breach:
+    # 'off' | 'warn' | 'checkpoint_and_raise' | 'halt'.
+    guards: str = "off"
+    cfl_limit: float = 2.0    # local-CFL guard threshold
+    # Testing hook: inject NaN into the metric STREAM (never the state)
+    # at this global step (must be a sampled step); -1 = disabled.
+    fault_step: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grid: GridConfig = GridConfig()
     parallelization: ParallelConfig = ParallelConfig()
@@ -155,6 +179,7 @@ class Config:
     time: TimeConfig = TimeConfig()
     io: IOConfig = IOConfig()
     ensemble: EnsembleConfig = EnsembleConfig()
+    observability: ObservabilityConfig = ObservabilityConfig()
 
 
 _SECTIONS = {
@@ -165,6 +190,7 @@ _SECTIONS = {
     "time": TimeConfig,
     "io": IOConfig,
     "ensemble": EnsembleConfig,
+    "observability": ObservabilityConfig,
 }
 
 

@@ -1,0 +1,26 @@
+"""Run telemetry: on-device metric streams, health guards, structured sinks.
+
+The in-loop observability subsystem (SURVEY.md §5 "Metrics" made live):
+:mod:`.metrics` computes the Williamson invariant ladder *inside* the
+jitted segment loop and accumulates it into a small device buffer
+fetched once per segment; :mod:`.monitor` watches the fetched stream
+for NaN/Inf blowups and CFL breaches with a configurable policy;
+:mod:`.sink` writes the run manifest and per-segment records as JSONL
+for ``scripts/telemetry_report.py``.  Wired through
+``Simulation`` by the ``observability:`` config block (off by default —
+enabling it must not perturb the state carry, asserted bitwise in
+tests/test_obs.py).
+"""
+
+from .metrics import (METRICS, MetricSet, MetricSpec, build_metric_set,
+                      default_metrics, fetch_buffer)
+from .monitor import GUARD_POLICIES, HealthError, HealthMonitor
+from .sink import (RECORD_KINDS, TelemetrySink, read_records,
+                   validate_record)
+
+__all__ = [
+    "METRICS", "MetricSet", "MetricSpec", "build_metric_set",
+    "default_metrics", "fetch_buffer",
+    "GUARD_POLICIES", "HealthError", "HealthMonitor",
+    "RECORD_KINDS", "TelemetrySink", "read_records", "validate_record",
+]

@@ -1,0 +1,309 @@
+"""On-device metric streams: the invariant ladder, computed in-loop.
+
+Williamson et al. (1992) define the conservation ladder a shallow-water
+run must monitor continuously (mass, energy, potential enstrophy); this
+module adds the run-health scalars that catch a blowup while it is
+still cheap (h min/max, max |v|, local CFL number, nonfinite count) and
+packages them so the *segment loop itself* computes them:
+
+  * a :class:`MetricSpec` registry (:data:`METRICS`) of named scalar
+    reductions over the interior state;
+  * :func:`build_metric_set` resolves a config's metric names against a
+    model/state family into a :class:`MetricSet` whose ``values(state)``
+    returns ONE stacked ``(k_metrics,)`` vector — the quantity
+    :func:`jaxstream.stepping.integrate_with_metrics` accumulates into
+    the ``(k_metrics, samples)`` device buffer;
+  * :func:`fetch_buffer` is the single device->host transfer per
+    segment (tests monkeypatch it to assert the one-fetch budget).
+
+Everything here is plain ``jnp`` reductions over the global state, so
+the same metric function serves every execution tier: under GSPMD or
+``shard_map`` steppers the state arrays are sharded and XLA partitions
+the reductions into per-face partials + ``psum`` automatically (parity
+with the eager ``Simulation.diagnostics()`` is tested at C24 on the
+6-device explicit tier); under the batched ensemble tiers the member
+axis is detected by rank and invariants are reported for member 0 with
+the nonfinite count taken over ALL members (a blowup anywhere in the
+ensemble must trip the guard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import diagnostics as diag
+
+__all__ = ["MetricSpec", "METRICS", "MetricSet", "build_metric_set",
+           "default_metrics", "fetch_buffer", "state_family"]
+
+#: Invariants whose relative drift vs step 0 is worth a sink column.
+CONSERVED = ("mass", "energy", "enstrophy", "tracer_mass", "heat")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One named scalar metric: ``fn(ctx) -> 0-d jnp value``.
+
+    ``requires`` is the set of capability tags a run must provide —
+    subset of ``{"swe", "cov", "advection", "diffusion"}`` ("cov": the
+    covariant-velocity model, whose vorticity operator enstrophy
+    needs).  Empty set = available for every family.
+    """
+    name: str
+    doc: str
+    requires: frozenset
+    fn: Callable
+
+
+class _Ctx:
+    """Lazy per-sample intermediates shared between metric functions.
+
+    Built once per ``MetricSet.values`` call; properties cache, so e.g.
+    ``max_speed`` and ``cfl`` share one ``speed2`` computation.  Member-
+    batched states (scalar field of rank 4) expose member 0 through
+    ``field0``/``u0`` while ``all_arrays`` keeps the full batch (the
+    nonfinite count must see every member).
+    """
+
+    def __init__(self, ms: "MetricSet", state):
+        self.ms = ms
+        self.state = state
+        self.grid = ms.grid
+        self.dt = ms.dt
+        self.gravity = ms.gravity
+        self.b_int = ms.b_int
+        f = state[ms.field_key]
+        self.batched = f.ndim == 4
+        self.field0 = f[0] if self.batched else f
+        self._cache: Dict[str, object] = {}
+
+    def _memo(self, key, fn):
+        if key not in self._cache:
+            self._cache[key] = fn()
+        return self._cache[key]
+
+    @property
+    def u0(self):
+        u = self.state["u" if "u" in self.state else "v"]
+        return u[:, 0] if self.batched else u
+
+    @property
+    def speed2(self):
+        def mk():
+            u = self.u0
+            if self.ms.cov:
+                iaa, iab, ibb = self.ms.ginv_int
+                uc_a = iaa * u[0] + iab * u[1]
+                uc_b = iab * u[0] + ibb * u[1]
+                return uc_a * u[0] + uc_b * u[1]
+            return jnp.sum(u * u, axis=0)
+        return self._memo("speed2", mk)
+
+    @property
+    def vcart(self):
+        def mk():
+            if self.ms.cov:
+                return self.ms.model.to_cartesian({"u": self.u0})
+            return self.u0
+        return self._memo("vcart", mk)
+
+    @property
+    def absvort(self):
+        def mk():
+            from ..ops.fv import vorticity_cov
+
+            m = self.ms.model
+            return vorticity_cov(self.grid, m._fill_u(self.u0)) + m.fcor
+        return self._memo("absvort", mk)
+
+    @property
+    def all_arrays(self):
+        return [v for v in self.state.values()]
+
+
+METRICS: Dict[str, MetricSpec] = {}
+
+
+def _register(name, doc, requires, fn):
+    METRICS[name] = MetricSpec(name, doc, frozenset(requires), fn)
+
+
+def _nonfinite(c: _Ctx):
+    total = 0
+    for a in c.all_arrays:
+        total = total + jnp.sum(~jnp.isfinite(a))
+    # float so it stacks with the invariant scalars.
+    return jnp.asarray(total, c.field0.dtype)
+
+
+_register("mass", "integral h dA (member 0)", {"swe"},
+          lambda c: diag.total_mass(c.grid, c.field0))
+_register("energy", "integral [h|v|^2/2 + g h (h/2 + b)] dA", {"swe"},
+          lambda c: diag.total_energy(c.grid, c.field0, c.vcart,
+                                      c.gravity, c.b_int))
+_register("enstrophy", "integral (zeta + f)^2 / (2h) dA", {"swe", "cov"},
+          lambda c: diag.potential_enstrophy(c.grid, c.field0, c.absvort))
+_register("h_min", "min h (blowups go negative first)", {"swe"},
+          lambda c: jnp.min(c.field0))
+_register("h_max", "max h", {"swe"},
+          lambda c: jnp.max(c.field0))
+_register("max_speed", "max |v| (m/s)", {"swe"},
+          lambda c: jnp.sqrt(jnp.max(c.speed2)))
+# Local 2-D CFL in the bench's convention: per-cell (sqrt(g h) + |v|)
+# times (1/dx_a + 1/dx_b) from the metric cell spacings, max over cells,
+# times dt.  A negative h makes this NaN — which the NaN guard catches,
+# exactly the behavior a blowup monitor wants.
+_register("cfl", "dt * max_cell (sqrt(gh) + |v|)(1/dxa + 1/dxb)", {"swe"},
+          lambda c: c.dt * jnp.max(
+              (jnp.sqrt(c.gravity * c.field0) + jnp.sqrt(c.speed2))
+              * c.ms.inv_dx))
+_register("nonfinite_count", "number of non-finite state entries "
+          "(all members)", set(), _nonfinite)
+_register("tracer_mass", "integral q dA", {"advection"},
+          lambda c: diag.total_mass(c.grid, c.field0))
+_register("tracer_max", "max q (shape preservation)", {"advection"},
+          lambda c: jnp.max(c.field0))
+_register("heat", "integral T dA", {"diffusion"},
+          lambda c: diag.total_mass(c.grid, c.field0))
+
+
+def state_family(state) -> str:
+    """'swe' | 'advection' | 'diffusion' from the prognostic keys."""
+    if "h" in state:
+        return "swe"
+    if "q" in state:
+        return "advection"
+    if "T" in state:
+        return "diffusion"
+    raise ValueError(
+        f"cannot infer a model family from state keys {sorted(state)}")
+
+
+def default_metrics(family: str, cov: bool) -> tuple:
+    """The default metric ladder for one model family."""
+    if family == "swe":
+        names = ["mass", "energy"]
+        if cov:
+            names.append("enstrophy")
+        return tuple(names + ["h_min", "h_max", "max_speed", "cfl",
+                              "nonfinite_count"])
+    if family == "advection":
+        return ("tracer_mass", "tracer_max", "nonfinite_count")
+    return ("heat", "nonfinite_count")
+
+
+@dataclasses.dataclass
+class MetricSet:
+    """Resolved metrics for one run: ``values(state) -> (k,) vector``.
+
+    ``state`` is the *interior* prognostic dict ({"h","u"/"v"} / {"q"} /
+    {"T"}), optionally member-batched; non-prognostic carry keys
+    (strips) must be dropped by the caller (``Simulation`` restricts the
+    fused carries first).
+    """
+    names: tuple
+    specs: tuple
+    grid: object
+    model: object
+    dt: float
+    gravity: float
+    field_key: str
+    cov: bool
+    b_int: object = None
+    ginv_int: object = None
+    inv_dx: object = None
+
+    @property
+    def k(self) -> int:
+        return len(self.names)
+
+    def values(self, state):
+        ctx = _Ctx(self, state)
+        return jnp.stack([jnp.asarray(s.fn(ctx)) for s in self.specs])
+
+
+def resolve_metric_names(names, family: str, cov: bool) -> tuple:
+    """Config value -> validated metric-name tuple.
+
+    Accepts a list/tuple, a comma-separated string, or ``"default"`` /
+    ``""`` (the family ladder).  Unknown names and metrics a family
+    cannot provide raise with the valid set listed.
+    """
+    if isinstance(names, str):
+        names = (default_metrics(family, cov)
+                 if names.strip() in ("", "default")
+                 else tuple(s.strip() for s in names.split(",") if s.strip()))
+    else:
+        names = tuple(names)
+        if not names:
+            names = default_metrics(family, cov)
+    caps = {family} | ({"cov"} if cov else set())
+    valid = sorted(n for n, s in METRICS.items() if s.requires <= caps)
+    for n in names:
+        if n not in METRICS:
+            raise ValueError(
+                f"unknown observability metric {n!r}; registered: "
+                f"{sorted(METRICS)}")
+        if not METRICS[n].requires <= caps:
+            raise ValueError(
+                f"observability metric {n!r} is not available for this "
+                f"run (needs {sorted(METRICS[n].requires)}); valid here: "
+                f"{valid}")
+    return names
+
+
+def build_metric_set(grid, model, example_state, names, dt: float,
+                     gravity: float) -> MetricSet:
+    """Resolve ``names`` against a model/state and precompute statics.
+
+    ``example_state``: an interior prognostic dict (used for family
+    detection only — no values are read).  ``model`` may be ``None``
+    for the scalar families; SWE metrics need it (velocity frame,
+    orography, vorticity operator).
+    """
+    family = state_family(example_state)
+    cov = family == "swe" and "u" in example_state
+    names = resolve_metric_names(names, family, cov)
+    specs = tuple(METRICS[n] for n in names)
+    field_key = {"swe": "h", "advection": "q", "diffusion": "T"}[family]
+    ms = MetricSet(names=names, specs=specs, grid=grid, model=model,
+                   dt=dt, gravity=gravity, field_key=field_key, cov=cov)
+    if family == "swe":
+        if model is None:
+            raise ValueError("SWE observability metrics need the model")
+        b = getattr(model, "b_ext", None)
+        ms.b_int = grid.interior(b) if b is not None else 0.0
+        if cov:
+            ms.ginv_int = (grid.interior(model.ginv_aa),
+                           grid.interior(model.ginv_ab),
+                           grid.interior(model.ginv_bb))
+        if any(n == "cfl" for n in names):
+            # Static per-cell inverse spacings from the metric basis:
+            # dx_i = |e_i| * dalpha (concrete once at build — cheap for
+            # eager and lazy grids alike).
+            na = jnp.sqrt(jnp.sum(grid.e_a * grid.e_a, axis=0))
+            nb = jnp.sqrt(jnp.sum(grid.e_b * grid.e_b, axis=0))
+            ms.inv_dx = grid.interior(1.0 / (na * grid.dalpha)
+                                      + 1.0 / (nb * grid.dalpha))
+    return ms
+
+
+def fetch_buffer(buf) -> np.ndarray:
+    """THE one device->host transfer of a segment's metric buffer.
+
+    Starts an async copy first (the transfer flies while Python builds
+    the record) and returns the host ``(k_metrics, samples)`` array.
+    Kept as a module-level seam so tests can monkeypatch it to count
+    fetches (the at-most-one-per-segment acceptance budget).
+    """
+    try:  # not every backend/array type exposes the async copy
+        buf.copy_to_host_async()
+    except Exception:
+        pass
+    return np.asarray(jax.device_get(buf))

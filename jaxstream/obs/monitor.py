@@ -1,0 +1,136 @@
+"""Health guards over the fetched metric stream.
+
+A marginally-resolved run (the Galewsky jet is the canonical case)
+blows up silently: NaNs appear mid-segment and every later step is
+wasted compute.  :class:`HealthMonitor` watches the per-segment metric
+buffer — entirely host-side, on values the loop already produced, so
+guarding costs zero extra device work — and applies a configurable
+policy when a sample is non-finite or the local CFL number breaches
+its limit:
+
+  * ``warn``: log and keep integrating (the default when guards are on);
+  * ``halt``: raise :class:`HealthError` carrying the last-good
+    step/time so the driver can stop cleanly;
+  * ``checkpoint_and_raise``: first invoke the ``on_breach`` callback
+    (``Simulation`` saves a postmortem checkpoint of the current —
+    possibly corrupt — state), then raise.  The *last-good* step/time
+    in the error is the restart target; the postmortem checkpoint is
+    for inspection, not resumption.
+
+Fault injection for testing lives upstream: the
+``observability.fault_step`` config makes the in-loop sampler write NaN
+into the metric *stream* (never the state) at one global step, so a
+test can prove the whole fetch->check->raise path fires without
+integrating a real blowup.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+__all__ = ["GUARD_POLICIES", "HealthError", "HealthMonitor"]
+
+log = get_logger(__name__)
+
+GUARD_POLICIES = ("off", "warn", "checkpoint_and_raise", "halt")
+
+
+class HealthError(RuntimeError):
+    """A guard tripped.  Carries the breach and the last-good sample."""
+
+    def __init__(self, kind: str, step: int, value: float,
+                 last_good_step: Optional[int],
+                 last_good_t: Optional[float]):
+        self.kind = kind
+        self.step = int(step)
+        self.value = float(value)
+        self.last_good_step = last_good_step
+        self.last_good_t = last_good_t
+        where = (f"last good step {last_good_step} (t={last_good_t:.0f} s)"
+                 if last_good_step is not None
+                 else "no good sample observed")
+        super().__init__(
+            f"health guard tripped: {kind} at step {step} "
+            f"(value {value:g}); {where}")
+
+
+class HealthMonitor:
+    """Check each segment's fetched ``(k_metrics, samples)`` buffer.
+
+    ``names`` fixes the buffer's row order.  A sample is *bad* when any
+    of its metric values is non-finite, when the ``nonfinite_count``
+    row is positive, or when the ``cfl`` row exceeds ``cfl_limit``.
+    Samples are scanned in step order; good samples advance the
+    last-good cursor, the first bad sample triggers the policy.
+    """
+
+    def __init__(self, names: Sequence[str], policy: str = "warn",
+                 cfl_limit: float = 2.0,
+                 on_breach: Optional[Callable] = None):
+        if policy not in GUARD_POLICIES:
+            raise ValueError(
+                f"guard policy must be one of {GUARD_POLICIES}, "
+                f"got {policy!r}")
+        self.names = tuple(names)
+        self.policy = policy
+        self.cfl_limit = float(cfl_limit)
+        self.on_breach = on_breach
+        self.last_good_step: Optional[int] = None
+        self.last_good_t: Optional[float] = None
+        self.events: list = []
+        self._i_nonfinite = (self.names.index("nonfinite_count")
+                             if "nonfinite_count" in self.names else None)
+        self._i_cfl = (self.names.index("cfl")
+                       if "cfl" in self.names else None)
+
+    def _classify(self, col) -> Optional[tuple]:
+        """(kind, value) of the first breach in one sample, or None."""
+        if not np.all(np.isfinite(col)):
+            bad = col[~np.isfinite(col)]
+            return "nan", float(bad[0])
+        if self._i_nonfinite is not None and col[self._i_nonfinite] > 0:
+            return "nan", float(col[self._i_nonfinite])
+        if self._i_cfl is not None and col[self._i_cfl] > self.cfl_limit:
+            return "cfl", float(col[self._i_cfl])
+        return None
+
+    def check(self, steps, ts, buf) -> list:
+        """Scan one segment: ``steps``/``ts`` per sample, ``buf``
+        ``(k_metrics, samples)``.  Returns the guard-event dicts it
+        appended (for the sink); raises per policy on a breach."""
+        new_events = []
+        buf = np.asarray(buf)
+        for j in range(buf.shape[1]):
+            breach = self._classify(buf[:, j])
+            if breach is None:
+                self.last_good_step = int(steps[j])
+                self.last_good_t = float(ts[j])
+                continue
+            kind, value = breach
+            event = {
+                "kind": "guard", "event": kind, "step": int(steps[j]),
+                "t": float(ts[j]), "value": value, "policy": self.policy,
+                "last_good_step": self.last_good_step,
+                "last_good_t": self.last_good_t,
+            }
+            new_events.append(event)
+            self.events.append(event)
+            if self.policy == "warn":
+                log.warning(
+                    "health guard: %s at step %d (value %g; last good "
+                    "step %s) — policy 'warn', continuing",
+                    kind, steps[j], value, self.last_good_step)
+                continue
+            if self.policy == "checkpoint_and_raise" and self.on_breach:
+                try:
+                    self.on_breach()
+                except Exception as e:  # the raise below must still fire
+                    log.warning("guard breach callback failed (%s: %s)",
+                                type(e).__name__, e)
+            raise HealthError(kind, int(steps[j]), value,
+                              self.last_good_step, self.last_good_t)
+        return new_events

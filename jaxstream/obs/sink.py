@@ -1,0 +1,130 @@
+"""Structured telemetry sink: run manifest + per-segment JSONL records.
+
+One run = one JSONL file.  Line 1 is the ``manifest`` record (static
+run identity: config echo, devices, metric names); each compiled
+segment then appends one ``segment`` record (step/time, the sampled
+invariants, drift vs step 0, wall seconds and rates), guards append
+``guard`` records, and benchmark harnesses append ``bench`` records.
+The format is append-only plain JSONL so a crashed run's telemetry
+survives to the last flushed line; ``scripts/telemetry_report.py``
+turns a file into the drift table / rate timeline / guard-event
+summary.
+
+Schema discipline lives in :func:`validate_record` — the tests
+round-trip records through a file and validate every line, so a field
+rename here fails the tier-1 gate rather than silently breaking the
+report CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["RECORD_KINDS", "TelemetrySink", "read_records",
+           "validate_record", "run_manifest"]
+
+#: kind -> required keys (beyond "kind").
+RECORD_KINDS: Dict[str, tuple] = {
+    "manifest": ("schema_version", "created_unix", "metric_names",
+                 "interval", "guards", "config", "devices"),
+    "segment": ("step", "t", "steps", "wall_s", "steps_per_sec",
+                "sim_days_per_sec_per_chip", "metrics", "drift"),
+    "guard": ("event", "step", "t", "value", "policy",
+              "last_good_step", "last_good_t"),
+    "bench": ("metric", "value", "unit"),
+}
+
+SCHEMA_VERSION = 1
+
+
+def validate_record(rec: dict) -> dict:
+    """Raise ``ValueError`` unless ``rec`` is schema-valid; returns it."""
+    kind = rec.get("kind")
+    if kind not in RECORD_KINDS:
+        raise ValueError(
+            f"telemetry record kind {kind!r} unknown; valid: "
+            f"{sorted(RECORD_KINDS)}")
+    missing = [k for k in RECORD_KINDS[kind] if k not in rec]
+    if missing:
+        raise ValueError(
+            f"telemetry {kind!r} record missing keys {missing}")
+    return rec
+
+
+def run_manifest(metric_names=(), interval: int = 0, guards: str = "off",
+                 config: Optional[dict] = None) -> dict:
+    """The static run-identity record (line 1 of every sink file)."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "kind": "manifest",
+        "schema_version": SCHEMA_VERSION,
+        "created_unix": time.time(),
+        "metric_names": list(metric_names),
+        "interval": int(interval),
+        "guards": guards,
+        "config": config or {},
+        "devices": {
+            "platform": devs[0].platform,
+            "count": len(devs),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        },
+        "jax_version": jax.__version__,
+    }
+
+
+class TelemetrySink:
+    """JSONL writer for ONE run; validates every record on the way out.
+
+    Flushes per record: telemetry's whole value is surviving the crash
+    that truncates the run.  Opening a sink TRUNCATES an existing file
+    — one file is one run (two manifests in a file would make the
+    report CLI mix two runs' drift anchors); point ``observability.
+    sink`` at a fresh path per attempt if you want to keep the old
+    record.  Multihost runs should only open a sink on process 0
+    (``Simulation`` enforces this).
+    """
+
+    def __init__(self, path: str, manifest: dict):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "w", buffering=1)
+        self.n_written = 0
+        self.write(manifest)
+
+    def write(self, rec: dict) -> dict:
+        validate_record(rec)
+        self._fh.write(json.dumps(rec) + "\n")
+        self.n_written += 1
+        return rec
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str, kind: Optional[str] = None) -> List[dict]:
+    """Parse a sink file back; optionally filter to one record kind."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = validate_record(json.loads(line))
+            if kind is None or rec["kind"] == kind:
+                out.append(rec)
+    return out

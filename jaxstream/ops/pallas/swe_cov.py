@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ...utils.jax_compat import tpu_compiler_params
+from ...utils.jax_compat import named_scope, tpu_compiler_params
 
 from ...geometry.connectivity import (
     EDGE_E,
@@ -1953,12 +1953,15 @@ def make_fused_ssprk3_cov_compact(
         def step(y, t):
             del t
             h0, u0 = y["h"], y["u"]
-            gsn, gwe = route(y["strips_sn"], y["strips_we"])
-            h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
-            gsn, gwe = route(sn1, we1)
-            h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
-            gsn, gwe = route(sn2, we2)
-            h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
+            with named_scope("rk_stage1"):
+                gsn, gwe = route(y["strips_sn"], y["strips_we"])
+                h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
+            with named_scope("rk_stage2"):
+                gsn, gwe = route(sn1, we1)
+                h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
+            with named_scope("rk_stage3"):
+                gsn, gwe = route(sn2, we2)
+                h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
             return {"h": h3, "u": u3, "strips_sn": sn3, "strips_we": we3}
 
         return step
@@ -1977,14 +1980,17 @@ def make_fused_ssprk3_cov_compact(
     def step(y, t):
         del t
         h0, u0 = fold(y["h"]), fold(y["u"], 1)
-        gsn, gwe = route(y["strips_sn"], y["strips_we"])
-        h1, u1, sn1, we1 = stage1(h0, u0, fold(gsn), fold(gwe), b_ext)
-        gsn, gwe = route(unfold(sn1), unfold(we1))
-        h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, fold(gsn), fold(gwe),
-                                  b_ext)
-        gsn, gwe = route(unfold(sn2), unfold(we2))
-        h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, fold(gsn), fold(gwe),
-                                  b_ext)
+        with named_scope("rk_stage1"):
+            gsn, gwe = route(y["strips_sn"], y["strips_we"])
+            h1, u1, sn1, we1 = stage1(h0, u0, fold(gsn), fold(gwe), b_ext)
+        with named_scope("rk_stage2"):
+            gsn, gwe = route(unfold(sn1), unfold(we1))
+            h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, fold(gsn),
+                                      fold(gwe), b_ext)
+        with named_scope("rk_stage3"):
+            gsn, gwe = route(unfold(sn2), unfold(we2))
+            h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, fold(gsn),
+                                      fold(gwe), b_ext)
         return {"h": unfold(h3), "u": unfold(u3, 1),
                 "strips_sn": unfold(sn3), "strips_we": unfold(we3)}
 
@@ -2447,15 +2453,19 @@ def make_fused_ssprk3_cov_split_nu4(
     def step(y, t):
         del t
         h0, u0 = y["h"], y["u"]
-        gsn, gwe = route(y["strips_sn"], y["strips_we"])
-        h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
-        gsn, gwe = route(sn1, we1)
-        h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
-        gsn, gwe = route(sn2, we2)
-        h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
+        with named_scope("rk_stage1"):
+            gsn, gwe = route(y["strips_sn"], y["strips_we"])
+            h1, u1, sn1, we1 = stage1(h0, u0, gsn, gwe, b_ext)
+        with named_scope("rk_stage2"):
+            gsn, gwe = route(sn1, we1)
+            h2, u2, sn2, we2 = stage2(h0, u0, h1, u1, gsn, gwe, b_ext)
+        with named_scope("rk_stage3"):
+            gsn, gwe = route(sn2, we2)
+            h3, u3, sn3, we3 = stage3(h0, u0, h2, u2, gsn, gwe, b_ext)
         if interval == 1:
-            gsn, gwe = route(sn3, we3)
-            hf, uf, snf, wef = filt(h3, u3, gsn, gwe)
+            with named_scope("nu4_filter"):
+                gsn, gwe = route(sn3, we3)
+                hf, uf, snf, wef = filt(h3, u3, gsn, gwe)
             return {"h": hf, "u": uf, "strips_sn": snf, "strips_we": wef}
 
         if "filter_k" not in y:

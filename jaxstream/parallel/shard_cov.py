@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..utils.jax_compat import shard_map
+from ..utils.jax_compat import named_scope, shard_map
 
 from ..geometry.connectivity import (
     EDGE_E,
@@ -251,33 +251,36 @@ def make_cov_shard_exchange_phases(program: CovShardProgram):
     def start(h_blk, u_blk, t):
         # Canonical strips for every edge, read once: the stages write
         # only the ghost ring, so the interior strips are loop-invariant.
-        hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
-                        for e in range(4)])                  # (4, halo, n)
-        us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
-                        for e in range(4)], axis=1)          # (2, 4, halo, n)
-        recvs = []
-        for s, perm in enumerate(program.perms):
-            rows = tuple(t[name][0, s] for name in CUBE_ROW_NAMES)
-            e_s, rev = rows[0], rows[1]
-            h_send = jnp.take(hs, e_s, axis=0)
-            u_send = jnp.take(us, e_s, axis=1)
-            payload = jnp.concatenate([h_send[None], u_send])  # (3, halo, n)
-            payload = _maybe_flip(payload, rev)
-            recvs.append((lax.ppermute(payload, axis, perm), u_send, rows))
-        return recvs
+        with named_scope("exchange_start"):
+            hs = jnp.stack([read_strip(h_blk, 0, e, halo, n)
+                            for e in range(4)])              # (4, halo, n)
+            us = jnp.stack([read_strip(u_blk, 0, e, halo, n)
+                            for e in range(4)], axis=1)      # (2, 4, halo, n)
+            recvs = []
+            for s, perm in enumerate(program.perms):
+                rows = tuple(t[name][0, s] for name in CUBE_ROW_NAMES)
+                e_s, rev = rows[0], rows[1]
+                h_send = jnp.take(hs, e_s, axis=0)
+                u_send = jnp.take(us, e_s, axis=1)
+                payload = jnp.concatenate([h_send[None], u_send])
+                payload = _maybe_flip(payload, rev)        # (3, halo, n)
+                recvs.append(
+                    (lax.ppermute(payload, axis, perm), u_send, rows))
+            return recvs
 
     def finish(h_blk, u_blk, recvs):
-        sym = jnp.zeros((4, n), jnp.float32)
-        for recv, u_send, rows in recvs:
-            e_s = rows[0]
-            h_blk, u_blk, mine = apply_cov_cube_recv(
-                h_blk, u_blk, u_send, recv, rows, e_s)
-            sym = jnp.where(
-                (jnp.arange(4) == e_s)[:, None], mine[None], sym)
+        with named_scope("exchange_finish"):
+            sym = jnp.zeros((4, n), jnp.float32)
+            for recv, u_send, rows in recvs:
+                e_s = rows[0]
+                h_blk, u_blk, mine = apply_cov_cube_recv(
+                    h_blk, u_blk, u_send, recv, rows, e_s)
+                sym = jnp.where(
+                    (jnp.arange(4) == e_s)[:, None], mine[None], sym)
 
-        sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]     # (1, 2, n)
-        sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
-        return h_blk, u_blk, sym_sn, sym_we
+            sym_sn = jnp.stack([sym[EDGE_S], sym[EDGE_N]])[None]  # (1, 2, n)
+            sym_we = jnp.stack([sym[EDGE_W], sym[EDGE_E]], axis=-1)[None]
+            return h_blk, u_blk, sym_sn, sym_we
 
     return start, finish
 
@@ -638,15 +641,18 @@ def _make_cov_face_rhs(model, grid, program: CovShardProgram, overlap,
             # none of them, so the async collectives overlap it; the
             # band pass then consumes the received strips.
             recvs = ex_start(h_e, u_e, tabs)
-            dh_c, du_c = rhs_interior(
-                fz, xr_i, xfr_i, yc_i, yfc_i, h_int, u_int,
-                b_loc[:, halo:halo + n, halo:halo + n])
+            with named_scope("rhs_interior"):
+                dh_c, du_c = rhs_interior(
+                    fz, xr_i, xfr_i, yc_i, yfc_i, h_int, u_int,
+                    b_loc[:, halo:halo + n, halo:halo + n])
             h_e, u_e, ssn, swe = ex_finish(h_e, u_e, recvs)
-            dh, du = rhs_band(fz, xr_f, xfr_f, yc_f, yfc_f,
-                              h_e, u_e, b_loc, ssn, swe, dh_c, du_c)
+            with named_scope("rhs_band"):
+                dh, du = rhs_band(fz, xr_f, xfr_f, yc_f, yfc_f,
+                                  h_e, u_e, b_loc, ssn, swe, dh_c, du_c)
         else:
             h_e, u_e, ssn, swe = exchange(h_e, u_e, tabs)
-            dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
+            with named_scope("rhs_face"):
+                dh, du = rhs_local(fz, h_e, u_e, b_loc, ssn, swe)
         if nu4 != 0.0:
             # del^4 = lap(lap(.)) with an exchanged refill between,
             # exactly the fused nu4 stepper's structure: the same

@@ -40,15 +40,35 @@ from .io.history import HistoryWriter, save_geometry
 from .models.advection import TracerAdvection
 from .models.diffusion import ThermalDiffusion
 from .models.shallow_water import ShallowWater
+from .obs import metrics as obs_metrics
+from .obs.monitor import HealthMonitor
+from .obs.sink import TelemetrySink, run_manifest
 from .parallel.mesh import (setup_ensemble_sharding, setup_sharding,
                             shard_ensemble_state, shard_state)
 from .parallel.sharded_model import make_stepper_for
 from .physics import initial_conditions as ics
-from .stepping import integrate, jit_integrate
+from .stepping import integrate, integrate_with_metrics, jit_integrate
 from .utils import diagnostics as diag
 from .utils.logging import get_logger
 
 __all__ = ["Simulation", "run_from_config"]
+
+#: The prognostic keys of every dense state family — what the in-loop
+#: metric functions see (fused-stepper strip carries are dropped first).
+_PROG_KEYS = ("h", "u", "v", "q", "T")
+
+
+class _ObsRuntime:
+    """Per-Simulation telemetry wiring (built by ``_build_obs``)."""
+
+    def __init__(self, cfg, metric_set, metric_fn, monitor, sink, ref):
+        self.cfg = cfg                  # the ObservabilityConfig block
+        self.ms = metric_set
+        self.metric_fn = metric_fn      # fn(loop_carry, t) -> (k,) vector
+        self.monitor = monitor
+        self.sink = sink
+        self.ref = ref                  # step-0 metric values (np, (k,))
+        self.wrote_initial = False
 
 log = get_logger(__name__)
 
@@ -227,8 +247,108 @@ class Simulation:
         if io.checkpoint_stride > 0:
             self.checkpoints = CheckpointManager(io.checkpoint_path)
             self._maybe_resume()
+        # Telemetry last: the metric reference must see the post-resume
+        # state, and the guard's postmortem callback needs the
+        # checkpoint manager.
+        self._obs = self._build_obs()
 
     # ------------------------------------------------------------------ build
+    def _build_obs(self):
+        """Wire the ``observability:`` block into this run (or None).
+
+        Builds the resolved :class:`jaxstream.obs.metrics.MetricSet`,
+        the loop-carry metric function the instrumented segments trace,
+        the :class:`HealthMonitor` (policy != 'off') and the JSONL sink
+        (process 0 only), and records the step-0 reference values the
+        drift columns are measured against (on a resumed run that
+        reference is the resume point).
+        """
+        o = self.config.observability
+        if o.interval <= 0:
+            return None
+        if self._tt_keys is not None:
+            raise ValueError(
+                "observability.interval > 0 requires model.numerics: "
+                "dense (the factored TT state has no in-loop metric "
+                "path; eager Simulation.diagnostics() still works)")
+        tb = self.config.parallelization.temporal_block
+        if o.interval % tb:
+            raise ValueError(
+                f"observability.interval={o.interval} must be a multiple "
+                f"of parallelization.temporal_block={tb} (samples are "
+                "taken at stepper-call boundaries)")
+        # Segments are gcd(history_stride, checkpoint_stride) steps long
+        # (Simulation.run); an interval longer than that would truncate
+        # every segment's sample count to ZERO and silently disable the
+        # metrics AND the guards the user just configured — reject the
+        # misconfiguration instead.
+        io = self.config.io
+        strides = [s for s in (io.history_stride, io.checkpoint_stride)
+                   if s > 0]
+        seg = math.gcd(*strides) if strides else 0
+        if seg and o.interval > seg:
+            raise ValueError(
+                f"observability.interval={o.interval} exceeds the "
+                f"compiled segment length {seg} (= gcd of "
+                f"io.history_stride/io.checkpoint_stride): every segment "
+                "would take zero samples and the guards could never "
+                "fire; lower the interval or raise the io strides")
+        p, tc = self.config.physics, self.config.time
+        ex = {k: v for k, v in self.state.items() if k in _PROG_KEYS}
+        ms = obs_metrics.build_metric_set(
+            self.grid, self.model, ex, o.metrics, tc.dt, p.gravity)
+        if self._fused_step is not None:
+            m = self.model
+            loop_prep = m.restrict_state
+        else:
+            def loop_prep(y):
+                return {k: v for k, v in y.items() if k in _PROG_KEYS}
+
+        def metric_fn(y, t):
+            del t
+            return ms.values(loop_prep(y))
+
+        monitor = None
+        if o.guards != "off":
+            monitor = HealthMonitor(ms.names, o.guards, o.cfl_limit,
+                                    on_breach=self._postmortem_checkpoint)
+        sink = None
+        if o.sink and jax.process_index() == 0:
+            cfg = self.config
+            manifest = run_manifest(
+                ms.names, o.interval, o.guards,
+                config={
+                    "grid_n": cfg.grid.n, "dtype": cfg.grid.dtype,
+                    "dt": tc.dt, "scheme": tc.scheme,
+                    "initial_condition": cfg.model.initial_condition,
+                    "numerics": cfg.model.numerics,
+                    "members": self.members,
+                    "num_devices": cfg.parallelization.num_devices,
+                    "use_shard_map": cfg.parallelization.use_shard_map,
+                    "temporal_block": tb,
+                })
+            sink = TelemetrySink(o.sink, manifest)
+        # Step-0 reference for the drift columns: one eager evaluation
+        # of the metric vector on the initial (or resumed) state.
+        ref = np.asarray(jax.device_get(jax.jit(ms.values)(ex)))
+        log.info("observability: %d metrics every %d steps (guards=%s%s)",
+                 ms.k, o.interval, o.guards,
+                 f", sink={o.sink}" if o.sink else "")
+        return _ObsRuntime(o, ms, metric_fn, monitor, sink, ref)
+
+    def _postmortem_checkpoint(self):
+        """'checkpoint_and_raise' breach callback: save the CURRENT
+        (possibly corrupt) state for inspection — the HealthError's
+        last-good step is the restart target, this save is evidence."""
+        if self.checkpoints is None:
+            log.warning(
+                "guard policy 'checkpoint_and_raise' with no checkpoint "
+                "manager (io.checkpoint_stride is 0) — raising without "
+                "a postmortem save")
+            return
+        self.checkpoints.save(self.step_count, self.state, self.t)
+        log.warning("guard breach: postmortem checkpoint saved at step %d",
+                    self.step_count)
     def _build_model_and_state(self):
         cfg = self.config
         m, p, g = cfg.model, cfg.physics, self.grid
@@ -612,61 +732,180 @@ class Simulation:
         self.step_count = step
         log.info("resumed from checkpoint step %d (t=%.0f s)", step, self.t)
 
+    def _build_segment_fn(self, k: int):
+        """Compile the ``k``-step segment callable (cached per ``k``).
+
+        Without observability this is the historical pair of paths
+        (fused-carry / classic ``jit_integrate``), signature
+        ``fn(y, t) -> (y, t)``.  With ``observability.interval > 0``
+        and at least one sample landing inside the segment, the loop is
+        :func:`jaxstream.stepping.integrate_with_metrics` instead —
+        same state ops in the same order — with signature
+        ``fn(y, t, step0) -> (y, t, buf)`` and an ``obs_samples``
+        attribute carrying the buffer's column count.
+
+        Known trade: the metric buffer's ``(k_metrics, samples)`` shape
+        is static, so instrumented segments compile once per DISTINCT
+        segment length instead of the classic tier's single
+        traced-nsteps executable.  A run has at most two distinct
+        lengths (the stride gcd and the final remainder), so this is
+        one extra compile per run at worst.
+        """
+        dt = self.config.time.dt
+        active = (self._fused_step if self._fused_step is not None
+                  else self._step)
+        # Temporal blocking: a blocked stepper advances
+        # steps_per_call steps per call, so the integrator runs
+        # k/spc calls of span spc*dt each (t advances identically
+        # — the block's sub-step times are sequential dt adds).
+        spc = getattr(active, "steps_per_call", 1)
+        if k % spc:
+            raise ValueError(
+                f"segment of {k} steps is not a multiple of "
+                f"parallelization.temporal_block={spc}; make "
+                "io.history_stride/io.checkpoint_stride and the "
+                "total step count multiples of temporal_block")
+        # Both paths DONATE the state carry (round-7 satellite,
+        # parallelization.donate_state to opt out): segments are
+        # ping-pong by construction (self.state is always replaced
+        # by the result), so XLA aliases the input and output state
+        # instead of double-buffering every prognostic array for
+        # the whole loop.  Accelerator callers holding their own
+        # reference to sim.state across run() calls must copy it
+        # (np.asarray) first — donation consumes the buffers.
+        donate = self.config.parallelization.donate_state
+        obs = self._obs
+        samples = 0
+        if obs is not None:
+            every = obs.cfg.interval // spc
+            samples = (k // spc) // every
+        if samples > 0:
+            mfn, fault = obs.metric_fn, obs.cfg.fault_step
+            if self._fused_step is not None:
+                m, fused, prep = self.model, self._fused_step, \
+                    self._fused_prep
+
+                def fn(y, t, step0, _n=k // spc, _dt=dt * spc,
+                       _e=every, _s=samples):
+                    y_c = prep(y)
+                    y_c, t, buf = integrate_with_metrics(
+                        fused, y_c, t, _n, _dt, mfn, _e, _s, step0,
+                        steps_per_call=spc, fault_step=fault)
+                    return m.restrict_state(y_c), t, buf
+            else:
+                step = self._step
+
+                def fn(y, t, step0, _n=k // spc, _dt=dt * spc,
+                       _e=every, _s=samples):
+                    return integrate_with_metrics(
+                        step, y, t, _n, _dt, mfn, _e, _s, step0,
+                        steps_per_call=spc, fault_step=fault)
+            jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+            def call(y, t, step0, _f=jfn):
+                return _f(y, t, step0)
+
+            call.obs_samples = samples
+            return call
+        if self._fused_step is not None:
+            m, fused = self.model, self._fused_step
+
+            prep = self._fused_prep
+
+            def fn(y, t, _k=k // spc, _dt=dt * spc):
+                y_c = prep(y)
+                y_c, t = integrate(fused, y_c, t, _k, _dt)
+                return m.restrict_state(y_c), t
+
+            return jax.jit(fn, donate_argnums=(0,) if donate else ())
+        # unroll=1: the generic tiers' steps are ms-scale (TT
+        # roundings, classic jnp), where the while-carry's
+        # ~us-scale copies are invisible but a 4x-traced step
+        # graph would multiply compile time.  One jit_integrate
+        # executable serves every segment length (nsteps rides
+        # as a traced operand).
+        if self._classic_run is None:
+            self._classic_run = jit_integrate(
+                self._step, dt * spc, unroll=1, donate=donate)
+        run = self._classic_run
+
+        def fn(y, t, _k=k // spc):
+            return run(y, t, _k)
+
+        return fn
+
     def _run_segment(self, k: int):
         fn = self._segment_cache.get(k)
         if fn is None:
-            dt = self.config.time.dt
-            active = (self._fused_step if self._fused_step is not None
-                      else self._step)
-            # Temporal blocking: a blocked stepper advances
-            # steps_per_call steps per call, so the integrator runs
-            # k/spc calls of span spc*dt each (t advances identically
-            # — the block's sub-step times are sequential dt adds).
-            spc = getattr(active, "steps_per_call", 1)
-            if k % spc:
-                raise ValueError(
-                    f"segment of {k} steps is not a multiple of "
-                    f"parallelization.temporal_block={spc}; make "
-                    "io.history_stride/io.checkpoint_stride and the "
-                    "total step count multiples of temporal_block")
-            # Both paths DONATE the state carry (round-7 satellite,
-            # parallelization.donate_state to opt out): segments are
-            # ping-pong by construction (self.state is always replaced
-            # by the result), so XLA aliases the input and output state
-            # instead of double-buffering every prognostic array for
-            # the whole loop.  Accelerator callers holding their own
-            # reference to sim.state across run() calls must copy it
-            # (np.asarray) first — donation consumes the buffers.
-            donate = self.config.parallelization.donate_state
-            if self._fused_step is not None:
-                m, fused = self.model, self._fused_step
-
-                prep = self._fused_prep
-
-                def fn(y, t, _k=k // spc, _dt=dt * spc):
-                    y_c = prep(y)
-                    y_c, t = integrate(fused, y_c, t, _k, _dt)
-                    return m.restrict_state(y_c), t
-
-                fn = jax.jit(fn, donate_argnums=(0,) if donate else ())
-            else:
-                # unroll=1: the generic tiers' steps are ms-scale (TT
-                # roundings, classic jnp), where the while-carry's
-                # ~us-scale copies are invisible but a 4x-traced step
-                # graph would multiply compile time.  One jit_integrate
-                # executable serves every segment length (nsteps rides
-                # as a traced operand).
-                if self._classic_run is None:
-                    self._classic_run = jit_integrate(
-                        self._step, dt * spc, unroll=1, donate=donate)
-                run = self._classic_run
-
-                def fn(y, t, _k=k // spc):
-                    return run(y, t, _k)
+            fn = self._build_segment_fn(k)
             self._segment_cache[k] = fn
+        if getattr(fn, "obs_samples", 0) > 0:
+            # Instrumented segment: the metric buffer rides the compiled
+            # loop and is fetched with ONE device->host transfer here —
+            # which also blocks on the segment, so `wall` is the true
+            # segment wall time.
+            step0, t0 = self.step_count, self.t
+            wall0 = time.perf_counter()
+            self.state, t, buf = fn(self.state, self.t,
+                                    jnp.asarray(step0))
+            host = obs_metrics.fetch_buffer(buf)
+            wall = time.perf_counter() - wall0
+            self.t = float(t)
+            self.step_count += k
+            self._ingest_telemetry(host, step0, t0, k, wall)
+            return
         self.state, t = fn(self.state, self.t)
         self.t = float(t)
         self.step_count += k
+
+    def _ingest_telemetry(self, host, step0: int, t0: float, k: int,
+                          wall: float):
+        """One fetched segment buffer -> sink record + guard check.
+
+        ``host``: the ``(k_metrics, samples)`` numpy buffer; sample j
+        is global step ``step0 + (j+1)*interval``.  Writes the segment
+        record first so a guard raise leaves the evidence on disk, then
+        runs the monitor (guard events are flushed even when the policy
+        raises).
+        """
+        obs = self._obs
+        interval = obs.cfg.interval
+        names = obs.ms.names
+        samples = host.shape[1]
+        steps = step0 + interval * np.arange(1, samples + 1)
+        dt = self.config.time.dt
+        ts = t0 + interval * dt * np.arange(1, samples + 1)
+        drift = {}
+        for i, n in enumerate(names):
+            if n in obs_metrics.CONSERVED:
+                v0 = float(obs.ref[i])
+                d = float(host[i, -1]) - v0
+                drift[n] = d / abs(v0) if v0 else d
+        if obs.sink is not None:
+            rate = k / wall if wall > 0 else float("inf")
+            chips = (self.config.parallelization.num_devices
+                     if self.setup is not None else 1)
+            obs.sink.write({
+                "kind": "segment",
+                "step": self.step_count, "t": self.t, "steps": k,
+                "wall_s": wall, "steps_per_sec": rate,
+                "sim_days_per_sec_per_chip":
+                    rate * dt / 86400.0 / chips,
+                "metrics": {n: float(host[i, -1])
+                            for i, n in enumerate(names)},
+                "drift": drift,
+                "samples": {"step": steps.tolist(),
+                            **{n: host[i].tolist()
+                               for i, n in enumerate(names)}},
+            })
+        if obs.monitor is not None:
+            n0 = len(obs.monitor.events)
+            try:
+                obs.monitor.check(steps, ts, host)
+            finally:
+                if obs.sink is not None:
+                    for ev in obs.monitor.events[n0:]:
+                        obs.sink.write(ev)
 
     def _emit(self):
         if self.history is not None:
@@ -676,24 +915,46 @@ class Simulation:
         for k, v in self.diagnostics().items():
             log.info("step %-8d t=%10.0fs  %s=%.10g", self.step_count, self.t, k, v)
 
+    @staticmethod
+    def _fetch_scalars(out) -> Dict[str, float]:
+        """One host transfer for a whole dict of device scalars.
+
+        The invariants are stacked on device (exact widening to the
+        common dtype — an f32 value converts to the identical f64, so
+        the returned floats are bitwise what per-metric ``float(x)``
+        calls produced) and fetched with a SINGLE ``jax.device_get``:
+        one blocking round trip per :meth:`diagnostics` call instead of
+        one per metric.
+        """
+        if not out:
+            return {}
+        vals = [jnp.asarray(v) for v in out.values()]
+        common = jnp.result_type(*[v.dtype for v in vals])
+        host = np.asarray(
+            jax.device_get(jnp.stack([v.astype(common) for v in vals])))
+        return {k: float(host[i]) for i, k in enumerate(out)}
+
     def diagnostics(self) -> Dict[str, float]:
-        """Scalar invariants for the current state (model-appropriate)."""
+        """Scalar invariants for the current state (model-appropriate).
+
+        All invariants are computed on device and fetched with one
+        batched transfer (:meth:`_fetch_scalars`)."""
         g, s = self.grid, self.state
-        out: Dict[str, float] = {}
+        out: Dict[str, Any] = {}
         if self._tt_keys is not None:
             from .tt.diagnostics import tt_total_mass
 
             pair = lambda k: (s[k + "__ttA"], s[k + "__ttB"])
             if self._tt_keys == ("q",):
-                out["tracer_mass"] = float(tt_total_mass(g, pair("q")))
-                out["tracer_max"] = float(jnp.max(self._tt_dense("q")))
+                out["tracer_mass"] = tt_total_mass(g, pair("q"))
+                out["tracer_max"] = jnp.max(self._tt_dense("q"))
             elif self._tt_keys == ("T",):
-                out["heat"] = float(tt_total_mass(g, pair("T")))
+                out["heat"] = tt_total_mass(g, pair("T"))
             else:
                 h = self._tt_dense("h")
                 ua = self._tt_dense("ua")
                 ub = self._tt_dense("ub")
-                out["mass"] = float(diag.total_mass(g, h))
+                out["mass"] = diag.total_mass(g, h)
                 sl = slice(g.halo, g.halo + g.n)
                 aa = jnp.asarray(g.a_a)[:, :, sl, sl]
                 ab = jnp.asarray(g.a_b)[:, :, sl, sl]
@@ -701,9 +962,9 @@ class Simulation:
                 b_int = (g.interior(jnp.asarray(self._tt_hs))
                          if self._tt_hs is not None else 0.0)
                 p = self.config.physics
-                out["energy"] = float(
-                    diag.total_energy(g, h, v, p.gravity, b_int))
-            return out
+                out["energy"] = diag.total_energy(g, h, v, p.gravity,
+                                                  b_int)
+            return self._fetch_scalars(out)
         if "h" in s and self.members > 1:
             # Member-0 invariants plus the ensemble's height spread (the
             # quantity a perturbed-IC run exists to grow): per-cell
@@ -711,31 +972,30 @@ class Simulation:
             p = self.config.physics
             vkey = "u" if "u" in s else "v"
             s0 = {"h": s["h"][0], vkey: s[vkey][:, 0]}
-            out["mass_m0"] = float(diag.total_mass(g, s0["h"]))
+            out["mass_m0"] = diag.total_mass(g, s0["h"])
             b = self.model.b_ext
             b_int = g.interior(b) if b is not None else 0.0
             v = s0["v"] if "v" in s0 else self.model.to_cartesian(s0)
-            out["energy_m0"] = float(
-                diag.total_energy(g, s0["h"], v, p.gravity, b_int))
-            out["h_spread_max"] = float(jnp.max(jnp.std(
-                s["h"].astype(jnp.float32), axis=0)))
-            return out
+            out["energy_m0"] = diag.total_energy(g, s0["h"], v,
+                                                 p.gravity, b_int)
+            out["h_spread_max"] = jnp.max(jnp.std(
+                s["h"].astype(jnp.float32), axis=0))
+            return self._fetch_scalars(out)
         if "h" in s:
             p = self.config.physics
-            out["mass"] = float(diag.total_mass(g, s["h"]))
+            out["mass"] = diag.total_mass(g, s["h"])
             b = self.model.b_ext
             b_int = g.interior(b) if b is not None else 0.0
             # Covariant models carry "u"; energy wants the Cartesian vector.
             v = s["v"] if "v" in s else self.model.to_cartesian(s)
-            out["energy"] = float(
-                diag.total_energy(g, s["h"], v, p.gravity, b_int)
-            )
+            out["energy"] = diag.total_energy(g, s["h"], v, p.gravity,
+                                              b_int)
         elif "q" in s:
-            out["tracer_mass"] = float(diag.total_mass(g, s["q"]))
-            out["tracer_max"] = float(jnp.max(s["q"]))
+            out["tracer_mass"] = diag.total_mass(g, s["q"])
+            out["tracer_max"] = jnp.max(s["q"])
         elif "T" in s:
-            out["heat"] = float(diag.total_mass(g, s["T"]))
-        return out
+            out["heat"] = diag.total_mass(g, s["T"])
+        return self._fetch_scalars(out)
 
     def total_steps(self) -> int:
         tc = self.config.time
@@ -761,6 +1021,21 @@ class Simulation:
         seg = math.gcd(*strides) if strides else 0
         if self.step_count == 0 and self.history is not None:
             self._emit()  # record the initial condition
+        obs = self._obs
+        if (obs is not None and obs.sink is not None
+                and not obs.wrote_initial):
+            # Step-0 record: the drift columns' reference values, so the
+            # report CLI's drift table has its anchor in-file.
+            obs.sink.write({
+                "kind": "segment", "step": self.step_count, "t": self.t,
+                "steps": 0, "wall_s": 0.0, "steps_per_sec": 0.0,
+                "sim_days_per_sec_per_chip": 0.0,
+                "metrics": {n: float(obs.ref[i])
+                            for i, n in enumerate(obs.ms.names)},
+                "drift": {n: 0.0 for n in obs.ms.names
+                          if n in obs_metrics.CONSERVED},
+            })
+            obs.wrote_initial = True
         wall0 = time.perf_counter()
         while self.step_count < total:
             k = min(seg, total - self.step_count) if seg else total - self.step_count

@@ -22,7 +22,8 @@ from jax import tree_util as jtu
 
 __all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper",
            "blocked", "integrate", "integrate_with_history",
-           "vmap_ensemble", "jit_integrate", "jit_integrate_with_history"]
+           "integrate_with_metrics", "vmap_ensemble", "jit_integrate",
+           "jit_integrate_with_history"]
 
 
 def _axpy(y, dt, k):
@@ -174,6 +175,66 @@ def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float
     if rem:  # don't silently drop the trailing nsteps % stride steps
         y, t = jax.lax.fori_loop(0, rem, body, (y, t))
     return y, t, hist
+
+
+def integrate_with_metrics(step: Callable, y0, t0: float, ncalls: int,
+                           dt: float, metric_fn: Callable, every: int,
+                           n_samples: int, step0,
+                           steps_per_call: int = 1,
+                           fault_step: int = -1):
+    """:func:`integrate` plus an on-device metric stream (zero host syncs).
+
+    Runs ``ncalls`` stepper calls under one ``lax.fori_loop`` exactly as
+    :func:`integrate` with ``unroll=1`` does — same ops in the same
+    order, so enabling metrics must not perturb the state carry (tested
+    bitwise in tests/test_obs.py) — and additionally evaluates
+    ``metric_fn(y, t) -> (k_metrics,)`` after every ``every``-th call,
+    writing the vector into column ``j`` of a ``(k_metrics, n_samples)``
+    device buffer.  Sample ``j`` (0-based) is taken after call
+    ``(j+1) * every``, i.e. at global step
+    ``step0 + (j+1) * every * steps_per_call``; unsampled trailing calls
+    (``ncalls % every``) still integrate, their steps are simply not
+    observed.  Returns ``(y, t, buf)`` — the caller fetches ``buf``
+    with ONE ``jax.device_get`` per segment
+    (:func:`jaxstream.obs.metrics.fetch_buffer`).
+
+    ``step0`` is a *traced* operand (the global step count before this
+    segment) so one executable serves every segment.  ``fault_step >=
+    0`` is the testing hook: the sample whose global step equals it is
+    overwritten with NaN *in the stream only* — the state carry is
+    untouched — so guard plumbing can be proven without integrating a
+    real blowup (``fault_step`` must land on a sampled step to fire).
+    """
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    if n_samples < 1:
+        raise ValueError(f"n_samples must be >= 1, got {n_samples}")
+    t0a = jnp.asarray(t0, dtype=float)
+    vec_shape = jax.eval_shape(metric_fn, y0, t0a)
+    buf0 = jnp.full((vec_shape.shape[0], n_samples), jnp.nan,
+                    vec_shape.dtype)
+
+    def body(i, carry):
+        y, t, buf = carry
+        y = step(y, t)
+        t = t + dt
+
+        def write(b):
+            vec = metric_fn(y, t)
+            if fault_step >= 0:
+                g = step0 + (i + 1) * steps_per_call
+                vec = jnp.where(jnp.equal(g, fault_step),
+                                jnp.full_like(vec, jnp.nan), vec)
+            j = (i + 1) // every - 1
+            return jax.lax.dynamic_update_slice(
+                b, vec[:, None].astype(b.dtype), (0, j))
+
+        take = jnp.logical_and((i + 1) % every == 0,
+                               (i + 1) // every <= n_samples)
+        buf = jax.lax.cond(take, write, lambda b: b, buf)
+        return y, t, buf
+
+    return jax.lax.fori_loop(0, ncalls, body, (y0, t0a, buf0))
 
 
 def jit_integrate(step: Callable, dt: float, unroll: int = 4,

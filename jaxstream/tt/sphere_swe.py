@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import EARTH_GRAVITY, EARTH_OMEGA
+from ..utils.jax_compat import named_scope
 from .cross import (aca_lowrank, aca_lowrank_many, host_svd_lowrank,
                     rsvd_lowrank, svd_lowrank)
 from .swe2d import kr_raw
@@ -320,16 +321,18 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
         # O(n r r_c) strip reconstructions, no rounding in between — so
         # a sharded strip_ghosts_many can put every ppermute on the
         # wire before any of the step's heavy face-local work starts.
-        vcs = [stack_pairs([kr(S["aax"][c], uap), kr(S["abx"][c], ubp)])
-               for c in range(3)]
-        ghosts = strip_ghosts_many([hp] + vcs)
-        hl = resampled_ghost_lines(ghosts[0], ridx, rwgt)
-        vl = {X: [] for X in _EDGES}
-        for c in range(3):
-            lc = resampled_ghost_lines(ghosts[1 + c], ridx, rwgt)
-            for X in _EDGES:
-                vl[X].append(lc[X])
-        G = _ghost_composites(hl, vl, ES_l, gravity)
+        with named_scope("tt_ghosts"):
+            vcs = [stack_pairs([kr(S["aax"][c], uap),
+                                kr(S["abx"][c], ubp)])
+                   for c in range(3)]
+            ghosts = strip_ghosts_many([hp] + vcs)
+            hl = resampled_ghost_lines(ghosts[0], ridx, rwgt)
+            vl = {X: [] for X in _EDGES}
+            for c in range(3):
+                lc = resampled_ghost_lines(ghosts[1 + c], ridx, rwgt)
+                for X in _EDGES:
+                    vl[X].append(lc[X])
+            G = _ghost_composites(hl, vl, ES_l, gravity)
 
         # --- interior factored intermediates, rounded in TWO batched
         # sweeps (sequential ACA latency is the TPU wall; the operands
@@ -340,12 +343,13 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
         curl_ops = (da_pairs(ubp, G["W"]["ub"], G["E"]["ub"])
                     + [(-a, b) for a, b in
                        db_pairs(uap, G["S"]["ua"], G["N"]["ua"])])
-        uua, uub, sgh, curl = rnd_many([
-            stk([kr(S["gaa"], uap), kr(S["gab"], ubp)]),
-            stk([kr(S["gab"], uap), kr(S["gbb"], ubp)]),
-            stk([kr(S["sg"], hp)]),
-            stk(curl_ops),
-        ])
+        with named_scope("tt_sweep1"):
+            uua, uub, sgh, curl = rnd_many([
+                stk([kr(S["gaa"], uap), kr(S["gab"], ubp)]),
+                stk([kr(S["gab"], uap), kr(S["gbb"], ubp)]),
+                stk([kr(S["sg"], hp)]),
+                stk(curl_ops),
+            ])
 
         # Sweep 2: everything needing sweep 1 — flux divergence, K+Phi,
         # absolute vorticity, sqrtg u^i.
@@ -354,14 +358,15 @@ def make_tt_sphere_swe(grid, dt: float, rank: int,
         kp_pairs.append((gravity * hp[0], hp[1]))
         if hs_tt is not None:
             kp_pairs.append((gravity * hs_tt[0], hs_tt[1]))
-        div, KP, zeta, mau, mbu = rnd_many([
-            stk(da_pairs(kr(sgh, uua), G["W"]["Fa"], G["E"]["Fa"])
-                + db_pairs(kr(sgh, uub), G["S"]["Fb"], G["N"]["Fb"])),
-            stk(kp_pairs),
-            stk([kr(S["isg"], curl), S["f"]]),
-            stk([kr(S["sg"], uua)]),
-            stk([kr(S["sg"], uub)]),
-        ])
+        with named_scope("tt_sweep2"):
+            div, KP, zeta, mau, mbu = rnd_many([
+                stk(da_pairs(kr(sgh, uua), G["W"]["Fa"], G["E"]["Fa"])
+                    + db_pairs(kr(sgh, uub), G["S"]["Fb"], G["N"]["Fb"])),
+                stk(kp_pairs),
+                stk([kr(S["isg"], curl), S["f"]]),
+                stk([kr(S["sg"], uua)]),
+                stk([kr(S["sg"], uub)]),
+            ])
 
         dh = kr(S["isg"], div)
         dh = ((-scale * dt) * dh[0], dh[1])

@@ -12,9 +12,12 @@ handled (and documented) at the call site.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 
-__all__ = ["LEGACY_SHARD_MAP", "shard_map", "tpu_compiler_params"]
+__all__ = ["LEGACY_SHARD_MAP", "named_scope", "shard_map",
+           "tpu_compiler_params"]
 
 #: True on the 0.4.x line.  Besides the spelling differences shimmed
 #: below, that line's XLA trips an hlo-verifier bug ("tile_assignment
@@ -40,6 +43,23 @@ else:  # jax < 0.5: experimental namespace, check_vma spelled check_rep
         return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=check_vma,
                               **kw)
+
+
+def named_scope(name: str):
+    """``jax.named_scope`` under any supported version; inert otherwise.
+
+    A trace-time name-stack annotation: every op traced inside the
+    context carries ``name`` as a prefix, so xprof/TensorBoard traces
+    show the fused steppers as named regions (exchange start/finish,
+    interior vs band RHS, RK stages, TT sweeps) instead of anonymous
+    custom-call soup.  Zero runtime cost — the name lives in HLO
+    metadata only — and a no-op context if the API is ever absent, so
+    annotated code never gains a hard version dependency.
+    """
+    ns = getattr(jax, "named_scope", None)
+    if ns is None:  # pragma: no cover - every supported jax has it
+        return contextlib.nullcontext()
+    return ns(name)
 
 
 def tpu_compiler_params(**kwargs):

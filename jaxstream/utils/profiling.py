@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import math
 import statistics
 import time
 from typing import Callable, Dict, Optional, Sequence
@@ -301,6 +302,15 @@ class StepTimer:
     def kept(self) -> Sequence[float]:
         return self.samples[self.discard:]
 
+    @staticmethod
+    def _percentile(sorted_samples: Sequence[float], q: float) -> float:
+        """Nearest-rank (ceil) percentile: the smallest sample >= the
+        q-quantile.  The previous p90 used ``int(n * 0.9) - 1``, which
+        under-indexes for small n (n=2 returned the MINIMUM as p90;
+        n=10 was only right by accident of truncation) — the ceil
+        convention is exact for all n >= 1."""
+        return sorted_samples[max(0, math.ceil(q * len(sorted_samples)) - 1)]
+
     def stats(self) -> Dict[str, float]:
         k = sorted(self.kept)
         if not k:
@@ -309,8 +319,9 @@ class StepTimer:
             "n": len(k),
             "mean_s": statistics.fmean(k),
             "min_s": k[0],
-            "p50_s": k[len(k) // 2],
-            "p90_s": k[int(len(k) * 0.9) - 1 if len(k) > 1 else 0],
+            "p50_s": self._percentile(k, 0.50),
+            "p90_s": self._percentile(k, 0.90),
+            "p99_s": self._percentile(k, 0.99),
         }
 
     def sim_days_per_sec(self, dt: float, steps_per_call: int = 1) -> float:

@@ -18,6 +18,15 @@ every gate run self-checking):
    ``pytest.mark.slow``.  These are the suite's most expensive items
    (~40-90 s each); the fast tier's time budget assumes they stay out.
 
+3. **Telemetry tests stay tier-1** (round-8 observability satellite):
+   a test module importing ``jaxstream.obs`` must carry NO ``slow``
+   markers.  The observability acceptance criteria (buffer parity,
+   guard firing, bitwise-unchanged carry) are what the fast gate
+   certifies on every run — a slow-marked telemetry parity would
+   silently drop that coverage from tier-1.  Put genuinely slow
+   obs-adjacent tests in a module that exercises the feature through
+   ``Simulation`` without importing ``jaxstream.obs`` directly.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -37,6 +46,9 @@ BUILTIN_MARKERS = {
 _MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_][A-Za-z0-9_]*)")
 _WORKER_RE = re.compile(
     r"(_worker\.py|worker\.py\b|xla_force_host_platform_device_count)")
+_OBS_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.obs\b|import\s+jaxstream\.obs\b"
+    r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*obs\b)", re.MULTILINE)
 
 
 def registered_markers(pytest_ini: str) -> set:
@@ -69,6 +81,12 @@ def lint_file(path: str, allowed: set):
         yield (f"{rel}: launches a multi-device subprocess worker but "
                f"carries no pytest.mark.slow — subprocess device tests "
                f"must stay out of the fast tier")
+    if _OBS_IMPORT_RE.search(src) and "slow" in used:
+        yield (f"{rel}: imports jaxstream.obs but marks tests slow — "
+               f"telemetry coverage must stay tier-1-clean (the fast "
+               f"gate certifies the observability acceptance criteria "
+               f"on every run); move the slow test to a module that "
+               f"does not import jaxstream.obs")
 
 
 def main(repo_root: str = None) -> int:

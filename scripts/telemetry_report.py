@@ -1,0 +1,142 @@
+"""Summarize a jaxstream telemetry JSONL file (jaxstream.obs.sink).
+
+Usage::
+
+    python scripts/telemetry_report.py run.jsonl [--json]
+
+Prints, from the run's manifest + segment/guard/bench records:
+
+  * the run identity line (config echo, devices, metric ladder);
+  * a drift table — per conserved invariant: step-0 value, final
+    value, final relative drift, and the max |drift| seen across all
+    segment records (a conservation leak that self-cancels by the end
+    still shows here);
+  * a rate timeline — per segment: step range, wall seconds, steps/s,
+    sim-days/sec/chip;
+  * guard events (NaN / CFL breaches with their last-good step);
+  * bench records, if the file came from ``bench.py --telemetry``.
+
+``--json`` emits one machine-readable JSON object instead (the same
+aggregates), for dashboards or the driver.  stdlib only — this tool
+must run on a machine with no JAX installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path):
+    records = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i + 1}: not JSON ({e})")
+    if not records:
+        raise SystemExit(f"{path}: empty telemetry file")
+    return records
+
+
+def summarize(records):
+    manifest = next((r for r in records if r.get("kind") == "manifest"), {})
+    segments = [r for r in records if r.get("kind") == "segment"]
+    guards = [r for r in records if r.get("kind") == "guard"]
+    benches = [r for r in records if r.get("kind") == "bench"]
+
+    drift = {}
+    if segments:
+        first, last = segments[0], segments[-1]
+        for name in last.get("drift", {}):
+            vals = [s["drift"][name] for s in segments
+                    if name in s.get("drift", {})]
+            drift[name] = {
+                "initial_value": first.get("metrics", {}).get(name),
+                "final_value": last.get("metrics", {}).get(name),
+                "final_drift": last["drift"][name],
+                "max_abs_drift": max((abs(v) for v in vals), default=0.0),
+            }
+    timeline = [
+        {"step": s["step"], "t": s["t"], "steps": s["steps"],
+         "wall_s": s["wall_s"], "steps_per_sec": s["steps_per_sec"],
+         "sim_days_per_sec_per_chip": s["sim_days_per_sec_per_chip"]}
+        for s in segments if s["steps"] > 0
+    ]
+    return {"manifest": manifest, "drift": drift, "timeline": timeline,
+            "guards": guards, "bench": benches,
+            "n_segments": len(segments)}
+
+
+def print_report(s):
+    m = s["manifest"]
+    cfg, dev = m.get("config", {}), m.get("devices", {})
+    print("run:", json.dumps(cfg))
+    print(f"devices: {dev.get('count', '?')}x {dev.get('platform', '?')} "
+          f"(process {dev.get('process_index', 0)}/"
+          f"{dev.get('process_count', 1)}), jax "
+          f"{m.get('jax_version', '?')}")
+    print(f"metrics: {', '.join(m.get('metric_names', []))} "
+          f"(every {m.get('interval', '?')} steps; guards="
+          f"{m.get('guards', 'off')})")
+
+    if s["drift"]:
+        print("\ndrift vs step 0:")
+        print(f"  {'metric':<12} {'initial':>14} {'final':>14} "
+              f"{'final drift':>12} {'max |drift|':>12}")
+        for name, d in s["drift"].items():
+            ini = d["initial_value"]
+            fin = d["final_value"]
+            print(f"  {name:<12} "
+                  f"{ini if ini is None else format(ini, '>14.7g')} "
+                  f"{fin if fin is None else format(fin, '>14.7g')} "
+                  f"{d['final_drift']:>12.3e} {d['max_abs_drift']:>12.3e}")
+
+    if s["timeline"]:
+        print("\nrate timeline:")
+        print(f"  {'step':>8} {'t (s)':>12} {'steps':>7} {'wall s':>9} "
+              f"{'steps/s':>10} {'sd/s/chip':>10}")
+        for seg in s["timeline"]:
+            print(f"  {seg['step']:>8} {seg['t']:>12.0f} "
+                  f"{seg['steps']:>7} {seg['wall_s']:>9.3f} "
+                  f"{seg['steps_per_sec']:>10.2f} "
+                  f"{seg['sim_days_per_sec_per_chip']:>10.4f}")
+
+    if s["guards"]:
+        print("\nguard events:")
+        for g in s["guards"]:
+            print(f"  step {g['step']}: {g['event']} (value {g['value']:g},"
+                  f" policy {g['policy']}, last good step "
+                  f"{g['last_good_step']})")
+    else:
+        print("\nguard events: none")
+
+    for b in s["bench"]:
+        extra = {k: v for k, v in b.items()
+                 if k not in ("kind", "metric", "value", "unit")}
+        print(f"bench: {b['metric']} = {b['value']} {b['unit']}"
+              + (f"  {json.dumps(extra)}" if extra else ""))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a jaxstream telemetry JSONL file.")
+    ap.add_argument("path", help="telemetry JSONL file (obs.sink format)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    args = ap.parse_args(argv)
+    s = summarize(load(args.path))
+    if args.json:
+        print(json.dumps(s))
+    else:
+        print_report(s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,14 @@ else:
     # Tests use float64 oracles (SURVEY.md §7: "f64-on-CPU oracle");
     # library code is dtype-explicit so this only sharpens test math.
     jax.config.update("jax_enable_x64", True)
+    # NOTE (round 8): do NOT enable jax's persistent compilation cache
+    # here.  It would be a big win — the fast tier is compile-dominated
+    # and the 64 s TT-rounding parity drops to 22 s warm — but this
+    # image's jaxlib SEGFAULTS deserializing its own CPU cache entries
+    # (reproduced: tests/test_simulation_tt.py::
+    # test_tt_swe_run_with_history_and_checkpoint passes cold, then
+    # crashes in the very next process loading the entries it just
+    # wrote).  Revisit when the image's jax moves past 0.4.37.
 
 
 def pytest_collection_modifyitems(config, items):

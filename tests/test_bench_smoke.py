@@ -16,11 +16,13 @@ import sys
 import numpy as np
 
 
-def test_bench_smoke_runs_and_reports(monkeypatch, capsys):
+def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     sys.path.insert(0, __file__.rsplit("/", 2)[0])
     import bench
 
-    monkeypatch.setattr(sys, "argv", ["bench.py", "--smoke"])
+    telemetry = str(tmp_path / "bench.jsonl")
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--smoke",
+                                      "--telemetry", telemetry])
     code = None
     try:
         bench.main()
@@ -45,3 +47,14 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys):
     assert (ens["B2"]["sim_days_per_sec"]
             >= 0.9 * ens["B1"]["sim_days_per_sec"])
     assert ens["batched_exchange_plan"]["members"] == 2
+
+    # --telemetry writes a schema-valid obs-sink file alongside the
+    # stdout JSON (round-8 satellite: bench rides the structured sink).
+    from jaxstream.obs.sink import read_records
+
+    recs = read_records(telemetry)      # validates every line
+    assert recs[0]["kind"] == "manifest"
+    benches = [r for r in recs if r["kind"] == "bench"]
+    names = {b["metric"] for b in benches}
+    assert rec["metric"] in names
+    assert any(m.endswith("_B1") for m in names)

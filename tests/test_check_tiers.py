@@ -72,3 +72,30 @@ def test_registered_markers_parsed(name):
     allowed = check_tiers.registered_markers(
         os.path.join(REPO, "pytest.ini"))
     assert name in allowed
+
+
+def test_obs_importing_module_with_slow_marker_detected(tmp_path):
+    """Rule 3 (round-8 observability satellite): telemetry tests stay
+    tier-1 — a module importing jaxstream.obs must carry no slow
+    markers."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_t.py").write_text(
+        "import pytest\n"
+        "from jaxstream.obs import metrics\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module without the marker is clean...
+    (tests / "test_t.py").write_text(
+        "from jaxstream.obs import metrics\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # ...and slow markers elsewhere stay legal.
+    (tests / "test_u.py").write_text(
+        "import pytest\n"
+        "@pytest." + "mark.slow\n"
+        "def test_b():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0

@@ -1,0 +1,79 @@
+"""Multihost-aware logging (round-8 satellite).
+
+The 24-device subprocess tests used to interleave 24 identical INFO
+streams; the ``_MultihostFilter`` demotes non-zero processes to
+WARNING (unless ``JAXSTREAM_LOG`` explicitly overrides) and prefixes
+every record with its process index.  Process identity is resolved
+lazily per record, so the behavior is testable by monkeypatching
+``_process_info`` — no distributed runtime needed.
+"""
+
+import logging
+
+from jaxstream.utils import logging as jlog
+
+
+def _record(level):
+    return logging.LogRecord("jaxstream.test", level, __file__, 1,
+                             "msg", (), None)
+
+
+def test_single_process_logs_info_unprefixed(monkeypatch):
+    monkeypatch.setattr(jlog, "_process_info", lambda: (0, 1))
+    f = jlog._MultihostFilter(forced=False)
+    rec = _record(logging.INFO)
+    assert f.filter(rec)
+    assert rec.pidx == ""
+
+
+def test_process_zero_of_pod_logs_info_with_prefix(monkeypatch):
+    monkeypatch.setattr(jlog, "_process_info", lambda: (0, 24))
+    f = jlog._MultihostFilter(forced=False)
+    rec = _record(logging.INFO)
+    assert f.filter(rec)
+    assert rec.pidx == "p0 "
+
+
+def test_nonzero_process_demoted_to_warning(monkeypatch):
+    monkeypatch.setattr(jlog, "_process_info", lambda: (3, 24))
+    f = jlog._MultihostFilter(forced=False)
+    assert not f.filter(_record(logging.INFO))
+    assert not f.filter(_record(logging.DEBUG))
+    rec = _record(logging.WARNING)
+    assert f.filter(rec)        # real problems surface from any host
+    assert rec.pidx == "p3 "
+    assert f.filter(_record(logging.ERROR))
+
+
+def test_env_override_keeps_all_processes_logging(monkeypatch):
+    """JAXSTREAM_LOG set -> forced=True: every process logs at the
+    configured level, prefixed for attribution."""
+    monkeypatch.setattr(jlog, "_process_info", lambda: (7, 24))
+    f = jlog._MultihostFilter(forced=True)
+    rec = _record(logging.INFO)
+    assert f.filter(rec)
+    assert rec.pidx == "p7 "
+
+
+def test_process_info_failure_proof(monkeypatch):
+    """A broken/uninitialized jax must never take logging down."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def broken(name, *a, **k):
+        if name == "jax":
+            raise RuntimeError("backend exploded")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", broken)
+    assert jlog._process_info() == (0, 1)
+
+
+def test_get_logger_configures_filter_once():
+    log = jlog.get_logger("test_logging")
+    assert log.name == "jaxstream.test_logging"
+    root = logging.getLogger("jaxstream")
+    filters = [flt for h in root.handlers for flt in h.filters
+               if isinstance(flt, jlog._MultihostFilter)]
+    assert filters, "the multihost filter must be installed on the handler"

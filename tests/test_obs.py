@@ -1,0 +1,366 @@
+"""Telemetry-path coverage (round-8 observability tentpole).
+
+The acceptance criteria, as tests:
+  * enabling metrics leaves the state carry BITWISE unchanged;
+  * the in-loop invariants match eager ``Simulation.diagnostics()`` to
+    1e-12 relative in f64 — including on the 6-device explicit
+    shard_map tier (per-face partials + psum at C24);
+  * at most ONE device->host fetch per segment (``fetch_buffer`` is
+    monkeypatch-counted);
+  * the NaN guard halts with the last-good step on an injected blowup
+    (``observability.fault_step`` — stream-only, never the state);
+  * sink JSONL records round-trip schema-valid and the report CLI
+    summarizes them.
+
+This module imports ``jaxstream.obs`` and therefore must stay tier-1
+(scripts/check_tiers.py rule 3): no slow markers here.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from jaxstream.obs import metrics as obs_metrics
+from jaxstream.obs.monitor import HealthError, HealthMonitor
+from jaxstream.obs.sink import (TelemetrySink, read_records, run_manifest,
+                                validate_record)
+from jaxstream.simulation import Simulation
+
+
+def _cfg(n=12, nsteps=4, interval=2, **over):
+    cfg = {
+        "grid": {"n": n, "halo": 2, "dtype": "float64"},
+        "model": {"initial_condition": "tc2"},
+        "time": {"dt": 600.0, "nsteps": nsteps},
+        "parallelization": {"num_devices": 1},
+        "observability": {"interval": interval},
+    }
+    for k, v in over.items():
+        cfg.setdefault(k, {}).update(v)
+    return cfg
+
+
+# ------------------------------------------------------------------ sink
+def test_sink_jsonl_schema_round_trip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    manifest = run_manifest(["mass", "cfl"], 4, "warn",
+                            config={"grid_n": 12})
+    with TelemetrySink(path, manifest) as sink:
+        sink.write({"kind": "segment", "step": 4, "t": 2400.0,
+                    "steps": 4, "wall_s": 0.5, "steps_per_sec": 8.0,
+                    "sim_days_per_sec_per_chip": 0.05,
+                    "metrics": {"mass": 1.0}, "drift": {"mass": 0.0}})
+        sink.write({"kind": "guard", "event": "nan", "step": 4,
+                    "t": 2400.0, "value": float("nan"), "policy": "warn",
+                    "last_good_step": 2, "last_good_t": 1200.0})
+        sink.write({"kind": "bench", "metric": "m", "value": 1.0,
+                    "unit": "x"})
+    recs = read_records(path)
+    assert [r["kind"] for r in recs] == ["manifest", "segment", "guard",
+                                         "bench"]
+    assert recs[0]["metric_names"] == ["mass", "cfl"]
+    assert read_records(path, kind="guard")[0]["last_good_step"] == 2
+
+    with pytest.raises(ValueError, match="missing keys"):
+        validate_record({"kind": "segment", "step": 1})
+    with pytest.raises(ValueError, match="unknown"):
+        validate_record({"kind": "nope"})
+
+
+# -------------------------------------------------------------- metrics
+def test_metric_name_resolution_and_rejection():
+    from jaxstream.obs.metrics import default_metrics, resolve_metric_names
+
+    assert resolve_metric_names("default", "swe", cov=True) == \
+        default_metrics("swe", True)
+    assert "enstrophy" in default_metrics("swe", True)
+    assert "enstrophy" not in default_metrics("swe", False)
+    assert resolve_metric_names("mass, cfl", "swe", False) == \
+        ("mass", "cfl")
+    assert resolve_metric_names(["tracer_mass"], "advection", False) == \
+        ("tracer_mass",)
+    with pytest.raises(ValueError, match="unknown observability metric"):
+        resolve_metric_names("mass,banana", "swe", False)
+    # The Cartesian SWE model has no covariant vorticity operator.
+    with pytest.raises(ValueError, match="not available"):
+        resolve_metric_names("enstrophy", "swe", cov=False)
+    with pytest.raises(ValueError, match="not available"):
+        resolve_metric_names("mass", "advection", False)
+
+
+def test_interval_must_respect_temporal_block():
+    with pytest.raises(ValueError, match="temporal_block"):
+        Simulation(_cfg(nsteps=4,
+                        parallelization={"temporal_block": 2},
+                        observability={"interval": 3}))
+
+
+def test_interval_exceeding_segment_stride_rejected(tmp_path):
+    """interval > gcd(io strides) would truncate every segment's sample
+    count to zero — metrics AND guards silently dead.  Must refuse."""
+    with pytest.raises(ValueError, match="segment length"):
+        Simulation(_cfg(
+            nsteps=8,
+            io={"history_path": str(tmp_path / "h"),
+                "history_stride": 2},
+            observability={"interval": 4, "guards": "halt"}))
+
+
+def test_sink_truncates_previous_run(tmp_path):
+    """One file = one run: reopening a sink path must not append a
+    second manifest (the report CLI would mix two runs' drift
+    anchors)."""
+    path = str(tmp_path / "r.jsonl")
+    TelemetrySink(path, run_manifest(["mass"], 2, "off")).close()
+    TelemetrySink(path, run_manifest(["energy"], 4, "off")).close()
+    recs = read_records(path)
+    assert len(recs) == 1
+    assert recs[0]["metric_names"] == ["energy"]
+
+
+def test_tt_runs_reject_in_loop_metrics():
+    with pytest.raises(ValueError, match="numerics"):
+        Simulation(_cfg(model={"initial_condition": "tc2",
+                               "numerics": "tt", "tt_rank": 4},
+                        grid={"halo": 2}))
+
+
+def test_cov_model_default_ladder_includes_enstrophy():
+    """Covariant model metrics, straight from build_metric_set (no
+    Simulation/stepper compile needed): the default ladder gains
+    enstrophy and its value agrees with the eager diagnostic
+    operators at 1e-12 — the MetricSet is not a parallel
+    implementation."""
+    import jax.numpy as jnp
+
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
+    from jaxstream.obs.metrics import build_metric_set
+    from jaxstream.ops.fv import vorticity_cov
+    from jaxstream.physics.initial_conditions import williamson_tc2
+    from jaxstream.utils.diagnostics import potential_enstrophy
+
+    g = build_grid(12, halo=2, radius=EARTH_RADIUS, dtype=jnp.float64)
+    h_ext, v_ext = williamson_tc2(g, EARTH_GRAVITY, EARTH_OMEGA)
+    m = CovariantShallowWater(g, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA)
+    s = m.initial_state(h_ext, v_ext)
+    ms = build_metric_set(g, m, s, "default", 600.0, EARTH_GRAVITY)
+    assert "enstrophy" in ms.names
+    vals = np.asarray(jax.device_get(ms.values(s)))
+    assert np.all(np.isfinite(vals))
+    ref = float(potential_enstrophy(
+        g, s["h"], vorticity_cov(g, m._fill_u(s["u"])) + m.fcor))
+    assert vals[ms.names.index("enstrophy")] == pytest.approx(ref,
+                                                              rel=1e-12)
+    assert vals[ms.names.index("nonfinite_count")] == 0.0
+
+
+# ---------------------------------------------------- simulation wiring
+def test_c24_tc2_telemetry_acceptance(tmp_path, monkeypatch):
+    """The C24 TC2 acceptance criterion, end to end: schema-valid
+    JSONL, invariants at 1e-12 vs eager diagnostics(), exactly one
+    device->host fetch per compiled segment, AND a bitwise-identical
+    state carry vs the same run with telemetry off."""
+    calls = {"n": 0}
+    real = obs_metrics.fetch_buffer
+
+    def counting_fetch(buf):
+        calls["n"] += 1
+        return real(buf)
+
+    monkeypatch.setattr(obs_metrics, "fetch_buffer", counting_fetch)
+    path = str(tmp_path / "telemetry.jsonl")
+    io = {"history_path": str(tmp_path / "h"), "history_stride": 2}
+    sim = Simulation(_cfg(
+        n=24, nsteps=4, io=dict(io),
+        observability={"interval": 2, "sink": path, "guards": "warn"}))
+    sim.run()
+    # 4 steps with history_stride 2 -> two compiled segments -> exactly
+    # two buffer fetches (the per-step float() syncs are gone).
+    assert calls["n"] == 2
+
+    d = sim.diagnostics()
+    recs = read_records(path)           # validates every line's schema
+    segs = [r for r in recs if r["kind"] == "segment" and r["steps"] > 0]
+    assert len(segs) == 2
+    last = segs[-1]["metrics"]
+    assert last["mass"] == pytest.approx(d["mass"], rel=1e-12)
+    assert last["energy"] == pytest.approx(d["energy"], rel=1e-12)
+    assert last["nonfinite_count"] == 0.0
+    assert 0.0 < last["cfl"] < 2.0
+    assert segs[-1]["step"] == 4
+    # Drift columns exist for the conserved ladder and are tiny on a
+    # 4-step f64 TC2 run.
+    assert abs(segs[-1]["drift"]["mass"]) < 1e-12
+    assert recs[0]["kind"] == "manifest"
+    assert recs[0]["metric_names"] == list(sim._obs.ms.names)
+    # The monitor saw only good samples.
+    assert sim._obs.monitor.events == []
+    assert sim._obs.monitor.last_good_step == 4
+
+    # Bitwise: the identical run with observability off (same io, same
+    # segment structure) must produce the exact same carry — the
+    # instrumented loop runs the same state ops in the same order.
+    ref = Simulation(_cfg(n=24, nsteps=4,
+                          io={**io, "history_path": str(tmp_path / "h2")},
+                          observability={"interval": 0}))
+    ref.run()
+    assert calls["n"] == 2              # obs-off runs never fetch
+    for k in ref.state:
+        a = np.asarray(ref.state[k])
+        b = np.asarray(sim.state[k])
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b), f"carry {k} perturbed by metrics"
+    assert sim.t == ref.t
+
+
+def test_sharded_psum_metrics_match_eager_diagnostics(tmp_path):
+    """The explicit 6-device shard_map tier at C24: the in-loop metric
+    reductions partition into per-face partials + psum, and the values
+    that came through the segment buffer fetch must equal the eager
+    diagnostics of the same state at 1e-12 (f64)."""
+    if len(jax.devices()) < 6:
+        pytest.skip("needs 6 virtual CPU devices")
+    path = str(tmp_path / "sharded.jsonl")
+    sim = Simulation(_cfg(
+        n=24, nsteps=2, interval=2,
+        parallelization={"num_devices": 6, "device_type": "cpu",
+                         "use_shard_map": True},
+        observability={"interval": 2, "sink": path}))
+    sim.run()
+    d = sim.diagnostics()
+    last = read_records(path, kind="segment")[-1]
+    assert last["steps"] == 2
+    assert last["metrics"]["mass"] == pytest.approx(d["mass"],
+                                                    rel=1e-12)
+    assert last["metrics"]["energy"] == pytest.approx(d["energy"],
+                                                      rel=1e-12)
+    assert last["metrics"]["nonfinite_count"] == 0.0
+
+
+def test_ensemble_member0_metrics_match_diagnostics():
+    """Member-batched state: the rank-detected member axis reports
+    member-0 invariants (== diagnostics()'s mass_m0) with the
+    nonfinite count over all members.  Evaluated on the initial state
+    — no stepper compile needed; the in-loop plumbing is the same
+    metric function the other tests integrate with."""
+    sim = Simulation(_cfg(nsteps=2, interval=2,
+                          ensemble={"members": 2, "seed": 1}))
+    d = sim.diagnostics()
+    names = sim._obs.ms.names
+    # The wiring's own step-0 reference is the same evaluation.
+    vals = sim._obs.ref
+    assert vals[names.index("mass")] == pytest.approx(d["mass_m0"],
+                                                      rel=1e-12)
+    assert vals[names.index("energy")] == pytest.approx(d["energy_m0"],
+                                                        rel=1e-12)
+    assert vals[names.index("nonfinite_count")] == 0.0
+
+
+# ---------------------------------------------------------------- guards
+def test_nan_guard_halts_with_last_good_and_postmortem(tmp_path):
+    """The injected-blowup acceptance check, one integrated run: the
+    fault hook NaNs the stream at step 4, the guard raises HealthError
+    carrying last-good step 2, the postmortem checkpoint saves the
+    current state, the guard event reaches the sink before the raise,
+    and the state itself stays finite (the fault never touches it)."""
+    path = str(tmp_path / "t.jsonl")
+    sim = Simulation(_cfg(
+        nsteps=4, interval=2,
+        io={"checkpoint_path": str(tmp_path / "ckpt"),
+            "checkpoint_stride": 2},
+        observability={"interval": 2, "sink": path,
+                       "guards": "checkpoint_and_raise",
+                       "fault_step": 4}))
+    with pytest.raises(HealthError) as ei:
+        sim.run()
+    # Sample at step 2 was good, the injected NaN lands at step 4.
+    assert ei.value.kind == "nan"
+    assert ei.value.step == 4
+    assert ei.value.last_good_step == 2
+    assert ei.value.last_good_t == pytest.approx(1200.0)
+    # The fault is stream-only: the state itself never went non-finite.
+    assert np.all(np.isfinite(np.asarray(sim.state["h"])))
+    # The guard event made it to disk before the raise.
+    guards = read_records(path, kind="guard")
+    assert len(guards) == 1
+    assert guards[0]["event"] == "nan"
+    assert guards[0]["last_good_step"] == 2
+    from jaxstream.io.checkpoint import CheckpointManager
+
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    assert cm.latest_step() == 4    # the postmortem save
+
+    # The report CLI summarizes the very file this run produced
+    # (manifest + step-0 anchor + segment + guard records).
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    import telemetry_report
+
+    class _Cap:
+        def __init__(self):
+            self.buf = []
+
+        def write(self, s):
+            self.buf.append(s)
+
+        def flush(self):
+            pass
+
+    cap = _Cap()
+    real_stdout, sys.stdout = sys.stdout, cap
+    try:
+        assert telemetry_report.main([path]) == 0
+        out = "".join(cap.buf)
+        cap.buf = []
+        assert telemetry_report.main([path, "--json"]) == 0
+        rep = json.loads("".join(cap.buf))
+    finally:
+        sys.stdout = real_stdout
+    assert "drift vs step 0" in out
+    assert "guard events:" in out and "nan" in out
+    assert rep["n_segments"] >= 2
+    assert "mass" in rep["drift"]
+    assert rep["guards"][0]["event"] == "nan"
+
+
+def test_monitor_cfl_breach_and_last_good_tracking():
+    mon = HealthMonitor(["mass", "cfl"], policy="halt", cfl_limit=2.0)
+    steps = np.array([2, 4, 6])
+    ts = np.array([1200.0, 2400.0, 3600.0])
+    good = np.array([[1.0, 1.0, 1.0], [0.5, 0.6, 0.7]])
+    assert mon.check(steps, ts, good) == []
+    assert mon.last_good_step == 6
+    bad = np.array([[1.0, 1.0], [0.5, 2.5]])        # CFL breach at 10
+    with pytest.raises(HealthError) as ei:
+        mon.check(np.array([8, 10]), np.array([4800.0, 6000.0]), bad)
+    assert ei.value.kind == "cfl"
+    assert ei.value.step == 10
+    assert ei.value.last_good_step == 8
+    assert len(mon.events) == 1
+
+
+def test_monitor_warn_policy_continues():
+    """'warn' records the event and keeps going — the stream after the
+    breach is still scanned and can re-advance the last-good cursor."""
+    mon = HealthMonitor(["mass"], policy="warn")
+    buf = np.array([[1.0, np.nan, 1.0]])
+    events = mon.check(np.array([2, 4, 6]),
+                       np.array([1200.0, 2400.0, 3600.0]), buf)
+    assert [e["event"] for e in events] == ["nan"]
+    assert mon.last_good_step == 6      # recovered after the breach
+    assert mon.events == events         # recorded, not raised
+
+
+def test_monitor_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="policy"):
+        HealthMonitor(["mass"], policy="explode")
+
+
