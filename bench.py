@@ -24,9 +24,15 @@ is a top-level field.  The ``ensemble`` field reports the batched
 perturbed-IC ensemble section (``bench_ensemble``, TC5 C96 at the
 CFL-matched dt=300 — the members-x-moderate-resolution regime where
 batching pays): aggregate sim-days/sec/chip at B in {1, 4, 16} with
-B-scaled rooflines and the B=1 bitwise acceptance check.  ``python bench.py --smoke`` runs the
-C24 bitrot canary instead (no gates; wired into tier-1 via
-tests/test_bench_smoke.py).
+B-scaled rooflines and the B=1 bitwise acceptance check.  The ``io``
+field (round 9) reports the async-host-pipeline section
+(``bench_io``): steps/s with history+checkpoint+telemetry on, async
+vs sync, against the io-off baseline, plus the per-mode
+``host_wait_s`` totals from the runs' own telemetry.  ``python
+bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
+wired into tier-1 via tests/test_bench_smoke.py); ``python bench.py
+--compile-report`` prints cold-vs-warm compile seconds for the
+``JAXSTREAM_COMPILE_CACHE`` persistent-cache opt-in.
 """
 
 from __future__ import annotations
@@ -668,7 +674,7 @@ def bench_galewsky(n=384, dt=60.0, nu4=1.0e14):
 
 
 def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
-                   k1=2000, k2=8000, gates=True):
+                   k1=2000, k2=8000, gates=True, bitwise_check=True):
     """Batched ensemble section: aggregate throughput for B members.
 
     The many-concurrent-simulations workload (perturbed-IC TC5
@@ -695,6 +701,11 @@ def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
     vmapped classic stepper (impl tag) where the fused kernels don't
     compile, so the section runs end-to-end on any backend; ``gates``
     off skips the physical-range checks (the --smoke mode).
+    ``bitwise_check`` off skips the standalone B=1 batched-vs-unbatched
+    jit (one full stepper compile, ~15 s on this CPU): the smoke tier
+    leaves that exact parity to
+    tests/test_ensemble.py::test_b1_batched_bitwise_vs_unbatched, which
+    runs in the same gate — the full bench keeps it inline.
     """
     import jax
     import jax.numpy as jnp
@@ -718,6 +729,10 @@ def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
         model = CovariantShallowWater(
             grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext,
             backend="pallas")
+        # The compile IS the availability probe: on CPU the fused
+        # pallas kernels construct fine and only fail here ("Only
+        # interpret mode is supported"), which is what routes the
+        # section to the vmapped classic stepper.
         step1j = jax.jit(model.make_fused_step(dt))
         y1 = model.compact_state(model.initial_state(h_ext, v_ext))
         jax.block_until_ready(step1j(y1, jnp.float32(0.0)))
@@ -729,7 +744,7 @@ def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
             grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA, b_ext=b_ext)
     out["impl"] = impl
 
-    if impl == "fused_kernel":
+    if impl == "fused_kernel" and bitwise_check:
         # B=1 batched path must be bitwise-identical to the unbatched
         # stepper (the acceptance criterion of the member-axis fold).
         # The B=1 stepper is cached for the rate loop below; one jitted
@@ -836,6 +851,181 @@ def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
     return out
 
 
+def bench_io(n=48, dt=600.0, nsteps=96, stride=12, warm=12, ic="tc2",
+             gates=True):
+    """IO-overlap section: history+telemetry cost, async vs sync vs off.
+
+    The async-host-pipeline acceptance measurement (round 9): the same
+    Simulation config is integrated three ways — no IO at all (the
+    baseline the device loop can reach), history+checkpoint+telemetry
+    with the synchronous boundary stalls, and the same IO under
+    ``io.async_pipeline`` (double-buffered fetches + background
+    writers).  Reports steps/s for each, the IO overhead of both modes
+    relative to the io-off baseline, and the per-segment
+    ``host_wait_s`` totals from the runs' own telemetry files (the
+    async column is the overlap made visible).  ``gates``: the final
+    height field must stay finite in every mode — a pipeline that
+    corrupts the carry must not report a rate.  Never raises (returns
+    ``{"skipped": ...}``) — the headline metric does not depend on it.
+
+    Fairness: the simulation logger is held at WARNING for every mode,
+    which suppresses the sync path's per-emit diagnostics log lines
+    (a diagnostics compute + blocking device_get per boundary that the
+    async loop never performs).  Both modes therefore do identical I/O
+    work — history append + checkpoint save + telemetry record — and
+    the sync/async delta measures *overlap*, not dropped work.
+    """
+    import logging
+    import shutil
+    import tempfile
+
+    from jaxstream.obs.sink import read_records
+    from jaxstream.simulation import Simulation
+
+    out = {"n": n, "dt": dt, "nsteps": nsteps, "stride": stride,
+           "ic": ic}
+
+    def run_mode(mode):
+        d = tempfile.mkdtemp(prefix=f"bench_io_{mode}_")
+        cfg = {
+            "grid": {"n": n, "halo": 2, "dtype": "float32"},
+            "model": {"initial_condition": ic},
+            "time": {"dt": dt, "nsteps": warm + nsteps},
+            "parallelization": {"num_devices": 1},
+        }
+        if mode != "off":
+            cfg["io"] = {
+                "history_path": d + "/hist", "history_stride": stride,
+                "checkpoint_path": d + "/ckpt",
+                "checkpoint_stride": stride,
+                "async_pipeline": {"enabled": mode == "async"},
+            }
+            cfg["observability"] = {"interval": stride,
+                                    "sink": d + "/telemetry.jsonl"}
+        sim = Simulation(cfg)
+        try:
+            sim.run(warm)                      # compile + first strides
+            t0 = time.perf_counter()
+            if mode == "off":
+                # No strides -> one run() call would jit a SECOND,
+                # different-length segment inside the timed window
+                # (deflating the baseline that io_overhead_pct divides
+                # by).  Advance in warm-sized calls so the timed window
+                # reuses the already-compiled k=warm segment, like the
+                # strided modes reuse theirs.
+                s = warm
+                while s < warm + nsteps:
+                    s = min(s + warm, warm + nsteps)
+                    sim.run(s)
+            else:
+                sim.run(warm + nsteps)
+            wall = time.perf_counter() - t0
+            h = np.asarray(sim.state["h"], np.float64)
+            finite = bool(np.all(np.isfinite(h)))
+            if gates and not finite:
+                raise RuntimeError(f"bench io mode={mode}: non-finite h")
+            entry = {"steps_per_sec": round(nsteps / wall, 2),
+                     "wall_s": round(wall, 3)}
+            if mode != "off":
+                segs = read_records(d + "/telemetry.jsonl",
+                                    kind="segment")
+                entry["host_wait_s_total"] = round(
+                    sum(s.get("host_wait_s", 0.0) for s in segs
+                        if s["step"] > warm), 4)
+            return entry
+        finally:
+            sim.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+    sim_log = logging.getLogger("jaxstream.simulation")
+    old_level = sim_log.level
+    sim_log.setLevel(logging.WARNING)
+    try:
+        for mode in ("off", "sync", "async"):
+            out[mode] = run_mode(mode)
+        base = out["off"]["steps_per_sec"]
+        for mode in ("sync", "async"):
+            r = out[mode]["steps_per_sec"]
+            out[mode]["io_overhead_pct"] = round(100.0 * (base / r - 1.0),
+                                                 2)
+        out["async_overhead_smaller"] = (
+            out["async"]["io_overhead_pct"]
+            < out["sync"]["io_overhead_pct"])
+        log(f"bench io C{n} {ic} {nsteps} steps (stride {stride}): "
+            f"off {base:.1f} steps/s; "
+            f"sync {out['sync']['steps_per_sec']:.1f} "
+            f"(+{out['sync']['io_overhead_pct']:.1f}% overhead, host "
+            f"wait {out['sync']['host_wait_s_total']:.3f}s); "
+            f"async {out['async']['steps_per_sec']:.1f} "
+            f"(+{out['async']['io_overhead_pct']:.1f}% overhead, host "
+            f"wait {out['async']['host_wait_s_total']:.3f}s)")
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench io: unavailable ({type(e).__name__}: {e})")
+        out["skipped"] = f"{type(e).__name__}: {e}"
+    finally:
+        sim_log.setLevel(old_level)
+    return out
+
+
+def compile_report(n=24):
+    """``--compile-report``: cold vs warm compile seconds, one JSON line.
+
+    Measures the persistent compilation cache (enabled by
+    ``JAXSTREAM_COMPILE_CACHE=/path``, picked up on jaxstream import):
+    compile a representative stepper executable cold, drop jax's
+    in-memory caches (``jax.clear_caches()``), compile again — warm
+    hits the persistent cache when enabled, recompiles when not, so the
+    cold/warm ratio IS the cache's value.  Same-process reuse only: on
+    this image's jaxlib a *different* process deserializing CPU cache
+    entries segfaults (tests/conftest.py round-8 note), which is why
+    the cache is an env-var opt-in rather than a default.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from jaxstream.geometry.cubed_sphere import build_grid
+    from jaxstream.models.shallow_water import ShallowWater
+    from jaxstream.physics.initial_conditions import williamson_tc2
+    from jaxstream.stepping import integrate
+
+    cache_dir = os.environ.get("JAXSTREAM_COMPILE_CACHE", "")
+    grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
+    h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    model = ShallowWater(grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA)
+    state = model.initial_state(h_ext, v_ext)
+    step = model.make_step(600.0, "ssprk3")
+    fn = jax.jit(lambda y, k: integrate(step, y, 0.0, k, 600.0))
+
+    t0 = time.perf_counter()
+    fn.lower(state, 8).compile()
+    cold = time.perf_counter() - t0
+    jax.clear_caches()
+    t0 = time.perf_counter()
+    fn.lower(state, 8).compile()
+    warm = time.perf_counter() - t0
+    rec = {
+        "metric": "compile_report",
+        "cache_dir": cache_dir or None,
+        "cache_enabled": bool(cache_dir),
+        "n_cache_entries": (len(os.listdir(cache_dir))
+                            if cache_dir and os.path.isdir(cache_dir)
+                            else 0),
+        "cold_compile_s": round(cold, 3),
+        "warm_compile_s": round(warm, 3),
+        "speedup": round(cold / warm, 2) if warm > 0 else None,
+    }
+    log(f"compile report (C{n} classic SSPRK3 segment): cold {cold:.2f}s "
+        f"-> warm {warm:.2f}s "
+        + (f"(persistent cache at {cache_dir}, "
+           f"{rec['n_cache_entries']} entries)" if cache_dir
+           else "(JAXSTREAM_COMPILE_CACHE unset: warm = plain recompile)"))
+    print(json.dumps(rec))
+    return 0
+
+
 def bench_smoke(n=24, dt=600.0, telemetry=""):
     """``--smoke``: C24, a handful of steps, NO accuracy gates.
 
@@ -849,11 +1039,22 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
     t0 = time.perf_counter()
     try:
         ens = bench_ensemble(n=n, dt=dt, members=(1, 2), warm=1,
-                             k1=2, k2=6, gates=False)
+                             k1=2, k2=6, gates=False,
+                             bitwise_check=False)
     except Exception as e:
         log(f"bench smoke: ensemble section failed "
             f"({type(e).__name__}: {e})")
         ens = {"skipped": f"{type(e).__name__}: {e}"}
+    # IO-overlap canary: tiny async-vs-sync-vs-off triple so the async
+    # pipeline's bench plumbing is exercised by the tier-1 gate (the
+    # rates are smoke windows, NOT measurements — no gate on overhead).
+    # nsteps == warm keeps every mode's segment loop on ONE compiled
+    # body (the off mode would otherwise jit a second, different-k
+    # plain loop for the timed window — pure compile cost, no coverage:
+    # both history/checkpoint boundaries and both telemetry records
+    # still fire at steps 2 and 4).
+    io_sec = bench_io(n=12, dt=dt, nsteps=2, stride=2, warm=2,
+                      gates=False)
     b1 = ens.get("B1", {})
     ok = isinstance(b1, dict) and b1.get("sim_days_per_sec", 0.0) > 0.0
     rec = {
@@ -864,6 +1065,7 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
         "unit": "sim-days/sec (B=1, smoke window — NOT a benchmark)",
         "ok": bool(ok),
         "ensemble": ens,
+        "io": io_sec,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     sink = _open_telemetry(telemetry)
@@ -940,11 +1142,15 @@ def bench_multichip():
 
 def main():
     telemetry = _argv_value("--telemetry")
+    if "--compile-report" in sys.argv[1:]:
+        raise SystemExit(compile_report())
     if "--smoke" in sys.argv[1:]:
         raise SystemExit(bench_smoke(telemetry=telemetry))
     gates_ok = accuracy_gates()
     value, variants = bench_tc5()
     multichip = bench_multichip()
+    io_section = bench_io(n=96, dt=300.0, nsteps=480, stride=48, warm=48,
+                          ic="tc5")
     try:
         ensemble = bench_ensemble()
     except Exception as e:  # never fail the headline metric on this
@@ -998,6 +1204,7 @@ def main():
                      if value > 0 else None),
         "variants": variants,
         "ensemble": ensemble,
+        "io": io_section,
         "multichip": multichip,
     }))
 

@@ -1,0 +1,12 @@
+"""jaxstream — TPU-native cubed-sphere shallow-water framework.
+
+Importing the package applies environment hooks: setting
+``JAXSTREAM_COMPILE_CACHE=/path`` enables jax's persistent compilation
+cache there (``jaxstream.utils.jax_compat.enable_compile_cache``), so
+any entrypoint — ``Simulation``, the CLI, ``bench.py`` — warms compiles
+from the environment alone.
+"""
+
+from .utils.jax_compat import maybe_enable_compile_cache
+
+maybe_enable_compile_cache()

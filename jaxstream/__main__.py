@@ -16,13 +16,16 @@ import sys
 def _cmd_run(args):
     from .simulation import Simulation
 
-    sim = Simulation(args.config)
-    sim.run(args.nsteps)
-    print(json.dumps({
-        "steps": sim.step_count,
-        "t_seconds": sim.t,
-        "diagnostics": sim.diagnostics(),
-    }))
+    # Context-managed: drains/joins the async-pipeline writer thread
+    # and closes the telemetry sink on the way out (a no-op when
+    # io.async_pipeline is off).
+    with Simulation(args.config) as sim:
+        sim.run(args.nsteps)
+        print(json.dumps({
+            "steps": sim.step_count,
+            "t_seconds": sim.t,
+            "diagnostics": sim.diagnostics(),
+        }))
 
 
 def _cmd_info(args):

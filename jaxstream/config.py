@@ -22,6 +22,7 @@ __all__ = [
     "ParallelConfig",
     "PhysicsConfig",
     "TimeConfig",
+    "AsyncPipelineConfig",
     "IOConfig",
     "EnsembleConfig",
     "ObservabilityConfig",
@@ -124,12 +125,33 @@ class TimeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AsyncPipelineConfig:
+    """Async host pipeline (``io.async_pipeline:`` block) — default off,
+    and when off the run is bit-for-bit today's synchronous behavior.
+    With ``enabled: true`` the segment loop double-buffers: segment k+1
+    is dispatched before segment k's host work resolves, device->host
+    copies start with ``copy_to_host_async`` behind the next dispatch,
+    and history appends / checkpoint saves / telemetry JSONL records
+    drain on a bounded background writer thread (docs/USAGE.md "Async
+    host pipeline").  Written outputs are bitwise identical to the
+    synchronous path — only the overlap changes."""
+    enabled: bool = False
+    # Backpressure bound: the writer queue blocks the main thread when
+    # it already holds this many pending segments of tasks.  Host-side
+    # snapshot memory stays bounded at max_pending_segments queued + 1
+    # writing + 1 unresolved fetch (= 4 segments at the default)
+    # regardless of how far the device runs ahead.  Must be >= 1.
+    max_pending_segments: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
 class IOConfig:
     history_path: str = "history"
     history_stride: int = 0          # steps between snapshots; 0 = off
     history_tt_rank: int = 0         # >0: TT-compress snapshots (lossy)
     checkpoint_path: str = "checkpoints"
     checkpoint_stride: int = 0
+    async_pipeline: AsyncPipelineConfig = AsyncPipelineConfig()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,6 +216,13 @@ _SECTIONS = {
 }
 
 
+#: Dataclass-typed fields nested inside a section (config sub-blocks);
+#: their YAML value is a mapping built recursively by _build_section.
+_NESTED_SECTIONS = {
+    "AsyncPipelineConfig": AsyncPipelineConfig,
+}
+
+
 def _build_section(cls, data: dict):
     fields = {f.name: f for f in dataclasses.fields(cls)}
     unknown = set(data) - set(fields)
@@ -208,6 +237,23 @@ def _build_section(cls, data: dict):
     for k, v in data.items():
         ftype = fields[k].type
         ftype = getattr(ftype, "__name__", ftype)  # str or type object
+        if ftype in _NESTED_SECTIONS:
+            # Recurse OUTSIDE the coercion try: a bad key/value inside
+            # the nested mapping must surface _build_section's own
+            # message (which names the unknown key and the valid set),
+            # not a generic "expects a <section>" rewrap.
+            nested = _NESTED_SECTIONS[ftype]
+            if isinstance(v, nested):
+                pass
+            elif isinstance(v, dict) or v is None:
+                v = _build_section(nested, v or {})
+            else:
+                raise ValueError(
+                    f"{cls.__name__}.{k} expects a {ftype} mapping, "
+                    f"got {v!r}"
+                )
+            coerced[k] = v
+            continue
         try:
             if ftype == "float" and not isinstance(v, float):
                 v = float(v)

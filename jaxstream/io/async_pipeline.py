@@ -1,0 +1,205 @@
+"""Async host pipeline: double-buffered fetches + a background writer.
+
+The deck's design premise is that the device loop never waits on the
+host ("host contact only at history/checkpoint boundaries",
+simulation.py module docstring) — yet the synchronous run loop makes
+every segment boundary a full stall: block on the metric-buffer fetch,
+then on the history append (with its optional SVD compression), the
+Orbax checkpoint save, and the telemetry JSONL write, before the next
+segment is even dispatched.  This module supplies the two pieces that
+remove the stall (wired by ``Simulation`` behind the
+``io.async_pipeline:`` config block, default off):
+
+* :class:`HostFetch` — the double-buffer half.  Constructing one starts
+  ``copy_to_host_async`` transfers for every array leaf (via the
+  ``jaxstream.utils.jax_compat`` shim); the transfers are sequenced
+  after the arrays' definition events, so a fetch of a just-dispatched
+  segment's outputs costs nothing on the dispatch path.  ``resolve()``
+  blocks — and is only called *after the next segment's dispatch is in
+  flight*, so the wait overlaps device compute.
+
+* :class:`BackgroundWriter` — the writer half.  A single worker thread
+  drains history appends, checkpoint saves, and telemetry records in
+  strict FIFO order (one thread = the write order, and therefore every
+  written byte, is identical to the synchronous path).  The queue is
+  bounded (``max_pending``, default 2 segments of tasks, see
+  ``AsyncPipelineConfig``): when the host falls behind, ``submit``
+  blocks the main thread instead of buffering unboundedly — host
+  snapshot memory stays at a small constant (``max_pending`` queued
+  + 1 writing + 1 unresolved fetch = 4 segments at the default) no
+  matter how far the device runs ahead.  ``flush()`` drains; ``close()`` drains and joins.  A
+  task exception is captured and re-raised on the *next* main-thread
+  call (fail-stop: later tasks are skipped, not half-applied), so a
+  disk-full history append surfaces in the run loop rather than dying
+  silently on the worker.
+
+Donation note (TPU): the run loop enqueues the d2h copies *before*
+dispatching the next segment, whose compiled body donates the same
+state buffers.  That ordering is safe — the runtime sequences a donated
+buffer's reuse after its in-flight reads — and is the same discipline
+async checkpointing libraries rely on.  On CPU, donation is
+unimplemented and the question never arises.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+
+from ..utils.jax_compat import copy_to_host_async
+from ..utils.logging import get_logger
+
+__all__ = ["BackgroundWriter", "HostFetch", "WriterFailed"]
+
+log = get_logger(__name__)
+
+#: Thread name — the thread-leak test greps live threads for it.
+WRITER_THREAD_NAME = "jaxstream-io-writer"
+
+_STOP = object()
+
+
+class WriterFailed(RuntimeError):
+    """A queued writer task raised; carries the original as __cause__."""
+
+
+class HostFetch:
+    """A pytree of device arrays whose d2h copies are in flight.
+
+    Construction is non-blocking (enqueues ``copy_to_host_async`` per
+    leaf and keeps strong references so the buffers outlive donation
+    bookkeeping); :meth:`resolve` blocks until the data is on host and
+    returns the tree with every leaf as ``np.ndarray``.  Resolving
+    twice returns the same (cached) host tree.
+    """
+
+    def __init__(self, tree: Any):
+        self._tree = copy_to_host_async(tree)
+        self._host: Any = None
+        self._done = False
+
+    def resolve(self) -> Any:
+        if not self._done:
+            self._host = jax.tree_util.tree_map(np.asarray, self._tree)
+            self._tree = None       # drop device references promptly
+            self._done = True
+        return self._host
+
+
+class BackgroundWriter:
+    """Bounded-queue worker thread for boundary I/O tasks.
+
+    ``max_pending`` is the backpressure bound: ``submit`` blocks while
+    the queue already holds that many tasks.  All tasks run on ONE
+    worker in submission order.  After a task fails, the exception is
+    stored, every later queued task is *skipped* (fail-stop — a
+    history store must not receive frame k+1 after frame k failed
+    half-written), and the next ``submit``/``flush``/``close`` raises
+    :class:`WriterFailed` from it.
+    """
+
+    def __init__(self, max_pending: int = 2,
+                 name: str = WRITER_THREAD_NAME):
+        if max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {max_pending}")
+        self.max_pending = max_pending
+        self._q: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- worker
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is _STOP:
+                    return
+                if self._exc is None:       # fail-stop after first error
+                    fn, args, kwargs = item
+                    fn(*args, **kwargs)
+            except BaseException as e:      # noqa: BLE001 — must survive
+                self._exc = e
+                log.warning("background writer task failed (%s: %s); "
+                            "skipping the remaining queue",
+                            type(e).__name__, e)
+            finally:
+                self._q.task_done()
+
+    # -------------------------------------------------------- main thread
+    def _raise_pending(self):
+        if self._exc is not None:
+            # Drain BEFORE clearing: every task enqueued before the
+            # failure must be skipped by the worker (which still sees
+            # _exc) — clearing first would let the worker run frame
+            # k+1's append after frame k's failed half-written.  The
+            # join is fast: the worker is only marking tasks done.
+            self._q.join()
+            exc, self._exc = self._exc, None
+            raise WriterFailed(
+                f"background writer task failed: "
+                f"{type(exc).__name__}: {exc}") from exc
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._closed
+
+    @property
+    def pending(self) -> int:
+        """Tasks queued but not yet picked up (snapshot, racy)."""
+        return self._q.qsize()
+
+    def submit(self, fn: Callable, *args, **kwargs) -> None:
+        """Enqueue ``fn(*args, **kwargs)``; blocks at the queue bound.
+
+        The block IS the backpressure: the caller (the run loop) stalls
+        until the worker drains below ``max_pending``, so pending host
+        snapshots never exceed the bound."""
+        if self._closed:
+            raise RuntimeError("BackgroundWriter is closed")
+        self._raise_pending()
+        self._q.put((fn, args, kwargs))
+
+    def flush(self) -> None:
+        """Block until every queued task has run; raise on task failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain the queue, stop and join the worker; raise on failure.
+
+        Idempotent.  The sentinel rides the same FIFO queue, so every
+        task submitted before ``close`` completes before the thread
+        exits."""
+        if self._closed:
+            self._raise_pending()
+            return
+        self._closed = True
+        self._q.put(_STOP)
+        self._thread.join(timeout)
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *rest):
+        # On an exception the queue still drains (flush-on-exception:
+        # the postmortem evidence must land) but a writer failure must
+        # not mask the in-flight exception.
+        if exc_type is not None:
+            try:
+                self.close()
+            except Exception as e:
+                log.warning("background writer close failed during "
+                            "exception unwind (%s: %s)",
+                            type(e).__name__, e)
+        else:
+            self.close()

@@ -36,6 +36,15 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Dict[str, Any], t: float) -> None:
+        """Save one ``(state, t)`` pair (blocking until durable).
+
+        ``state`` leaves may be device arrays (the synchronous loop) or
+        host numpy arrays (the async pipeline saves the already-fetched
+        boundary snapshot — the restored values are identical either
+        way).  The manager is NOT thread-safe; all callers serialize
+        through one thread at a time — under the async pipeline that is
+        the background writer's FIFO, and the postmortem path drains it
+        before saving inline."""
         payload = {"state": state, "t": float(t)}
         self.mgr.save(step, args=self._ocp.args.StandardSave(payload))
         self.mgr.wait_until_finished()

@@ -13,6 +13,7 @@ host<->device transfer in the loop, SURVEY.md §3.4).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 from typing import Dict, Optional
 
@@ -21,7 +22,8 @@ import numpy as np
 from ..geometry.cubed_sphere import CubedSphereGrid
 from .zarrlite import ZarrGroup, open_group
 
-__all__ = ["HistoryWriter", "save_geometry", "load_geometry_arrays"]
+__all__ = ["HistoryWriter", "geometry_matches", "save_geometry",
+           "load_geometry_arrays"]
 
 
 class HistoryWriter:
@@ -44,8 +46,13 @@ class HistoryWriter:
         self.tt_rank = tt_rank
         if os.path.exists(os.path.join(path, ".zgroup")):
             self.group = open_group(path)
-            tarr = self.group["time"]
-            self._len = tarr.shape[0]
+            # The time axis IS the record count (appends commit it
+            # last).  A store created but killed before its first
+            # append has no time array yet — that is an empty store,
+            # not a corrupt one (the same convention read()/append
+            # rely on mid-stream).
+            self._len = (self.group["time"].shape[0]
+                         if "time" in self.group else 0)
             # The store's layout (raw 'h' vs 'h__ttA'/'h__ttB') is fixed at
             # creation; adopt the stored rank unconditionally — including a
             # stored None — so a reopen can never split one field across
@@ -68,14 +75,26 @@ class HistoryWriter:
         self.group[name].write_index0(i, a)
 
     def append(self, state: Dict, t: float) -> int:
-        """Write one snapshot; returns its record index."""
+        """Write one snapshot; returns its record index.
+
+        Crash-safe (round-9 satellite): every chunk/metadata file is
+        written atomically (temp + ``os.replace``, zarrlite), and the
+        ``time`` slab is written LAST — the record count readers trust
+        (``len(self.times)``, ``_len`` on reopen) only advances once
+        every field slab of the frame is durably in place.  The commit
+        point is the time array's ``.zarray`` shape publish, which
+        zarrlite's ``write_index0`` orders after the slab's chunk
+        bytes.  A run
+        killed mid-append therefore leaves at most a dangling partial
+        frame *past* the time axis, which the next append simply
+        overwrites (:meth:`read` truncates to the time length), never
+        a torn frame that poisons restart analysis.
+        """
         i = self._len
         if "time" not in self.group:
             self.group.create_array(
                 "time", shape=(0,), dtype=np.float64, chunks=(1,)
             )
-        tarr = self.group["time"]
-        tarr.write_index0(i, np.asarray(float(t)))
         for name, arr in state.items():
             a = np.asarray(arr)
             r = self.tt_rank
@@ -108,16 +127,22 @@ class HistoryWriter:
                 self._write(name + "__ttB", i, B)
             else:
                 self._write(name, i, a)
+        # Commit point: the frame exists once its time slab lands.
+        self.group["time"].write_index0(i, np.asarray(float(t)))
         self._len = i + 1
         return i
 
     def read(self, name: str) -> np.ndarray:
-        """Read a field's full record axis, reconstructing TT storage."""
+        """Read a field's full record axis, reconstructing TT storage.
+
+        Truncated to the time-axis length: a frame whose field slabs
+        landed but whose time slab didn't (a killed run) is a dangling
+        tail, not data."""
         if name in self.group:
-            return self.group[name].read()
+            return self.group[name].read()[:self._len]
         if name + "__ttA" in self.group:
-            A = self.group[name + "__ttA"].read()
-            B = self.group[name + "__ttB"].read()
+            A = self.group[name + "__ttA"].read()[:self._len]
+            B = self.group[name + "__ttB"].read()[:self._len]
             return np.einsum("...ir,...rj->...ij", A, B)
         raise KeyError(name)
 
@@ -129,8 +154,44 @@ class HistoryWriter:
         return self._len
 
 
-def save_geometry(path: str, grid: CubedSphereGrid) -> None:
-    """Persist every array field of the grid plus its scalar metadata."""
+def geometry_matches(path: str, grid: CubedSphereGrid) -> bool:
+    """True iff ``path`` already holds this grid's geometry store.
+
+    Matched on the scalar identity attrs (n/halo/radius/dalpha — what
+    :func:`save_geometry` stamps) plus the stored ``xyz`` dtype, which
+    distinguishes f32 from f64 grids.  A missing, foreign, or
+    mismatched store returns False (and the caller rewrites it)."""
+    try:
+        g = open_group(path)
+        a = g.attrs
+        if a.get("conventions") != "jaxstream-geometry-1":
+            return False
+        if (a.get("n"), a.get("halo")) != (grid.n, grid.halo):
+            return False
+        if (a.get("radius"), a.get("dalpha")) != (float(grid.radius),
+                                                  float(grid.dalpha)):
+            return False
+        # dtype only — no np.asarray(grid.xyz), which would pull the
+        # whole metric array to host on every Simulation construction
+        # (the exact per-construction cost this skip exists to remove).
+        return g["xyz"].dtype == np.dtype(grid.xyz.dtype)
+    except (OSError, KeyError, ValueError, json.JSONDecodeError):
+        return False
+
+
+def save_geometry(path: str, grid: CubedSphereGrid,
+                  skip_if_match: bool = True) -> None:
+    """Persist every array field of the grid plus its scalar metadata.
+
+    With ``skip_if_match`` (the default), an existing store whose
+    identity attrs and dtype already match ``grid`` is left untouched —
+    so a restarted run does not rewrite megabytes of unchanged metric
+    arrays on every ``Simulation`` construction (round-9 satellite).
+    A mismatched store (different resolution/halo/radius/dtype) is
+    rewritten as before.
+    """
+    if skip_if_match and geometry_matches(path, grid):
+        return
     g = ZarrGroup.create(
         path,
         {

@@ -26,6 +26,29 @@ __all__ = ["ZarrGroup", "ZarrArray", "open_group"]
 _FILL = {"f": 0.0, "i": 0, "u": 0, "b": False}
 
 
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``path`` via a same-directory temp file + ``os.replace``.
+
+    Every chunk and metadata write in this store goes through here
+    (round-9 crash-safety satellite): a killed process can leave a
+    stale ``.__tmp__`` orphan but never a torn file — readers see
+    either the old bytes or the new bytes, atomically.  POSIX rename
+    semantics; the temp name carries the pid so concurrent writers of
+    *different* records cannot collide.
+    """
+    tmp = f"{path}.__tmp__{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def _dump_json(path: str, obj: Any) -> None:
     """Serialize metadata exactly as zarr-python v2 does.
 
@@ -35,9 +58,9 @@ def _dump_json(path: str, obj: Any) -> None:
     ones written by the real package (golden-fixture tested,
     ``tests/test_io.py::test_zarr_golden_fixture``).
     """
-    with open(path, "w") as fh:
-        fh.write(json.dumps(obj, indent=4, sort_keys=True,
-                            ensure_ascii=True, separators=(",", ": ")))
+    _atomic_write_bytes(path, json.dumps(
+        obj, indent=4, sort_keys=True, ensure_ascii=True,
+        separators=(",", ": ")).encode("ascii"))
 
 
 def _dtype_str(dt: np.dtype) -> str:
@@ -112,8 +135,8 @@ class ZarrArray:
                                dtype=self.dtype)
                 full[tuple(slice(0, e) for e in block.shape)] = block
                 block = full
-            with open(self._chunk_file(idx), "wb") as fh:
-                fh.write(np.ascontiguousarray(block).tobytes())
+            _atomic_write_bytes(self._chunk_file(idx),
+                                np.ascontiguousarray(block).tobytes())
 
     def write_index0(self, i: int, data: np.ndarray) -> None:
         """Write one slab along axis 0 (requires chunks[0] == 1)."""
@@ -123,8 +146,6 @@ class ZarrArray:
         data = np.asarray(data, dtype=self.dtype)
         if data.shape != self.shape[1:]:
             raise ValueError(f"slab shape {data.shape} != {self.shape[1:]}")
-        if i >= self.shape[0]:  # grow along the record dimension
-            self.resize0(i + 1)
         grid_rest = tuple(
             -(-s // c) for s, c in zip(self.shape[1:], self.chunks[1:])
         )
@@ -139,8 +160,15 @@ class ZarrArray:
                                dtype=self.dtype)
                 full[tuple(slice(0, e) for e in block.shape)] = block
                 block = full
-            with open(self._chunk_file((i,) + rest), "wb") as fh:
-                fh.write(np.ascontiguousarray(block[None]).tobytes())
+            _atomic_write_bytes(self._chunk_file((i,) + rest),
+                                np.ascontiguousarray(block[None]).tobytes())
+        if i >= self.shape[0]:
+            # Grow the record axis LAST: .zarray's shape is what readers
+            # trust for the record count, so a crash between the chunk
+            # writes above and this publish leaves a dangling orphan
+            # chunk, never a published slab whose bytes are missing
+            # (which would read as fill values).
+            self.resize0(i + 1)
 
     def resize0(self, new_len: int) -> None:
         self.shape = (new_len,) + self.shape[1:]

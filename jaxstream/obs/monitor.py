@@ -22,6 +22,15 @@ Fault injection for testing lives upstream: the
 into the metric *stream* (never the state) at one global step, so a
 test can prove the whole fetch->check->raise path fires without
 integrating a real blowup.
+
+Async-pipeline timing (``io.async_pipeline.enabled``): segment k's
+buffer resolves only after segment k+1's dispatch is in flight, so a
+guard fires ONE segment later in wall-clock terms than under the
+synchronous loop — the breach step/value/last-good bookkeeping is
+unchanged (same buffer, same scan), and the raising policies still
+leave their evidence on disk: the run loop guarantees the background
+writer is flushed on any exception, and ``checkpoint_and_raise``'s
+postmortem drains queued saves before writing its own.
 """
 
 from __future__ import annotations

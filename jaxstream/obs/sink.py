@@ -26,7 +26,12 @@ from typing import Dict, Iterable, List, Optional
 __all__ = ["RECORD_KINDS", "TelemetrySink", "read_records",
            "validate_record", "run_manifest"]
 
-#: kind -> required keys (beyond "kind").
+#: kind -> required keys (beyond "kind").  Extra keys are legal — a
+#: record may carry more.  Notable optional ``segment`` key (round 9):
+#: ``host_wait_s``, the host-side I/O seconds that blocked the next
+#: segment's dispatch (fetch resolution is excluded — it overlaps
+#: compute under the async pipeline); the async-vs-sync comparison of
+#: this column is how the io.async_pipeline overlap is made visible.
 RECORD_KINDS: Dict[str, tuple] = {
     "manifest": ("schema_version", "created_unix", "metric_names",
                  "interval", "guards", "config", "devices"),
@@ -88,6 +93,12 @@ class TelemetrySink:
     sink`` at a fresh path per attempt if you want to keep the old
     record.  Multihost runs should only open a sink on process 0
     (``Simulation`` enforces this).
+
+    Threading: a sink is used from ONE thread at a time.  Under the
+    async host pipeline every ``write`` is a queued task on the single
+    background writer thread (FIFO with the history/checkpoint tasks),
+    so the line order — and therefore the file — is identical to the
+    synchronous path's.
     """
 
     def __init__(self, path: str, manifest: dict):

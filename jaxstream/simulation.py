@@ -24,6 +24,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import functools
+import logging
 import math
 import time
 from typing import Any, Callable, Dict, Optional
@@ -35,6 +36,7 @@ import jax.numpy as jnp
 
 from .config import Config, load_config
 from .geometry.cubed_sphere import build_grid
+from .io.async_pipeline import BackgroundWriter, HostFetch
 from .io.checkpoint import CheckpointManager
 from .io.history import HistoryWriter, save_geometry
 from .models.advection import TracerAdvection
@@ -47,7 +49,8 @@ from .parallel.mesh import (setup_ensemble_sharding, setup_sharding,
                             shard_ensemble_state, shard_state)
 from .parallel.sharded_model import make_stepper_for
 from .physics import initial_conditions as ics
-from .stepping import integrate, integrate_with_metrics, jit_integrate
+from .stepping import (integrate, integrate_with_metrics, jit_integrate,
+                       time_carry)
 from .utils import diagnostics as diag
 from .utils.logging import get_logger
 
@@ -71,6 +74,16 @@ class _ObsRuntime:
         self.wrote_initial = False
 
 log = get_logger(__name__)
+
+
+def _run_tasks(tasks):
+    """One async-pipeline boundary's writes, in order, as ONE writer
+    task — so the queue bound counts segments, and a failure mid-list
+    aborts the boundary's remaining writes (fail-stop within the
+    boundary, matching the writer's fail-stop across boundaries)."""
+    for fn, args in tasks:
+        fn(*args)
+
 
 _DTYPES = {"float32": jnp.float32, "float64": jnp.float64, "bfloat16": jnp.bfloat16}
 
@@ -228,6 +241,15 @@ class Simulation:
                 )
         self._segment_cache: Dict[int, Callable] = {}
 
+        # Async host pipeline (io.async_pipeline, round 9): the writer
+        # thread is created lazily on the first async run(); _host_wait
+        # accumulates the host-side I/O seconds that blocked the next
+        # dispatch since the last telemetry record (both modes report
+        # it, so the overlap is visible in the sink).
+        self._writer: Optional[BackgroundWriter] = None
+        self._host_wait = 0.0
+        self._t_carry = None
+
         io = cfg.io
         self.history: Optional[HistoryWriter] = None
         self.checkpoints: Optional[CheckpointManager] = None
@@ -339,16 +361,61 @@ class Simulation:
     def _postmortem_checkpoint(self):
         """'checkpoint_and_raise' breach callback: save the CURRENT
         (possibly corrupt) state for inspection — the HealthError's
-        last-good step is the restart target, this save is evidence."""
+        last-good step is the restart target, this save is evidence.
+
+        Async-pipeline aware: queued background saves are drained FIRST
+        (the Orbax manager is used serially — writer FIFO, then this),
+        and under the async loop ``self.state`` is the latest
+        *dispatched* segment's output, possibly still in flight — the
+        save blocks on it, which is exactly what "current state" means
+        once the pipeline runs ahead."""
         if self.checkpoints is None:
             log.warning(
                 "guard policy 'checkpoint_and_raise' with no checkpoint "
                 "manager (io.checkpoint_stride is 0) — raising without "
                 "a postmortem save")
             return
-        self.checkpoints.save(self.step_count, self.state, self.t)
+        if self._writer is not None and self._writer.alive:
+            try:
+                self._writer.flush()
+            except Exception as e:  # the postmortem save must still run
+                log.warning("async writer flush before postmortem failed "
+                            "(%s: %s)", type(e).__name__, e)
+        t = self.t
+        if self._t_carry is not None:
+            try:
+                t = float(jax.device_get(self._t_carry))
+            except Exception:
+                pass
+        self.checkpoints.save(self.step_count, self.state, t)
         log.warning("guard breach: postmortem checkpoint saved at step %d",
                     self.step_count)
+
+    def _ensure_writer(self) -> BackgroundWriter:
+        if self._writer is None or not self._writer.alive:
+            self._writer = BackgroundWriter(
+                self.config.io.async_pipeline.max_pending_segments)
+        return self._writer
+
+    def close(self):
+        """Release background resources: drain and join the async
+        writer thread, close the telemetry sink.  Idempotent.  Call it
+        (or use the Simulation as a context manager) when done with a
+        run whose ``io.async_pipeline.enabled`` is true — the writer is
+        a daemon thread, so skipping close leaks no process, but the
+        thread-hygiene tests hold this to zero."""
+        if self._writer is not None:
+            w, self._writer = self._writer, None
+            w.close()
+        if self._obs is not None and self._obs.sink is not None:
+            self._obs.sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def _build_model_and_state(self):
         cfg = self.config
         m, p, g = cfg.model, cfg.physics, self.grid
@@ -834,11 +901,15 @@ class Simulation:
 
         return fn
 
-    def _run_segment(self, k: int):
+    def _segment_fn(self, k: int) -> Callable:
         fn = self._segment_cache.get(k)
         if fn is None:
             fn = self._build_segment_fn(k)
             self._segment_cache[k] = fn
+        return fn
+
+    def _run_segment(self, k: int):
+        fn = self._segment_fn(k)
         if getattr(fn, "obs_samples", 0) > 0:
             # Instrumented segment: the metric buffer rides the compiled
             # loop and is fetched with ONE device->host transfer here —
@@ -852,23 +923,32 @@ class Simulation:
             wall = time.perf_counter() - wall0
             self.t = float(t)
             self.step_count += k
-            self._ingest_telemetry(host, step0, t0, k, wall)
+            self._ingest_telemetry(host, step0, t0, k, wall,
+                                   self.step_count, self.t)
             return
         self.state, t = fn(self.state, self.t)
         self.t = float(t)
         self.step_count += k
 
     def _ingest_telemetry(self, host, step0: int, t0: float, k: int,
-                          wall: float):
+                          wall: float, step_end: int, t_end: float,
+                          emit: Optional[Callable] = None):
         """One fetched segment buffer -> sink record + guard check.
 
         ``host``: the ``(k_metrics, samples)`` numpy buffer; sample j
         is global step ``step0 + (j+1)*interval``.  Writes the segment
         record first so a guard raise leaves the evidence on disk, then
         runs the monitor (guard events are flushed even when the policy
-        raises).
+        raises).  ``emit`` overrides the record destination — the async
+        pipeline routes records through its background writer (FIFO
+        with the history/checkpoint tasks) instead of writing inline.
+        The record's ``host_wait_s`` is the host-side I/O time that
+        blocked the next dispatch since the previous record (the
+        quantity the async pipeline exists to shrink).
         """
         obs = self._obs
+        if emit is None and obs.sink is not None:
+            emit = obs.sink.write
         interval = obs.cfg.interval
         names = obs.ms.names
         samples = host.shape[1]
@@ -881,16 +961,18 @@ class Simulation:
                 v0 = float(obs.ref[i])
                 d = float(host[i, -1]) - v0
                 drift[n] = d / abs(v0) if v0 else d
-        if obs.sink is not None:
+        if emit is not None:
             rate = k / wall if wall > 0 else float("inf")
             chips = (self.config.parallelization.num_devices
                      if self.setup is not None else 1)
-            obs.sink.write({
+            host_wait, self._host_wait = self._host_wait, 0.0
+            emit({
                 "kind": "segment",
-                "step": self.step_count, "t": self.t, "steps": k,
+                "step": step_end, "t": t_end, "steps": k,
                 "wall_s": wall, "steps_per_sec": rate,
                 "sim_days_per_sec_per_chip":
                     rate * dt / 86400.0 / chips,
+                "host_wait_s": host_wait,
                 "metrics": {n: float(host[i, -1])
                             for i, n in enumerate(names)},
                 "drift": drift,
@@ -903,17 +985,23 @@ class Simulation:
             try:
                 obs.monitor.check(steps, ts, host)
             finally:
-                if obs.sink is not None:
+                if emit is not None:
                     for ev in obs.monitor.events[n0:]:
-                        obs.sink.write(ev)
+                        emit(ev)
 
     def _emit(self):
         if self.history is not None:
             self.history.append(
                 {k: np.asarray(v) for k, v in self.state.items()}, self.t
             )
-        for k, v in self.diagnostics().items():
-            log.info("step %-8d t=%10.0fs  %s=%.10g", self.step_count, self.t, k, v)
+        # The per-emit log lines cost real host time (a diagnostics
+        # compute + one blocking device_get) — only pay it when the
+        # lines will actually be shown.  bench.py's io section relies
+        # on this to compare sync vs async on identical I/O work.
+        if log.isEnabledFor(logging.INFO):
+            for k, v in self.diagnostics().items():
+                log.info("step %-8d t=%10.0fs  %s=%.10g",
+                         self.step_count, self.t, k, v)
 
     @staticmethod
     def _fetch_scalars(out) -> Dict[str, float]:
@@ -1037,16 +1125,26 @@ class Simulation:
             })
             obs.wrote_initial = True
         wall0 = time.perf_counter()
-        while self.step_count < total:
-            k = min(seg, total - self.step_count) if seg else total - self.step_count
-            self._run_segment(k)
-            if io.history_stride and self.step_count % io.history_stride == 0:
-                self._emit()
-            if (
-                self.checkpoints is not None
-                and self.step_count % io.checkpoint_stride == 0
-            ):
-                self.checkpoints.save(self.step_count, self.state, self.t)
+        if io.async_pipeline.enabled:
+            self._run_loop_async(total, seg, io)
+        else:
+            while self.step_count < total:
+                k = (min(seg, total - self.step_count) if seg
+                     else total - self.step_count)
+                self._run_segment(k)
+                if (io.history_stride
+                        and self.step_count % io.history_stride == 0):
+                    w0 = time.perf_counter()
+                    self._emit()
+                    self._host_wait += time.perf_counter() - w0
+                if (
+                    self.checkpoints is not None
+                    and self.step_count % io.checkpoint_stride == 0
+                ):
+                    w0 = time.perf_counter()
+                    self.checkpoints.save(self.step_count, self.state,
+                                          self.t)
+                    self._host_wait += time.perf_counter() - w0
         jax.block_until_ready(self.state)
         wall = time.perf_counter() - wall0
         ran = self.step_count - start
@@ -1056,6 +1154,167 @@ class Simulation:
             ran, days, wall, days / wall if wall > 0 else float("inf"),
         )
         return self.state
+
+    # ------------------------------------------------------- async pipeline
+    def _run_loop_async(self, total: int, seg: int, io):
+        """The ``io.async_pipeline`` form of the segment loop.
+
+        Double-buffered: segment k+1 is dispatched with segment k's
+        boundary still unresolved — its device->host copies were
+        started (``copy_to_host_async`` via :class:`HostFetch`) right
+        behind segment k's own dispatch, and only after segment k+1 is
+        in flight does the host block on them.  Resolved boundaries
+        hand their history appends / checkpoint saves / telemetry
+        records to the bounded background writer; at the queue bound
+        (``max_pending_segments``) ``submit`` blocks, which is the
+        pipeline's backpressure — host snapshots stay at a small
+        constant (``max_pending_segments`` queued + 1 being written
+        + 1 unresolved fetch).  Written bytes are identical to
+        the synchronous path: one writer thread, FIFO, same values
+        (the time scalar stays on device between segments via
+        ``stepping.time_carry`` — bitwise the same float the sync
+        path round-trips through python).
+
+        On ANY exception the writer is still flushed before the
+        exception propagates (guaranteed flush-on-exception), so a
+        guard's sink records and the ``checkpoint_and_raise``
+        postmortem land on disk.
+        """
+        obs = self._obs
+        writer = None
+        if (self.history is not None or self.checkpoints is not None
+                or (obs is not None and obs.sink is not None)):
+            writer = self._ensure_writer()
+        self._t_carry = time_carry(self.t)
+        self._seg_anchor = time.perf_counter()
+        t_host = self.t              # resolved host time (trails one seg)
+        pending = None
+        raised = False
+        try:
+            while self.step_count < total:
+                k = (min(seg, total - self.step_count) if seg
+                     else total - self.step_count)
+                fn = self._segment_fn(k)
+                samples = getattr(fn, "obs_samples", 0)
+                step0 = self.step_count
+                buf = None
+                if samples > 0:
+                    self.state, self._t_carry, buf = fn(
+                        self.state, self._t_carry, jnp.asarray(step0))
+                else:
+                    self.state, self._t_carry = fn(self.state,
+                                                   self._t_carry)
+                self.step_count += k
+                want_hist = bool(
+                    io.history_stride
+                    and self.step_count % io.history_stride == 0
+                    and self.history is not None)
+                want_ckpt = bool(
+                    self.checkpoints is not None and io.checkpoint_stride
+                    and self.step_count % io.checkpoint_stride == 0)
+                # The boundary snapshot must be a DISTINCT device
+                # buffer: the next dispatch donates self.state, and jax
+                # marks a donated input deleted at dispatch (python-side
+                # bookkeeping, every backend) — fetching the original
+                # after that raises.  jnp.copy dispatches an on-device
+                # copy asynchronously; its d2h fetch then rides behind
+                # the next segment.  One state copy per history/
+                # checkpoint boundary, nothing per plain segment.
+                snap = None
+                if want_hist or want_ckpt:
+                    snap = jax.tree_util.tree_map(jnp.copy, self.state)
+                b = {
+                    "k": k, "step0": step0, "step_end": self.step_count,
+                    "samples": samples,
+                    "t": HostFetch(self._t_carry),
+                    "buf": HostFetch(buf) if samples > 0 else None,
+                    "state": HostFetch(snap) if snap is not None else None,
+                    "hist": want_hist, "ckpt": want_ckpt,
+                }
+                # The double buffer: only now — with this segment's
+                # dispatch in flight — resolve the previous boundary.
+                # (pending is popped BEFORE resolving so a raise inside
+                # the resolve can never double-resolve it from the
+                # unwind path below.)
+                prev, pending = pending, None
+                if prev is not None:
+                    t_host = self._resolve_boundary(prev, t_host, writer)
+                pending = b
+            prev, pending = pending, None
+            if prev is not None:
+                t_host = self._resolve_boundary(prev, t_host, writer)
+        except BaseException:
+            raised = True
+            # A still-pending boundary is fully computed on device — the
+            # sync path would have written it before dispatching the
+            # segment that just raised, so land its I/O (best-effort,
+            # never masking the in-flight exception) before unwinding.
+            if pending is not None:
+                try:
+                    self._resolve_boundary(pending, t_host, writer)
+                except Exception:
+                    log.warning("could not land the in-flight boundary "
+                                "during exception unwind", exc_info=True)
+                pending = None
+            raise
+        finally:
+            if writer is not None:
+                try:
+                    writer.flush()
+                except Exception:
+                    # Flush-on-exception must not MASK the in-flight
+                    # exception; on the success path a writer failure
+                    # is the run's failure.
+                    if not raised:
+                        raise
+                    log.warning("async writer flush failed during "
+                                "exception unwind", exc_info=True)
+        self.t = float(jax.device_get(self._t_carry))
+
+    def _resolve_boundary(self, b, t_prev: float, writer) -> float:
+        """Resolve one dispatched segment's host copies and hand its
+        boundary I/O to the background writer.  Called with the NEXT
+        segment already dispatched; returns the boundary's host time.
+
+        All of a boundary's writes ride ONE queued task, in sync-path
+        order (segment record, guard events, history append, checkpoint
+        save) — so the writer's FIFO produces byte-identical files AND
+        the queue bound counts whole segments, which is what
+        ``max_pending_segments`` promises.  A guard raise inside the
+        telemetry ingest still submits the records gathered so far
+        (segment record + guard events land on disk) but skips the
+        history/checkpoint writes, exactly like the synchronous loop,
+        which raises before reaching them."""
+        t_host = float(np.asarray(b["t"].resolve()))
+        self.t = t_host
+        now = time.perf_counter()
+        wall = now - self._seg_anchor
+        self._seg_anchor = now
+        host_state = (b["state"].resolve() if b["state"] is not None
+                      else None)
+        tasks = []
+        try:
+            if b["samples"] > 0:
+                host = b["buf"].resolve()
+                emit = None
+                obs = self._obs
+                if obs is not None and obs.sink is not None:
+                    sink_write = obs.sink.write
+                    emit = lambda rec: tasks.append((sink_write, (rec,)))
+                self._ingest_telemetry(host, b["step0"], t_prev, b["k"],
+                                       wall, b["step_end"], t_host,
+                                       emit=emit)
+            if b["hist"]:
+                tasks.append((self.history.append, (host_state, t_host)))
+            if b["ckpt"]:
+                tasks.append((self.checkpoints.save,
+                              (b["step_end"], host_state, t_host)))
+        finally:
+            if tasks:
+                w0 = time.perf_counter()
+                writer.submit(_run_tasks, tasks)
+                self._host_wait += time.perf_counter() - w0
+        return t_host
 
 
 def run_from_config(source: Any, nsteps: Optional[int] = None):

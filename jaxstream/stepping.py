@@ -21,9 +21,24 @@ import jax.numpy as jnp
 from jax import tree_util as jtu
 
 __all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper",
-           "blocked", "integrate", "integrate_with_history",
+           "blocked", "time_carry", "integrate", "integrate_with_history",
            "integrate_with_metrics", "vmap_ensemble", "jit_integrate",
            "jit_integrate_with_history"]
+
+
+def time_carry(t):
+    """The canonical time-scalar carry: ``jnp.asarray(t, dtype=float)``.
+
+    ``dtype=float`` resolves to f64 under ``jax_enable_x64`` and f32
+    otherwise — exactly what :func:`integrate` commits its loop carry
+    to.  The async host pipeline passes segment boundaries' *device*
+    time scalars straight back into the next segment through this form
+    (instead of the synchronous path's ``float(t)`` round trip, which
+    would block the dispatch on a d2h sync); the values are bitwise
+    identical either way — a device f32/f64 scalar round-tripped
+    through a python float converts back to the same bits.
+    """
+    return jnp.asarray(t, dtype=float)
 
 
 def _axpy(y, dt, k):
@@ -148,7 +163,7 @@ def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,
 
     # dtype=float -> float64 under jax_enable_x64, else float32: long runs
     # in x64 mode keep full time resolution (t ~ 1e6 s overwhelms f32 ulp).
-    t0a = jnp.asarray(t0, dtype=float)
+    t0a = time_carry(t0)
     if unroll == 1:
         return jax.lax.fori_loop(0, nsteps, body, (y0, t0a))
     y, t = jax.lax.fori_loop(0, nsteps // unroll, body_u, (y0, t0a))
@@ -170,7 +185,7 @@ def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float
 
     nchunks, rem = divmod(nsteps, stride)
     (y, t), hist = jax.lax.scan(
-        chunk, (y0, jnp.asarray(t0, dtype=float)), None, length=nchunks
+        chunk, (y0, time_carry(t0)), None, length=nchunks
     )
     if rem:  # don't silently drop the trailing nsteps % stride steps
         y, t = jax.lax.fori_loop(0, rem, body, (y, t))
@@ -209,7 +224,7 @@ def integrate_with_metrics(step: Callable, y0, t0: float, ncalls: int,
         raise ValueError(f"every must be >= 1, got {every}")
     if n_samples < 1:
         raise ValueError(f"n_samples must be >= 1, got {n_samples}")
-    t0a = jnp.asarray(t0, dtype=float)
+    t0a = time_carry(t0)
     vec_shape = jax.eval_shape(metric_fn, y0, t0a)
     buf0 = jnp.full((vec_shape.shape[0], n_samples), jnp.nan,
                     vec_shape.dtype)

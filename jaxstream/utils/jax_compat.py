@@ -13,10 +13,12 @@ handled (and documented) at the call site.
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 
-__all__ = ["LEGACY_SHARD_MAP", "named_scope", "shard_map",
+__all__ = ["LEGACY_SHARD_MAP", "copy_to_host_async", "enable_compile_cache",
+           "maybe_enable_compile_cache", "named_scope", "shard_map",
            "tpu_compiler_params"]
 
 #: True on the 0.4.x line.  Besides the spelling differences shimmed
@@ -60,6 +62,75 @@ def named_scope(name: str):
     if ns is None:  # pragma: no cover - every supported jax has it
         return contextlib.nullcontext()
     return ns(name)
+
+
+def copy_to_host_async(tree):
+    """Start device->host copies of every array leaf; returns ``tree``.
+
+    The async-host-pipeline primitive (``jaxstream.io.async_pipeline``):
+    enqueues a non-blocking d2h transfer per ``jax.Array`` leaf — the
+    transfer is sequenced after the array's definition event, so calling
+    this on the *future* a just-dispatched segment returned costs
+    nothing on the dispatch path.  A later ``np.asarray`` on the same
+    array resolves against the in-flight copy instead of starting a
+    blocking one.  Spelled ``Array.copy_to_host_async()`` on every
+    supported jax; leaves without the method (numpy arrays, python
+    scalars) pass through untouched, so whole state pytrees can be
+    handed over unfiltered.
+    """
+    def start(x):
+        m = getattr(x, "copy_to_host_async", None)
+        if m is not None:
+            m()
+        return x
+
+    return jax.tree_util.tree_map(start, tree)
+
+
+def enable_compile_cache(path: str) -> str:
+    """Point jax's persistent compilation cache at ``path`` (created).
+
+    Also zeroes ``jax_persistent_cache_min_compile_time_secs`` so every
+    executable is cached — the fast tier's compiles are individually
+    sub-second but collectively dominate its wall time.  KNOWN LIMIT on
+    this image's jaxlib (0.4.37): a *different process* deserializing
+    CPU cache entries segfaults (tests/conftest.py round-8 note), so
+    cross-process reuse is an opt-in via ``JAXSTREAM_COMPILE_CACHE``
+    rather than a default; same-process reuse (``jax.clear_caches()``
+    then recompile, what ``bench.py --compile-report`` measures) is
+    solid.
+    """
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for flag, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(flag, val)
+        except Exception:  # flag spelling drifts across jax versions
+            pass
+    try:
+        # jax latches cache-enablement once per process at the first
+        # compile (is_cache_used's _cache_checked); enabling the cache
+        # AFTER something already compiled needs the latch reset or the
+        # directory silently stays empty.
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    return path
+
+
+def maybe_enable_compile_cache(env: str = "JAXSTREAM_COMPILE_CACHE"):
+    """Enable the persistent compile cache iff ``$JAXSTREAM_COMPILE_CACHE``
+    names a directory; returns the path or None.  Called on package
+    import (jaxstream/__init__.py) so any entrypoint — Simulation, the
+    CLI, bench.py — picks the cache up from the environment alone."""
+    path = os.environ.get(env, "")
+    if not path:
+        return None
+    return enable_compile_cache(path)
 
 
 def tpu_compiler_params(**kwargs):

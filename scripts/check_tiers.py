@@ -27,6 +27,13 @@ every gate run self-checking):
    obs-adjacent tests in a module that exercises the feature through
    ``Simulation`` without importing ``jaxstream.obs`` directly.
 
+4. **Async-pipeline tests stay tier-1** (round-9 satellite): the same
+   rule for modules importing ``jaxstream.io.async_pipeline``.  The
+   async/sync bitwise file parity, the writer backpressure bound, the
+   flush-on-HealthError guarantee and the thread-leak check are the
+   acceptance criteria of the overlap path — they must run in every
+   fast gate, not rot in the slow tier.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -49,6 +56,11 @@ _WORKER_RE = re.compile(
 _OBS_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.obs\b|import\s+jaxstream\.obs\b"
     r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*obs\b)", re.MULTILINE)
+_ASYNC_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.io\.async_pipeline\b"
+    r"|import\s+jaxstream\.io\.async_pipeline\b"
+    r"|from\s+jaxstream\.io\s+import\s+(\w+\s*,\s*)*async_pipeline\b)",
+    re.MULTILINE)
 
 
 def registered_markers(pytest_ini: str) -> set:
@@ -87,6 +99,13 @@ def lint_file(path: str, allowed: set):
                f"gate certifies the observability acceptance criteria "
                f"on every run); move the slow test to a module that "
                f"does not import jaxstream.obs")
+    if _ASYNC_IMPORT_RE.search(src) and "slow" in used:
+        yield (f"{rel}: imports jaxstream.io.async_pipeline but marks "
+               f"tests slow — the async-pipeline acceptance criteria "
+               f"(bitwise file parity, backpressure bound, "
+               f"flush-on-exception, thread hygiene) must run in every "
+               f"fast gate; move the slow test to a module that does "
+               f"not import jaxstream.io.async_pipeline")
 
 
 def main(repo_root: str = None) -> int:

@@ -65,10 +65,13 @@ def summarize(records):
     timeline = [
         {"step": s["step"], "t": s["t"], "steps": s["steps"],
          "wall_s": s["wall_s"], "steps_per_sec": s["steps_per_sec"],
-         "sim_days_per_sec_per_chip": s["sim_days_per_sec_per_chip"]}
+         "sim_days_per_sec_per_chip": s["sim_days_per_sec_per_chip"],
+         "host_wait_s": s.get("host_wait_s", 0.0)}
         for s in segments if s["steps"] > 0
     ]
+    host_wait_total = sum(t["host_wait_s"] for t in timeline)
     return {"manifest": manifest, "drift": drift, "timeline": timeline,
+            "host_wait_total_s": host_wait_total,
             "guards": guards, "bench": benches,
             "n_segments": len(segments)}
 
@@ -100,12 +103,16 @@ def print_report(s):
     if s["timeline"]:
         print("\nrate timeline:")
         print(f"  {'step':>8} {'t (s)':>12} {'steps':>7} {'wall s':>9} "
-              f"{'steps/s':>10} {'sd/s/chip':>10}")
+              f"{'steps/s':>10} {'sd/s/chip':>10} {'host wait s':>11}")
         for seg in s["timeline"]:
             print(f"  {seg['step']:>8} {seg['t']:>12.0f} "
                   f"{seg['steps']:>7} {seg['wall_s']:>9.3f} "
                   f"{seg['steps_per_sec']:>10.2f} "
-                  f"{seg['sim_days_per_sec_per_chip']:>10.4f}")
+                  f"{seg['sim_days_per_sec_per_chip']:>10.4f} "
+                  f"{seg['host_wait_s']:>11.4f}")
+        print(f"  host I/O wait blocking dispatch, total: "
+              f"{s['host_wait_total_s']:.4f}s "
+              f"(io.async_pipeline moves this off the critical path)")
 
     if s["guards"]:
         print("\nguard events:")

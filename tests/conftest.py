@@ -13,7 +13,15 @@ import sys
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    # The fast tier is COMPILE-dominated (hundreds of jit compiles of
+    # big unrolled stepper graphs) and CI boxes are small: splitting
+    # LLVM codegen into parallel modules is numerics-neutral (pure
+    # compile-time partitioning) and measured ~8% off a compile-heavy
+    # module even on a 2-core container (round 9).
+    _flags = (_flags + " --xla_cpu_parallel_codegen_split_count=8").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 # Make the repo root importable regardless of pytest rootdir config.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -38,14 +46,30 @@ else:
     # Tests use float64 oracles (SURVEY.md §7: "f64-on-CPU oracle");
     # library code is dtype-explicit so this only sharpens test math.
     jax.config.update("jax_enable_x64", True)
-    # NOTE (round 8): do NOT enable jax's persistent compilation cache
-    # here.  It would be a big win — the fast tier is compile-dominated
-    # and the 64 s TT-rounding parity drops to 22 s warm — but this
-    # image's jaxlib SEGFAULTS deserializing its own CPU cache entries
-    # (reproduced: tests/test_simulation_tt.py::
-    # test_tt_swe_run_with_history_and_checkpoint passes cold, then
-    # crashes in the very next process loading the entries it just
-    # wrote).  Revisit when the image's jax moves past 0.4.37.
+    # Hold the package logger at WARNING for the gate: Simulation's
+    # per-emit INFO diagnostics lines each cost a diagnostics compile
+    # + a blocking device_get (simulation._emit gates on
+    # isEnabledFor), and across the suite's many history-enabled runs
+    # that is tens of seconds of the fixed 870 s tier-1 budget spent
+    # formatting log lines no test asserts on.  Tests that DO assert
+    # on log records set their own level (caplog.at_level).
+    import logging
+
+    logging.getLogger("jaxstream").setLevel(logging.WARNING)
+    # NOTE (rounds 8-9): do NOT enable jax's persistent compilation
+    # cache here.  It would be a big win — the fast tier is compile-
+    # dominated and a process-private cache dir measured ~60 s off
+    # test_bench_smoke + test_async_pipeline alone — but this image's
+    # jaxlib (0.4.37) SEGFAULTS deserializing CPU cache entries, and
+    # round 9 re-proved that the hazard is NOT limited to cross-process
+    # reuse: with a fresh per-run cache dir, a mid-suite
+    # ``jax.clear_caches()`` turns later compiles into disk reads of
+    # entries the same process wrote, and the gate died with SIGSEGV in
+    # the TT tier (tests/test_simulation_tt.py, history append touching
+    # a buffer from a cache-deserialized executable).  Small pure-jnp
+    # programs round-trip fine (bench.py --compile-report), the full
+    # suite's mix (scipy custom calls, donation, TT) does not.  Revisit
+    # when the image's jax moves past 0.4.37.
 
 
 def pytest_collection_modifyitems(config, items):

@@ -41,12 +41,27 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
         assert ens[key]["sim_days_per_sec"] > 0.0, key
         assert np.isfinite(ens[key]["sim_days_per_sec"])
     # B=2 advances two members per step; a correct batched path beats
-    # B=1 aggregate comfortably (measured ~2x on CPU).  The 0.9 floor
-    # only guards against a batched step that silently advances one
-    # member — wall-clock noise on a loaded CI box must not flake this.
+    # B=1 aggregate comfortably (measured ~2x on CPU).  The floor only
+    # guards against a batched step that silently advances one member
+    # (aggregate ratio ~0.5x, a hard arithmetic consequence) — wall-
+    # clock noise on the tiny smoke windows must not flake this: the
+    # old 0.9 floor flaked at 0.77x under load and 0.72 flaked at
+    # 0.714x on a degraded 2-core CI box, so the floor sits at 0.6,
+    # splitting the ~0.5x failure band from the observed >=0.71x
+    # noise band.
     assert (ens["B2"]["sim_days_per_sec"]
-            >= 0.9 * ens["B1"]["sim_days_per_sec"])
+            >= 0.6 * ens["B1"]["sim_days_per_sec"])
     assert ens["batched_exchange_plan"]["members"] == 2
+
+    # The io (async-pipeline) section ran all three modes and kept the
+    # carry finite; rates are smoke windows, so no overhead assertion.
+    io_sec = rec["io"]
+    assert "skipped" not in io_sec, io_sec
+    for mode in ("off", "sync", "async"):
+        assert io_sec[mode]["steps_per_sec"] > 0.0, mode
+    assert "host_wait_s_total" in io_sec["sync"]
+    assert "host_wait_s_total" in io_sec["async"]
+    assert isinstance(io_sec["async_overhead_smaller"], bool)
 
     # --telemetry writes a schema-valid obs-sink file alongside the
     # stdout JSON (round-8 satellite: bench rides the structured sink).

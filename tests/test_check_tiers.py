@@ -74,6 +74,27 @@ def test_registered_markers_parsed(name):
     assert name in allowed
 
 
+def test_async_pipeline_module_with_slow_marker_detected(tmp_path):
+    """Rule 4 (round-9 satellite): async-pipeline tests stay tier-1 —
+    a module importing jaxstream.io.async_pipeline must carry no slow
+    markers."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_ap.py").write_text(
+        "import pytest\n"
+        "from jaxstream.io.async_pipeline import BackgroundWriter\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module without the marker is clean.
+    (tests / "test_ap.py").write_text(
+        "from jaxstream.io import async_pipeline\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_obs_importing_module_with_slow_marker_detected(tmp_path):
     """Rule 3 (round-8 observability satellite): telemetry tests stay
     tier-1 — a module importing jaxstream.obs must carry no slow

@@ -65,6 +65,34 @@ def test_geometry_roundtrip(tmp_path):
     np.testing.assert_array_equal(back["xyz"], np.asarray(grid.xyz))
 
 
+def test_save_geometry_skips_matching_store(tmp_path):
+    """Round-9 satellite: a restart must not rewrite an unchanged
+    geometry store — and must still rewrite a mismatched one."""
+    from jaxstream.io.history import geometry_matches
+
+    grid = build_grid(6, halo=2, dtype=jnp.float32)
+    p = str(tmp_path / "geom")
+    save_geometry(p, grid)
+    assert geometry_matches(p, grid)
+    mtimes = {f: os.path.getmtime(os.path.join(p, "xyz", f))
+              for f in os.listdir(os.path.join(p, "xyz"))}
+    save_geometry(p, grid)                  # matching -> untouched
+    for f, m in mtimes.items():
+        assert os.path.getmtime(os.path.join(p, "xyz", f)) == m, f
+
+    # A different grid must NOT match and must rewrite.
+    grid8 = build_grid(8, halo=2, dtype=jnp.float32)
+    assert not geometry_matches(p, grid8)
+    save_geometry(p, grid8)
+    assert load_geometry_arrays(p)["__attrs__"]["n"] == 8
+    # A dtype change alone must also rewrite (attrs agree, arrays not).
+    grid8_64 = build_grid(8, halo=2, dtype=jnp.float64)
+    assert not geometry_matches(p, grid8_64)
+    # Garbage / absent paths simply don't match.
+    assert not geometry_matches(str(tmp_path / "nope"), grid)
+    save_geometry(p, grid8, skip_if_match=False)    # forced rewrite OK
+
+
 def test_checkpoint_roundtrip(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
     state = {
@@ -174,6 +202,100 @@ def test_history_field_layout_is_sticky(tmp_path):
     q = w2.read("q")  # record axis spans all 4 appends (0,1 are fill)
     assert q.dtype == np.float32 and q.shape[0] == 4
     assert np.max(np.abs(q[3] - 2 * h)) < 1e-3 * np.max(np.abs(h))
+
+
+def test_history_append_is_crash_safe(tmp_path):
+    """Round-9 satellite: a killed run cannot leave a torn frame.  The
+    time slab commits each frame LAST, so a partial frame (field slabs
+    written, time not) is invisible on reopen and overwritten by the
+    next append; and every chunk write is temp+os.replace atomic (no
+    half-written bytes, no stray temp files)."""
+    p = str(tmp_path / "hist")
+    w = HistoryWriter(p)
+    h1 = np.full((6, 4, 4), 1.0, np.float32)
+    h2 = np.full((6, 4, 4), 2.0, np.float32)
+    w.append({"h": h1}, 0.0)
+    w.append({"h": h2}, 600.0)
+
+    # Simulate a crash mid-append of frame 2: the field slab landed,
+    # the time slab did not (the commit ordering under test).
+    w.group["h"].write_index0(2, np.full((6, 4, 4), 99.0, np.float32))
+    assert w.group["h"].shape[0] == 3       # dangling tail on disk...
+
+    w2 = HistoryWriter(p)
+    assert len(w2) == 2                     # ...but not a record
+    assert w2.read("h").shape[0] == 2       # reads truncate to time axis
+    w2.append({"h": np.full((6, 4, 4), 3.0, np.float32)}, 1200.0)
+    h = w2.read("h")
+    assert h.shape[0] == 3
+    np.testing.assert_allclose(h[:, 0, 0, 0], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(w2.times, [0.0, 600.0, 1200.0])
+
+    # Atomicity hygiene: no temp-file debris anywhere in the store.
+    for dirpath, _, files in os.walk(p):
+        for f in files:
+            assert "__tmp__" not in f, os.path.join(dirpath, f)
+
+
+def test_history_reopen_before_first_append(tmp_path):
+    """A store created but killed before its first append has no time
+    array yet; a restart must reopen it as an EMPTY store (len 0) and
+    append normally — not die with KeyError('time') at construction."""
+    p = str(tmp_path / "hist")
+    HistoryWriter(p, attrs={"note": "created then killed"})
+    w = HistoryWriter(p)                    # reopen, no 'time' on disk
+    assert len(w) == 0
+    w.append({"h": np.full((6, 4, 4), 1.0, np.float32)}, 0.0)
+    assert len(w) == 1
+    np.testing.assert_allclose(w.times, [0.0])
+
+
+def test_zarr_write_index0_publishes_shape_last(tmp_path):
+    """The grown record axis (.zarray shape) must be published AFTER
+    the slab's chunk bytes land: a crash in between leaves an orphan
+    chunk past the published shape, never a published slab that reads
+    as fill values."""
+    from jaxstream.io import zarrlite
+
+    p = str(tmp_path / "hist")
+    w = HistoryWriter(p)
+    w.append({"h": np.full((6, 4, 4), 1.0, np.float32)}, 0.0)
+
+    arr = w.group["h"]
+    boom = RuntimeError("killed between chunk write and shape publish")
+
+    def no_publish(self, new_len):
+        raise boom
+
+    orig = zarrlite.ZarrArray.resize0
+    zarrlite.ZarrArray.resize0 = no_publish
+    try:
+        with pytest.raises(RuntimeError):
+            arr.write_index0(1, np.full((6, 4, 4), 2.0, np.float32))
+    finally:
+        zarrlite.ZarrArray.resize0 = orig
+
+    # The chunk bytes are on disk (orphan) but the shape never grew:
+    # a reopen sees exactly the committed record.
+    w2 = HistoryWriter(p)
+    assert w2.group["h"].shape[0] == 1
+    h = w2.read("h")
+    assert h.shape[0] == 1
+    np.testing.assert_allclose(h[0, 0, 0, 0], 1.0)
+
+
+def test_zarr_atomic_write_replaces_not_appends(tmp_path):
+    """_atomic_write_bytes: the destination flips atomically between
+    complete contents (same bytes as a plain write) and failed temp
+    files are cleaned up."""
+    from jaxstream.io.zarrlite import _atomic_write_bytes
+
+    p = str(tmp_path / "x.bin")
+    _atomic_write_bytes(p, b"aaaa")
+    assert open(p, "rb").read() == b"aaaa"
+    _atomic_write_bytes(p, b"bb")
+    assert open(p, "rb").read() == b"bb"    # replaced, not appended
+    assert os.listdir(str(tmp_path)) == ["x.bin"]
 
 
 def test_history_tt_preserves_dtype(tmp_path):
