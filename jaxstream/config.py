@@ -26,6 +26,7 @@ __all__ = [
     "IOConfig",
     "EnsembleConfig",
     "ObservabilityConfig",
+    "PrecisionConfig",
     "Config",
     "load_config",
 ]
@@ -114,6 +115,13 @@ class ModelConfig:
     # and 'aca' (cross approximation — the speed tier, no
     # factorization kernels in the step) for advection/diffusion.
     tt_rounding: str = "auto"        # 'auto' | 'aca' | 'svd'
+    # del^4 filter placement on the fused covariant path (nu4 > 0):
+    # 'split' (the round-5 once-per-step filter kernel — the reference),
+    # 'refused' (round 10: filter fused into the stage-1 kernel, 3
+    # kernels + 3 routes/step; trajectories equal to split up to one
+    # endpoint filter application, Galewsky day-6 gated), or 'stage'
+    # (the round-4 in-stage kernel pair, kept as the parity oracle).
+    nu4_mode: str = "split"          # 'split' | 'refused' | 'stage'
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,6 +201,30 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    """Per-stage dtype policy for the fused covariant stepper (round
+    10; ``jaxstream.ops.pallas.precision`` holds the op-level
+    semantics).  Defaults are all-f32 = bit-for-bit today's behavior.
+
+    ``stage: bf16`` runs the stage kernels' flux face-average
+    velocities, the PLR limiter algebra, and the strip router's
+    rotation multiplies in bfloat16 — every accumulator and every
+    metric term stays f32.  ``strips`` sets the inter-stage
+    strip/ghost storage dtype ('auto' follows ``stage``); 16-bit
+    strips halve strip HBM/wire traffic and keep exact mass
+    conservation (one shared symmetrized edge value per physical
+    edge).  ``carry`` selects the between-step HBM storage encoding —
+    'bf16' (h and u bf16) or 'mixed16' (h int16 fixed-point about a
+    static offset + u bf16, the bench's gated encoding); orthogonal to
+    ``stage`` (arithmetic vs storage), the two stack.  See
+    docs/USAGE.md "Precision" for measured budgets and the
+    when-it-loses caveats."""
+    stage: str = "f32"        # 'f32' | 'bf16' stage-kernel arithmetic
+    strips: str = "auto"      # 'auto' | 'f32' | 'bf16' strip storage
+    carry: str = "f32"        # 'f32' | 'bf16' | 'mixed16' carry storage
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grid: GridConfig = GridConfig()
     parallelization: ParallelConfig = ParallelConfig()
@@ -202,6 +234,7 @@ class Config:
     io: IOConfig = IOConfig()
     ensemble: EnsembleConfig = EnsembleConfig()
     observability: ObservabilityConfig = ObservabilityConfig()
+    precision: PrecisionConfig = PrecisionConfig()
 
 
 _SECTIONS = {
@@ -213,6 +246,7 @@ _SECTIONS = {
     "io": IOConfig,
     "ensemble": EnsembleConfig,
     "observability": ObservabilityConfig,
+    "precision": PrecisionConfig,
 }
 
 

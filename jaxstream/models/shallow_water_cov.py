@@ -212,7 +212,8 @@ class CovariantShallowWater(SWEBase):
                         nu4_mode: str = "split",
                         temporal_block: int = 1,
                         ensemble: int = 0,
-                        ensemble_impl: str = "kernel"):
+                        ensemble_impl: str = "kernel",
+                        precision=None):
         """Fused SSPRK3: one Pallas kernel per stage (halo fill in-kernel,
         edge rotations/symmetrization on a packed strip carry,
         :mod:`jaxstream.ops.pallas.swe_cov`).  ``compact=True`` (the
@@ -249,12 +250,34 @@ class CovariantShallowWater(SWEBase):
         (B per-member kernel launches, bitwise the same values) kept as
         the parity oracle and the portability fallback.  Compact carry
         and nu4 = 0 only.
+
+        ``precision`` (round 10, ``jaxstream.ops.pallas.precision``):
+        the per-stage dtype policy — ``'bf16'`` runs the
+        flux/reconstruction/router arithmetic in bfloat16 with f32
+        accumulators and metric terms and stores the inter-stage strips
+        bf16; ``None``/``'f32'`` is bitwise today's path.  Composes
+        with ``temporal_block``, ``ensemble``, the carry encodings
+        (``carry_dtype`` — storage — is orthogonal to ``precision`` —
+        arithmetic — and the two stack), and the split/refused nu4
+        modes; the ``'stage'`` nu4 oracle and the extended
+        (``compact=False``) carry reject 16-bit strips with pointers.
+
+        ``nu4_mode='refused'`` (round 10): the del^4 filter fused into
+        the stage-1 kernel — 3 kernels + 3 routes per step vs the
+        split form's 4 + 4, trajectories equal to split up to one
+        filter application at the endpoints (O(damp); Galewsky day-6
+        physics is the equivalence gate, same standard as
+        split-vs-stage).  Composes with ``temporal_block`` and
+        ``precision``; filter-cycling (``interval``) stays on 'split'.
         """
+        from ..ops.pallas.precision import resolve_stage_precision
+
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
-        if nu4_mode not in ("split", "stage"):
-            raise ValueError(f"nu4_mode must be 'split' or 'stage', "
-                             f"got {nu4_mode!r}")
+        if nu4_mode not in ("split", "stage", "refused"):
+            raise ValueError(f"nu4_mode must be 'split', 'stage' or "
+                             f"'refused', got {nu4_mode!r}")
+        precision = resolve_stage_precision(precision)
         if temporal_block < 1:
             raise ValueError(
                 f"temporal_block must be >= 1, got {temporal_block}")
@@ -292,12 +315,29 @@ class CovariantShallowWater(SWEBase):
                 raise ValueError("carry_dtype/h_offset/u_scale/"
                                  "_ablate_seam are not supported on the "
                                  "nu4 paths")
+            if nu4_mode == "stage" and precision is not None:
+                raise ValueError(
+                    "nu4_mode='stage' is the f32 parity oracle and "
+                    "takes no precision policy; use nu4_mode='split' "
+                    "or 'refused'")
             from ..ops.pallas.swe_cov import (
-                make_fused_ssprk3_cov_nu4, make_fused_ssprk3_cov_split_nu4)
+                make_fused_ssprk3_cov_nu4,
+                make_fused_ssprk3_cov_refused_nu4,
+                make_fused_ssprk3_cov_split_nu4)
 
-            mk = (make_fused_ssprk3_cov_split_nu4 if nu4_mode == "split"
-                  else make_fused_ssprk3_cov_nu4)
-            return _blocked(mk(
+            if nu4_mode == "refused":
+                return _blocked(make_fused_ssprk3_cov_refused_nu4(
+                    self.grid, self.gravity, self.omega, dt, self.b_ext,
+                    self.nu4, scheme=self.scheme, limiter=self.limiter,
+                    interpret=interpret, precision=precision,
+                ))
+            if nu4_mode == "split":
+                return _blocked(make_fused_ssprk3_cov_split_nu4(
+                    self.grid, self.gravity, self.omega, dt, self.b_ext,
+                    self.nu4, scheme=self.scheme, limiter=self.limiter,
+                    interpret=interpret, precision=precision,
+                ))
+            return _blocked(make_fused_ssprk3_cov_nu4(
                 self.grid, self.gravity, self.omega, dt, self.b_ext,
                 self.nu4, scheme=self.scheme, limiter=self.limiter,
                 interpret=interpret,
@@ -318,6 +358,7 @@ class CovariantShallowWater(SWEBase):
                              else carry_dtype),
                 h_offset=h_offset, h_scale=h_scale, u_scale=u_scale,
                 seam=not _ablate_seam, ensemble=kernel_ensemble,
+                precision=precision,
             )
             if ensemble and ensemble_impl == "vmap":
                 from ..stepping import vmap_ensemble
@@ -334,7 +375,7 @@ class CovariantShallowWater(SWEBase):
         return _blocked(make_fused_ssprk3_cov_inkernel(
             self.grid, self.gravity, self.omega, dt, self.b_ext,
             scheme=self.scheme, limiter=self.limiter,
-            interpret=interpret,
+            interpret=interpret, precision=precision,
         ))
 
     def initial_state(self, h_ext, v_ext) -> State:

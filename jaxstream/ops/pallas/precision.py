@@ -1,0 +1,180 @@
+"""Per-stage precision policy for the fused covariant stage kernels.
+
+Round 10 (ROADMAP open item 3).  ``mixed16`` previously existed only as
+a *carry* encoding between steps (``carry_dtype`` on the compact
+stepper: 16-bit HBM storage, every arithmetic op still f32).  Bench r05
+showed the fused TC5 C384 path compute-bound at ~48% of the VPU roof —
+the remaining headroom is in the stage arithmetic itself, and the SWE
+accuracy budget tolerates reduced-precision arithmetic in exactly the
+flop-dominant places (Danis et al. 2024, PAPERS.md; the Putman & Lin
+2007 flux/reconstruction stages).  This module is the one definition of
+*which* ops drop to bfloat16 and which must not:
+
+``compute='bf16'`` — the stage kernels' **flux face-average
+velocities**, the **PLR limiter algebra** (the slope min/max chain,
+about half of the reconstruction's VPU ops), and the strip **router's
+rotation multiplies** run in bfloat16.  Everything else keeps f32:
+
+  * **accumulators** — upwind flux products, divergences, Bernoulli /
+    vorticity gradients, and the RK combines all accumulate in f32 (a
+    bf16 value entering an f32 op promotes; the quantization lands on
+    the *operand*, never the running sum);
+  * **metric terms** — the closed-form ``_fast_frame`` fields stay f32
+    (they multiply into f32 accumulators, and metric roundoff is a
+    systematic, not statistical, error source);
+  * **reconstruction base values** — face states are assembled as
+    ``f32 cell value +- f32(bf16 half-slope)``: the bf16 quantization is
+    O(2^-9) *of the local slope* (a correction term), never of the cell
+    value — truncation-class by construction, no anomaly offset needed.
+
+``strips='bf16'`` — the inter-stage boundary-strip/ghost tensors (and
+hence the wire payload wherever strips ride a collective) are stored
+bfloat16; the kernels widen them to f32 on the in-VMEM ghost fill.
+Panel-seam conservation survives 16-bit strips unchanged: the router
+computes ONE symmetrized edge-normal value per physical edge and
+distributes the *identical* (rounded-once) row to both faces, so
+cross-seam flux equality — hence exact mass conservation — is preserved
+at any strips dtype (see ``sym_edge_normals``).
+
+The policy is intentionally NOT a blanket cast: vorticity and Bernoulli
+gradients difference nearly-equal large values (catastrophic in bf16's
+8-bit mantissa), and h itself is ~5e3 m where a direct bf16 cast is a
+~16 m quantum.  Measured budgets for what IS cast live in
+tests/test_precision.py and DESIGN.md "Precision ladder".
+
+``precision=None`` everywhere means OFF, and off is *bitwise* the
+historical f32 path (tested) — the policy threads through the existing
+stage factories rather than forking new ones, so it composes with
+temporal blocking, ensembles, donation, and the carry encodings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+__all__ = ["StagePrecision", "resolve_stage_precision", "encode_strips",
+           "strip_dtype_bytes", "mixed16_encoding"]
+
+_COMPUTE = ("f32", "bf16")
+_STRIPS = ("f32", "bf16")
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePrecision:
+    """Resolved per-stage dtype policy (see module docstring).
+
+    ``compute``: 'f32' | 'bf16' — flux/reconstruction/router arithmetic.
+    ``strips``:  'f32' | 'bf16' — inter-stage strip/ghost storage (the
+    exchange payload on sharded tiers).
+    """
+
+    compute: str = "f32"
+    strips: str = "f32"
+
+    def __post_init__(self):
+        if self.compute not in _COMPUTE:
+            raise ValueError(
+                f"StagePrecision.compute must be one of {_COMPUTE}, "
+                f"got {self.compute!r}")
+        if self.strips not in _STRIPS:
+            raise ValueError(
+                f"StagePrecision.strips must be one of {_STRIPS}, "
+                f"got {self.strips!r}")
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.compute == "bf16" else jnp.float32
+
+    @property
+    def strips_dtype(self):
+        return jnp.bfloat16 if self.strips == "bf16" else jnp.float32
+
+    @property
+    def is_off(self) -> bool:
+        return self.compute == "f32" and self.strips == "f32"
+
+
+def resolve_stage_precision(precision) -> StagePrecision | None:
+    """Normalize a user-facing precision spec to a policy (or None = off).
+
+    Accepts ``None`` / ``'f32'`` (off), ``'bf16'`` (bf16 compute + bf16
+    strips — the production ladder rung), a :class:`StagePrecision`, or
+    a ``{'stage'|'compute': ..., 'strips': ...}`` mapping (the config
+    block's shape; ``strips='auto'`` follows the compute policy).
+    Returns ``None`` when the resolved policy is entirely f32, so every
+    factory's ``precision is None`` fast path — the bitwise historical
+    trace — is taken whenever the policy is off.
+    """
+    if precision is None:
+        return None
+    if isinstance(precision, StagePrecision):
+        return None if precision.is_off else precision
+    if isinstance(precision, str):
+        name = precision.lower()
+        if name in ("f32", "off", "none", ""):
+            return None
+        if name == "bf16":
+            return StagePrecision(compute="bf16", strips="bf16")
+        raise ValueError(
+            f"unknown precision policy {precision!r}; valid: 'f32', "
+            "'bf16', a StagePrecision, or a {'stage','strips'} mapping")
+    if isinstance(precision, dict):
+        unknown = set(precision) - {"stage", "compute", "strips"}
+        if unknown:
+            # A misspelled key must not silently resolve to the f32
+            # default — an experiment would then report f32 rates and
+            # budgets labeled as its intended policy.
+            raise ValueError(
+                f"unknown precision keys {sorted(unknown)}; valid: "
+                "'stage' (or 'compute') and 'strips'")
+        compute = precision.get("stage", precision.get("compute", "f32"))
+        strips = precision.get("strips", "auto")
+        if strips == "auto":
+            strips = compute
+        return resolve_stage_precision(
+            StagePrecision(compute=compute, strips=strips))
+    raise TypeError(
+        f"precision must be None/str/dict/StagePrecision, "
+        f"got {type(precision).__name__}")
+
+
+def encode_strips(y, precision):
+    """Narrow a compact carry's strip tensors to the policy's strips
+    dtype (identity when the policy keeps f32 strips, or for carries
+    without strips).
+
+    The stage kernels EMIT strips in the strips dtype, so a jitted
+    integration loop (``fori_loop``/``scan``, whose carry type must be
+    stable across iterations) needs the INITIAL carry's strips in that
+    dtype too — ``compact_state``/``ensemble_compact_state`` build them
+    f32.  h/u are untouched: the carry encodings
+    (:meth:`CovariantShallowWater.encode_carry`) are the separate,
+    orthogonal storage hook.
+    """
+    pol = resolve_stage_precision(precision)
+    if pol is None or pol.strips != "bf16":
+        return y
+    sdt = pol.strips_dtype
+    return {k: (v.astype(sdt) if k in ("strips_sn", "strips_we") else v)
+            for k, v in y.items()}
+
+
+def strip_dtype_bytes(precision) -> int:
+    """Bytes per strip element under a policy (4 = f32, 2 = bf16) — the
+    comm_probe/bench wire-byte accounting hook."""
+    pol = resolve_stage_precision(precision)
+    return 2 if (pol is not None and pol.strips == "bf16") else 4
+
+
+def mixed16_encoding(h):
+    """The bench-gated mixed16 carry triple for an initial h field:
+    ``(carry_dtype, h_offset, h_scale)`` = h int16 fixed-point in
+    1/16 m quanta about the field's mid-range + u bf16 (round 5,
+    DESIGN.md carry ladder; mass held at the default 1e-3 band).  ONE
+    definition shared by bench_tc5's gated variant,
+    ``bench_precision_report`` and ``Simulation._resolve_precision`` —
+    a retune here is a retune of what the bench gates certify."""
+    off = float(0.5 * (float(jnp.min(h)) + float(jnp.max(h))))
+    return (jnp.int16, jnp.bfloat16), off, 0.0625

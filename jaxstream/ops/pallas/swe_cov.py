@@ -40,11 +40,13 @@ from ...geometry.connectivity import (
     edge_pairs,
 )
 from ...geometry.cubed_sphere import FACE_AXES
+from .precision import StagePrecision, resolve_stage_precision
 from .swe_rhs import _fast_frame, coord_rows, pick_recon
 
 __all__ = [
     "sym_edge_normals",
     "rhs_core_cov",
+    "pick_recon_precision",
     "make_cov_rhs_pallas",
     "make_cov_rhs_interior_local",
     "make_cov_rhs_band_local",
@@ -63,7 +65,48 @@ __all__ = [
     "make_fused_ssprk3_cov_nu4",
     "make_cov_nu4_filter",
     "make_fused_ssprk3_cov_split_nu4",
+    "make_fused_ssprk3_cov_refused_nu4",
 ]
+
+
+def pick_recon_precision(scheme: str, halo: int, n: int, limiter: str,
+                         precision: StagePrecision | None = None):
+    """Reconstruction for the stage kernels under a precision policy.
+
+    Policy off: plain :func:`pick_recon` — the bitwise historical path.
+    ``compute='bf16'`` + PLR: cell differences are formed in f32, the
+    limiter algebra (the slope-candidate min/max chain — most of the
+    reconstruction's VPU ops, and on TPU a 2x-wide lane mix in bf16)
+    runs in bfloat16, and the face state is assembled as ``f32 cell
+    value +- f32(bf16 half-slope)``.  Quantization lands on the *slope*
+    — the O(dx) correction term — never on the cell value, so the
+    face-state error is O(2^-9) of the local gradient: truncation-class
+    with no anomaly offset (a direct bf16 cast of h ~ 5e3 m would be a
+    ~16 m quantum; this form is ~4e-2 m per m/cell of slope).  Measured
+    budgets: tests/test_precision.py.
+
+    PPM + a bf16 compute policy is REJECTED, not half-run: the policy's
+    op split (and the roofline's ``bf16_flop_fraction``) is defined on
+    the PLR limiter algebra — silently running f32 reconstruction under
+    a 'bf16' label would publish wrong mixed-roof accounting.
+    """
+    if precision is None or precision.compute != "bf16":
+        return pick_recon(scheme, halo, n, limiter)
+    if scheme == "ppm":
+        raise ValueError(
+            "the bf16 stage policy is defined for the PLR "
+            "reconstruction (its op split and mixed-roof accounting "
+            "assume the limiter algebra); PPM has no bf16 form — drop "
+            "the precision policy or use scheme='plr'")
+    import functools
+
+    from ...ops.reconstruct import plr_face_states
+
+    # ONE definition of PLR (ops/reconstruct.py) — the policy only
+    # selects the slope dtype, so limiter/stencil fixes propagate to
+    # both paths.
+    return functools.partial(plr_face_states, h=halo, n=n,
+                             limiter=limiter, slope_dtype=jnp.bfloat16)
 
 _OUT_SIGN = {EDGE_S: -1.0, EDGE_W: -1.0, EDGE_N: 1.0, EDGE_E: 1.0}
 
@@ -221,7 +264,7 @@ def sym_edge_normals(grid, u_ext):
 def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
                  n, halo, d, radius, gravity, omega, recon,
                  seam_scratch=None, sym_prescaled=False,
-                 seam_edges=(True, True, True, True)):
+                 seam_edges=(True, True, True, True), precision=None):
     """One face's covariant-SWE right-hand side as traceable kernel math.
 
     ``fz = (c0z, cxz, cyz)`` are the face frame's z-components (scalars,
@@ -253,6 +296,17 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     inv2d = jnp.float32(1.0 / (2.0 * d))
     g = jnp.float32(gravity)
     two_omega = jnp.float32(2.0 * omega)
+    # Precision policy (see ops/pallas/precision.py): `lo` casts the
+    # flux face-average VELOCITY operands to bf16 — the policy's "flux
+    # arithmetic" half (the reconstruction half rides `recon`, built by
+    # pick_recon_precision).  A bf16 value multiplied into the f32
+    # metric promotes back to f32, so every accumulator (flux products,
+    # divergence, gradients, RK combine) stays f32; with the policy off
+    # `lo` is identity and the trace is bitwise the historical one.
+    if precision is not None and precision.compute == "bf16":
+        lo = lambda x: x.astype(jnp.bfloat16)
+    else:
+        lo = lambda x: x
 
     # ---- continuity ------------------------------------------------------
     # Flux-form velocities U = sqrtg u^perp directly via the folded metric
@@ -263,8 +317,8 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     # sqrtg is even in the along-edge coordinate), so cross-seam flux
     # equality — hence exact mass conservation — is preserved.
     Fx = _fast_frame(xfr[:, h0x:h1x + 1], yc[h0y:h1y], radius)
-    uba = 0.5 * (ua[h0y:h1y, h0x - 1:h1x] + ua[h0y:h1y, h0x:h1x + 1])
-    ubb = 0.5 * (ub[h0y:h1y, h0x - 1:h1x] + ub[h0y:h1y, h0x:h1x + 1])
+    uba = 0.5 * (lo(ua[h0y:h1y, h0x - 1:h1x]) + lo(ua[h0y:h1y, h0x:h1x + 1]))
+    ubb = 0.5 * (lo(ub[h0y:h1y, h0x - 1:h1x]) + lo(ub[h0y:h1y, h0x:h1x + 1]))
     ux = Fx["fg_aa"] * uba + Fx["fg_ab"] * ubb      # sqrtg u^a, (ny, nx+1)
     if sym_we is not None and (eW or eE):
         # Seam imposition: replace the two boundary flux-velocity
@@ -303,8 +357,8 @@ def rhs_core_cov(fz, xr, xfr, yc, yfc, hf, ua, ub, bf, sym_sn, sym_we, *,
     fx = jnp.maximum(ux, 0.0) * qL + jnp.minimum(ux, 0.0) * qR
 
     Fy = _fast_frame(xr[:, h0x:h1x], yfc[h0y:h1y + 1], radius)
-    vba = 0.5 * (ua[h0y - 1:h1y, h0x:h1x] + ua[h0y:h1y + 1, h0x:h1x])
-    vbb = 0.5 * (ub[h0y - 1:h1y, h0x:h1x] + ub[h0y:h1y + 1, h0x:h1x])
+    vba = 0.5 * (lo(ua[h0y - 1:h1y, h0x:h1x]) + lo(ua[h0y:h1y + 1, h0x:h1x]))
+    vbb = 0.5 * (lo(ub[h0y - 1:h1y, h0x:h1x]) + lo(ub[h0y:h1y + 1, h0x:h1x]))
     uy = Fy["fg_ab"] * vba + Fy["fg_bb"] * vbb      # sqrtg u^b, (ny+1, nx)
     if sym_sn is not None and (eS or eN):
         if sym_prescaled:
@@ -1062,6 +1116,7 @@ def make_cov_stage_inkernel(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    precision=None,
 ):
     """One fused covariant RK stage with the halo fill inside the kernel.
 
@@ -1072,6 +1127,10 @@ def make_cov_stage_inkernel(
     strips)`` — the combined state plus its packed boundary strips
     (:func:`pack_strips_cov` layout).  Ghost corners stay stale (never
     read by the dimension-split stencils).
+
+    ``precision``: compute half of the stage policy only (bf16
+    flux/recon arithmetic); this legacy extended-carry layout keeps its
+    packed strips f32 — 16-bit strip storage lives on the compact path.
     """
     import numpy as np
 
@@ -1079,7 +1138,13 @@ def make_cov_stage_inkernel(
     i0, i1 = halo, halo + n
     d = float(dalpha)
     g_dt = b * dt
-    recon = pick_recon(scheme, halo, n, limiter)
+    precision = resolve_stage_precision(precision)
+    if precision is not None and precision.strips == "bf16":
+        raise ValueError(
+            "the extended-carry (in-kernel exchange) stepper keeps f32 "
+            "strips; 16-bit strip storage needs the compact carry "
+            "(make_cov_stage_compact / make_fused_ssprk3_cov_compact)")
+    recon = pick_recon_precision(scheme, halo, n, limiter, precision)
     x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
     frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
     with_y0 = a != 0.0
@@ -1122,6 +1187,7 @@ def make_cov_stage_inkernel(
             hf, ua, ub, b_ref[0], ssn, swe,
             n=n, halo=halo, d=d, radius=radius,
             gravity=gravity, omega=omega, recon=recon,
+            precision=precision,
         )
 
         fa = jnp.float32(a)
@@ -1214,6 +1280,7 @@ def make_fused_ssprk3_cov_inkernel(
     scheme: str = "plr",
     limiter: str = "mc",
     interpret: bool = False,
+    precision=None,
 ):
     """``step(y, t) -> y`` over ``y = {h, u, strips}``.
 
@@ -1221,6 +1288,8 @@ def make_fused_ssprk3_cov_inkernel(
     three strip-routing shuffles (rotations + symmetrized edge normals on
     one packed strip tensor).  Initialise the carry with
     :meth:`CovariantShallowWater.extend_state(state, with_strips=True)`.
+    ``precision``: compute-half policy only (see
+    :func:`make_cov_stage_inkernel`).
     """
     from .swe_step import SSPRK3_COEFFS
 
@@ -1229,6 +1298,7 @@ def make_fused_ssprk3_cov_inkernel(
     mk = lambda a, b: make_cov_stage_inkernel(
         n, halo, float(grid.dalpha), float(grid.radius), gravity, omega,
         dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
+        precision=precision,
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)
@@ -1299,7 +1369,8 @@ def pack_strips_cov_split(h_int, u_int, n: int, halo: int):
     return sn, we
 
 
-def make_cov_strip_router_split(grid, prescale_sym: bool = False):
+def make_cov_strip_router_split(grid, prescale_sym: bool = False,
+                                precision: StagePrecision | None = None):
     """Linear router over the split-orientation strip layout.
 
     ``route(strips_sn, strips_we) -> (ghosts_sn, ghosts_we)`` with
@@ -1314,6 +1385,19 @@ def make_cov_strip_router_split(grid, prescale_sym: bool = False):
     here (vectorized over faces) so the stage kernel imposes them
     directly — the in-kernel (n, 1)-shaped sqrtg evals were measured at
     several us/stage of VPU time (``rhs_core_cov`` ``sym_prescaled``).
+
+    ``precision`` (ops/pallas/precision.py): with ``compute='bf16'``
+    AND ``strips='bf16'`` the 2x2 rotation multiply-adds — the router's
+    arithmetic — run in bfloat16 (tables cast once at build; against
+    f32 strip operands they would promote to f32 and only round the
+    coefficients, so the cast is gated on both knobs); with
+    ``strips='bf16'`` inputs are taken (and ghost/sym outputs emitted)
+    in bfloat16, halving the strip HBM/wire traffic.  The symmetrized edge normals are computed
+    in f32 from the (widened) strip rows and rounded ONCE per physical
+    edge before distribution, so both faces receive the identical
+    16-bit value — cross-seam flux equality, hence exact mass
+    conservation, is dtype-independent.  Policy off = the bitwise
+    historical route (identity casts).
     """
     import numpy as np
 
@@ -1364,6 +1448,17 @@ def make_cov_strip_router_split(grid, prescale_sym: bool = False):
         [Tc[:, :, EDGE_S, ::-1], Tc[:, :, EDGE_N]], axis=2))
     T_we = jnp.asarray(np.stack(
         [Tc[:, :, EDGE_W, ::-1], Tc[:, :, EDGE_E]], axis=2))
+    pol = precision
+    sdt = jnp.float32 if pol is None else pol.strips_dtype
+    if (pol is not None and pol.compute == "bf16"
+            and pol.strips == "bf16"):
+        # bf16 rotation algebra: tables cast once at build, products and
+        # adds ride the 2x-wide bf16 lanes.  Gated on 16-bit strips as
+        # well as compute: against f32 strip operands the multiplies
+        # would promote to f32 anyway (no lane packing), so bf16 tables
+        # would round the rotation coefficients for zero benefit.
+        T_sn = T_sn.astype(jnp.bfloat16)
+        T_we = T_we.astype(jnp.bfloat16)
 
     sym_tables = _pair_sym_tables(grid)
     adj_k = [h - 1, 0]          # placed edge-adjacent row: S/W flip, N/E not
@@ -1384,15 +1479,22 @@ def make_cov_strip_router_split(grid, prescale_sym: bool = False):
                                sgW.reshape(n), sgE.reshape(n)])[None]
 
     def route(strips_sn, strips_we):
+        # The input casts absorb an f32 initial carry under a 16-bit
+        # strips policy (and are no-ops thereafter — the stage kernels
+        # emit strips in sdt); every cast below is identity with the
+        # policy off, keeping that path bitwise the historical route.
         s_src = jnp.concatenate(
-            [strips_sn.reshape(6 * 6 * h, n),
-             jnp.transpose(strips_we, (0, 2, 1)).reshape(6 * 6 * h, n)],
+            [strips_sn.astype(sdt).reshape(6 * 6 * h, n),
+             jnp.transpose(strips_we.astype(sdt),
+                           (0, 2, 1)).reshape(6 * 6 * h, n)],
             axis=0)
         s_all = jnp.concatenate([s_src, jnp.flip(s_src, -1)], axis=0)
         rows = jnp.take(s_all, idx_all, axis=0)
         C_sn = rows[:n_sn].reshape(3, 6, 2, h, n)
         C_we = rows[n_sn : n_sn + n_we].reshape(3, 6, 2, h, n)
-        I_u = rows[n_sn + n_we :].reshape(2, 6, 4, n)
+        # Sym inputs widen to f32: the pair-symmetrization algebra is
+        # the conservation-critical path and stays full precision.
+        I_u = rows[n_sn + n_we :].reshape(2, 6, 4, n).astype(jnp.float32)
 
         G_sn = [C_sn[0],
                 T_sn[0] * C_sn[1] + T_sn[1] * C_sn[2],
@@ -1403,19 +1505,27 @@ def make_cov_strip_router_split(grid, prescale_sym: bool = False):
 
         gadj_a = jnp.stack(
             [G_sn[1][:, 0, adj_k[0]], G_sn[1][:, 1, adj_k[1]],
-             G_we[1][:, 0, adj_k[0]], G_we[1][:, 1, adj_k[1]]], axis=1)
+             G_we[1][:, 0, adj_k[0]], G_we[1][:, 1, adj_k[1]]],
+            axis=1).astype(jnp.float32)
         gadj_b = jnp.stack(
             [G_sn[2][:, 0, adj_k[0]], G_sn[2][:, 1, adj_k[1]],
-             G_we[2][:, 0, adj_k[0]], G_we[2][:, 1, adj_k[1]]], axis=1)
+             G_we[2][:, 0, adj_k[0]], G_we[2][:, 1, adj_k[1]]],
+            axis=1).astype(jnp.float32)
         sym = _pair_symmetrize(I_u, gadj_a, gadj_b, sym_tables)
         if sym_scale is not None:
             sym = sym * sym_scale
+        # Rounded ONCE per physical edge, then distributed — both faces
+        # get the identical sdt value, so seam conservation is exact at
+        # any strips dtype.
+        sym = sym.astype(sdt)
 
         gsn = jnp.concatenate(
-            [jnp.concatenate([g.reshape(6, 2 * h, n) for g in G_sn], axis=1),
+            [jnp.concatenate([g.reshape(6, 2 * h, n).astype(sdt)
+                              for g in G_sn], axis=1),
              sym[:, 0:2]], axis=1)
         gwe_rows = jnp.concatenate(
-            [jnp.concatenate([g.reshape(6, 2 * h, n) for g in G_we], axis=1),
+            [jnp.concatenate([g.reshape(6, 2 * h, n).astype(sdt)
+                              for g in G_we], axis=1),
              sym[:, 2:4]], axis=1)
         return gsn, jnp.transpose(gwe_rows, (0, 2, 1))
 
@@ -1465,7 +1575,8 @@ def _cov_blockspecs(n, halo, groups: int = 6):
 
 
 def _make_fill(n, halo, i0, i1, corners: bool = False,
-               interior: bool = True, base=(0, 0)):
+               interior: bool = True, base=(0, 0),
+               precision: StagePrecision | None = None):
     """Shared in-kernel ghost fill / strip emit over the split layout.
 
     ``interior=False`` skips the interior store (the manual-DMA stage
@@ -1474,22 +1585,33 @@ def _make_fill(n, halo, i0, i1, corners: bool = False,
     extended window inside a larger scratch — the manual-DMA layout
     puts the interior at a (8, 128)-tile-aligned offset because Mosaic
     only accepts tile-aligned DMA destination windows, which parks the
-    extended window's top-left at ``(8 - halo, 128 - halo)``."""
+    extended window's top-left at ``(8 - halo, 128 - halo)``.
+
+    ``precision``: under a 16-bit strips policy the routed ghost blocks
+    arrive in bfloat16 and are widened to f32 on the scratch store (the
+    extended frame the stencils read is always f32), and the emitted
+    boundary strips are narrowed to the strips dtype on the way out —
+    the two casts that bound the 16-bit region to strip storage."""
     h = halo
     by, bx = base
     m = n + 2 * h
+    if precision is not None and precision.strips == "bf16":
+        gc = lambda x: x.astype(jnp.float32)
+        sc = lambda x: x.astype(jnp.bfloat16)
+    else:
+        gc = sc = lambda x: x
 
     def fill_ghosts(scratch, int_val, gsn, gwe, fi):
         if interior:
             scratch[by + i0 : by + i1, bx + i0 : bx + i1] = int_val
         scratch[by : by + h, bx + i0 : bx + i1] = \
-            gsn[fi * 2 * h : fi * 2 * h + h]
+            gc(gsn[fi * 2 * h : fi * 2 * h + h])
         scratch[by + i1 : by + i1 + h, bx + i0 : bx + i1] = \
-            gsn[fi * 2 * h + h : (fi + 1) * 2 * h]
+            gc(gsn[fi * 2 * h + h : (fi + 1) * 2 * h])
         scratch[by + i0 : by + i1, bx : bx + h] = \
-            gwe[:, fi * 2 * h : fi * 2 * h + h]
+            gc(gwe[:, fi * 2 * h : fi * 2 * h + h])
         scratch[by + i0 : by + i1, bx + i1 : bx + i1 + h] = \
-            gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h]
+            gc(gwe[:, fi * 2 * h + h : (fi + 1) * 2 * h])
         if corners:
             # The Laplacian's cross-derivative faces read the h x h ghost
             # corners (unlike the dimension-split advective stencils).
@@ -1518,10 +1640,12 @@ def _make_fill(n, halo, i0, i1, corners: bool = False,
         return scratch
 
     def emit_strips(ssn_ref, swe_ref, int_new, fi):
-        ssn_ref[0, fi * 2 * h : fi * 2 * h + h] = int_new[0:h, :]
-        ssn_ref[0, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[n - h : n, :]
-        swe_ref[0, :, fi * 2 * h : fi * 2 * h + h] = int_new[:, 0:h]
-        swe_ref[0, :, fi * 2 * h + h : (fi + 1) * 2 * h] = int_new[:, n - h : n]
+        ssn_ref[0, fi * 2 * h : fi * 2 * h + h] = sc(int_new[0:h, :])
+        ssn_ref[0, fi * 2 * h + h : (fi + 1) * 2 * h] = \
+            sc(int_new[n - h : n, :])
+        swe_ref[0, :, fi * 2 * h : fi * 2 * h + h] = sc(int_new[:, 0:h])
+        swe_ref[0, :, fi * 2 * h + h : (fi + 1) * 2 * h] = \
+            sc(int_new[:, n - h : n])
 
     return fill_ghosts, emit_strips
 
@@ -1583,6 +1707,7 @@ def make_cov_stage_compact(
     sym_prescaled: bool = False,
     manual_dma: bool | None = None,
     groups: int = 6,
+    precision: StagePrecision | None = None,
 ):
     """One fused covariant RK stage over interior-only state.
 
@@ -1639,7 +1764,14 @@ def make_cov_stage_compact(
     i0, i1 = halo, halo + n
     d = float(dalpha)
     g_dt = b * dt
-    recon = pick_recon(scheme, halo, n, limiter)
+    precision = resolve_stage_precision(precision)
+    sdt = jnp.float32 if precision is None else precision.strips_dtype
+    # Widen sym rows to f32 at extraction under a 16-bit strips policy
+    # (the seam imposition stores into f32 seam scratch / iota-selects
+    # against the f32 flux tensor); identity with the policy off.
+    wide = ((lambda x: x.astype(jnp.float32))
+            if sdt != jnp.float32 else (lambda x: x))
+    recon = pick_recon_precision(scheme, halo, n, limiter, precision)
     x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
     frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
     with_y0 = a != 0.0
@@ -1671,6 +1803,10 @@ def make_cov_stage_compact(
     elif manual_dma and not plain_f32:
         raise ValueError("manual_dma needs a plain f32 carry (the DMA "
                          "engine cannot widen or rescale)")
+    if manual_dma and precision is not None:
+        raise ValueError("manual_dma needs the plain f32 precision "
+                         "policy (its scratch DMA layout is f32-only); "
+                         "drop precision or manual_dma")
     if manual_dma and groups != 6:
         raise ValueError("manual_dma is wired for the single-state "
                          "stepper only (its fetch-ahead hardcodes the "
@@ -1714,7 +1850,8 @@ def make_cov_stage_compact(
     _OY, _OX = 8, 128
     fill_ghosts, emit_strips = _make_fill(
         n, halo, i0, i1, interior=not manual_dma,
-        base=(_OY - halo, _OX - halo) if manual_dma else (0, 0))
+        base=(_OY - halo, _OX - halo) if manual_dma else (0, 0),
+        precision=precision)
 
     def kernel(*refs):
         if with_y0:
@@ -1787,8 +1924,8 @@ def make_cov_stage_compact(
             ua_int = uc_ref[0, 0]
             ub_int = uc_ref[1, 0]
         fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
-        ssn = gsn[6 * h : 6 * h + 2] if seam else None
-        swe = gwe[:, 6 * h : 6 * h + 2] if seam else None
+        ssn = wide(gsn[6 * h : 6 * h + 2]) if seam else None
+        swe = wide(gwe[:, 6 * h : 6 * h + 2]) if seam else None
 
         dh, dua, dub = rhs_core_cov(
             fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
@@ -1796,7 +1933,7 @@ def make_cov_stage_compact(
             n=n, halo=halo, d=d, radius=radius,
             gravity=gravity, omega=omega, recon=recon,
             seam_scratch=(scratch[3], scratch[4]) if seam else None,
-            sym_prescaled=sym_prescaled,
+            sym_prescaled=sym_prescaled, precision=precision,
         )
 
         fa = jnp.float32(a)
@@ -1872,8 +2009,8 @@ def make_cov_stage_compact(
         out_shape=[
             jax.ShapeDtypeStruct((groups, n, n), cdt_h),
             jax.ShapeDtypeStruct((2, groups, n, n), cdt_u),
-            jax.ShapeDtypeStruct((groups, 6 * h, n), jnp.float32),
-            jax.ShapeDtypeStruct((groups, n, 6 * h), jnp.float32),
+            jax.ShapeDtypeStruct((groups, 6 * h, n), sdt),
+            jax.ShapeDtypeStruct((groups, n, 6 * h), sdt),
         ],
         compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
@@ -1907,6 +2044,7 @@ def make_fused_ssprk3_cov_compact(
     u_scale: float = 1.0,
     seam: bool = True,
     ensemble: int = 0,
+    precision=None,
 ):
     """``step(y, t) -> y`` over ``y = {h, u, strips_sn, strips_we}``.
 
@@ -1915,6 +2053,14 @@ def make_fused_ssprk3_cov_compact(
     Initialise the carry with :meth:`CovariantShallowWater.compact_state`
     (encode ``h``/``u`` per ``carry_dtype``/``h_offset`` — see
     :meth:`CovariantShallowWater.encode_carry`).
+
+    ``precision`` (ops/pallas/precision.py): the per-stage dtype policy
+    — bf16 flux/reconstruction/router arithmetic with f32 accumulators
+    and metric terms, optionally bf16 strip storage.  Orthogonal to
+    ``carry_dtype`` (in-stage arithmetic vs between-step storage); the
+    two stack.  ``None`` is bitwise the historical f32 path.  A 16-bit
+    strips policy accepts an f32 initial strip carry (the first route
+    narrows it).
 
     ``ensemble = B > 0``: the carry gains a leading member axis —
     ``{h: (B, 6, n, n), u: (2, B, 6, n, n), strips_sn: (B, 6, 6h, n),
@@ -1932,7 +2078,9 @@ def make_fused_ssprk3_cov_compact(
     from .swe_step import SSPRK3_COEFFS
 
     B = int(ensemble)
-    route = make_cov_strip_router_split(grid, prescale_sym=seam)
+    precision = resolve_stage_precision(precision)
+    route = make_cov_strip_router_split(grid, prescale_sym=seam,
+                                        precision=precision)
     if B:
         # Member-mapped router: the static row-gather and 2x2 rotation
         # multiply-adds batch into single whole-ensemble XLA ops.
@@ -1942,7 +2090,7 @@ def make_fused_ssprk3_cov_compact(
         omega, dt, a, b, scheme=scheme, limiter=limiter, interpret=interpret,
         carry_dtype=carry_dtype, h_offset=h_offset, h_scale=h_scale,
         u_scale=u_scale, seam=seam, sym_prescaled=seam,
-        groups=6 * max(B, 1),
+        groups=6 * max(B, 1), precision=precision,
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)
@@ -2014,6 +2162,7 @@ def make_fused_ssprk3_cov_multistep(
     u_scale: float = 1.0,
     seam: bool = True,
     ensemble: int = 0,
+    precision=None,
 ):
     """``block(y, t) -> y`` running ``temporal_block`` fused SSPRK3 steps.
 
@@ -2038,6 +2187,7 @@ def make_fused_ssprk3_cov_multistep(
         grid, gravity, omega, dt, b_ext, scheme=scheme, limiter=limiter,
         interpret=interpret, carry_dtype=carry_dtype, h_offset=h_offset,
         h_scale=h_scale, u_scale=u_scale, seam=seam, ensemble=ensemble,
+        precision=precision,
     )
     if temporal_block == 1:
         return step1
@@ -2300,11 +2450,33 @@ def make_cov_stage_nu4(
     return stage_a, stage_b
 
 
+def _nu4_filtered_value(xr, xfr, yc, yfc, psi, iv, *, n, halo, d,
+                        radius, damp):
+    """``q - damp * lap(lap q)`` for one face — the ONE definition of
+    the del^4 filter arithmetic (ring-1 first Laplacian on the
+    halo-deep extended frame ``psi``, halo-1 second Laplacian on l1's
+    ``(n+2)^2`` window whose ``[1:n+1]`` maps to the interior),
+    shared by the split filter kernel (:func:`make_cov_nu4_filter`)
+    and the re-fused stage-1 kernel
+    (:func:`make_cov_stage_refused_nu4`) so a stencil/window fix
+    propagates to both placements.  ``iv`` is the face's unfiltered
+    interior values."""
+    m = n + 2 * halo
+    h = halo
+    l1 = lap_core(xr, xfr, yc, yfc, psi, n=n, halo=halo, d=d,
+                  radius=radius, ring=1)                # (n+2, n+2)
+    l2 = lap_core(xr[:, h - 1:m - h + 1], xfr[:, h - 1:m - h + 2],
+                  yc[h - 1:m - h + 1, :], yfc[h - 1:m - h + 2, :],
+                  l1, n=n, halo=1, d=d, radius=radius)
+    return iv - damp * l2
+
+
 def make_cov_nu4_filter(
     grid,
     nu4: float,
     dt_eff: float,
     interpret: bool = False,
+    precision=None,
 ):
     """Once-per-step del^4 filter as ONE kernel (round 5).
 
@@ -2337,7 +2509,13 @@ def make_cov_nu4_filter(
     d = float(grid.dalpha)
     radius = float(grid.radius)
     h = halo
-    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1, corners=True)
+    precision = resolve_stage_precision(precision)
+    sdt = jnp.float32 if precision is None else precision.strips_dtype
+    # The filter arithmetic itself is always f32 (a damp-scaled 4th-
+    # order operator is exactly where low-precision differencing bites);
+    # the policy only narrows the strip storage at the boundary.
+    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1, corners=True,
+                                          precision=precision)
     x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
     (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
      ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
@@ -2350,24 +2528,16 @@ def make_cov_nu4_filter(
         gsn = gsn_ref[0]
         gwe = gwe_ref[0]
         damp = jnp.float32(dt_eff * nu4)
-        # Coordinate windows for the second (halo-1-indexed) Laplacian:
-        # l1 lives on (n+2)^2 whose [1:n+1] maps to the interior.
-        xr2 = xr_ref[:][:, h - 1:m - h + 1]
-        xfr2 = xfr_ref[:][:, h - 1:m - h + 2]
-        yc2 = yc_ref[:][h - 1:m - h + 1, :]
-        yfc2 = yfc_ref[:][h - 1:m - h + 2, :]
         for fi, (int_ref, lead, out_ref) in enumerate(
                 ((hc_ref, (), ho_ref),
                  (uc_ref, (0,), uo_ref),
                  (uc_ref, (1,), uo_ref))):
             psi = fill_ghosts(scratch[fi], int_ref[lead + (0,)],
                               gsn, gwe, fi)
-            l1 = lap_core(xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
-                          psi, n=n, halo=halo, d=d, radius=radius,
-                          ring=1)                       # (n+2, n+2)
-            l2 = lap_core(xr2, xfr2, yc2, yfc2, l1,
-                          n=n, halo=1, d=d, radius=radius)
-            int_new = int_ref[lead + (0,)] - damp * l2
+            int_new = _nu4_filtered_value(
+                xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:], psi,
+                int_ref[lead + (0,)], n=n, halo=halo, d=d,
+                radius=radius, damp=damp)
             out_ref[lead + (0,)] = int_new
             emit_strips(ssn_ref, swe_ref, int_new, fi)
 
@@ -2383,8 +2553,8 @@ def make_cov_nu4_filter(
         out_shape=[
             jax.ShapeDtypeStruct((6, n, n), jnp.float32),
             jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
-            jax.ShapeDtypeStruct((6, 6 * h, n), jnp.float32),
-            jax.ShapeDtypeStruct((6, n, 6 * h), jnp.float32),
+            jax.ShapeDtypeStruct((6, 6 * h, n), sdt),
+            jax.ShapeDtypeStruct((6, n, 6 * h), sdt),
         ],
         compiler_params=tpu_compiler_params(
             vmem_limit_bytes=110 * 1024 * 1024,
@@ -2410,6 +2580,7 @@ def make_fused_ssprk3_cov_split_nu4(
     limiter: str = "mc",
     interpret: bool = False,
     interval: int = 1,
+    precision=None,
 ):
     """``step(y, t) -> y``: three PLAIN compact RK stages + one del^4
     filter kernel per step (4 kernels + 4 routes, vs the in-stage
@@ -2437,18 +2608,20 @@ def make_fused_ssprk3_cov_split_nu4(
     """
     from .swe_step import SSPRK3_COEFFS
 
-    route = make_cov_strip_router_split(grid)
+    precision = resolve_stage_precision(precision)
+    route = make_cov_strip_router_split(grid, precision=precision)
     mk = lambda a, b: make_cov_stage_compact(
         grid.n, grid.halo, float(grid.dalpha), float(grid.radius),
         gravity, omega, dt, a, b, scheme=scheme, limiter=limiter,
         interpret=interpret, seam=True, sym_prescaled=False,
+        precision=precision,
     )
     (a1, b1), (a2, b2), (a3, b3) = SSPRK3_COEFFS
     stage1 = mk(a1, b1)
     stage2 = mk(a2, b2)
     stage3 = mk(a3, b3)
     filt = make_cov_nu4_filter(grid, nu4, dt * interval,
-                               interpret=interpret)
+                               interpret=interpret, precision=precision)
 
     def step(y, t):
         del t
@@ -2536,5 +2709,224 @@ def make_fused_ssprk3_cov_nu4(
         return {"h": h3, "u": u3, "strips_sn": sn, "strips_we": we}
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Re-fused del^4 (round 10): the filter folded INTO the stage-1 kernel.
+#
+# The split filter (round 5) pays one extra kernel launch + one extra
+# strip route per step — 4 + 4 against the plain stepper's 3 + 3 — and
+# on the blocked tiers that fourth route is exactly the exchange the
+# temporal block (PR 2) exists to amortize away.  The re-fusion
+# observes that the split step's last op (filter y using route(y's
+# strips)) and the NEXT step's first op (stage 1 using the same
+# route(y's strips)) consume the identical routed ghosts: commuting the
+# filter to the head of the step makes them one kernel.  Per step:
+#
+#   split:    route S1 route S2 route S3 route FILT     (4 kernels, 4 routes)
+#   re-fused: route [FILT+S1] route S2 route S3         (3 kernels, 3 routes)
+#
+# Operator sequence: split is (F R)^k y0, re-fused is (R F)^k y0 — the
+# identical infinite product shifted by half a split step, so the two
+# trajectories differ by one filter application at the endpoints (an
+# O(damp) ~ 1e-3-relative perturbation on the filter term, the same
+# class as the split form's own first-order splitting).  Seam detail:
+# the in-kernel filter can only produce the FILTERED interior (filtered
+# ghosts would need depth-6 strips), so the advective stencils near the
+# boundary read filtered interior + unfiltered ghost values — an
+# O(damp) seam inconsistency on a damp-scaled term, the same class as
+# the split filter's own ring-1 seam approximation.  Mass conservation
+# is exact regardless: the symmetrized edge normals come from the
+# router (one shared value per physical edge, both faces identical), so
+# cross-seam flux equality never depends on ghost consistency.
+# Equivalence standard: the Galewsky day-6 physics gate
+# (bench_galewsky, refused line) + the damp-scale parity smoke in
+# tests/test_precision.py.
+# ---------------------------------------------------------------------------
+
+
+def make_cov_stage_refused_nu4(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    nu4: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+    precision=None,
+):
+    """Stage-1 kernel with the del^4 filter fused in front of the RHS.
+
+    ``stage1f(hc, uc, gsn, gwe, b_ext) -> (h1, u1, h0f, u0f, sn, we)``:
+    fills ghosts once (corner-filled — the Laplacian ring needs them;
+    the advective stencils never read corners so their arithmetic is
+    unchanged), applies ``q -= dt nu4 lap(lap q)`` to the three
+    prognostics' interiors (ring-1 first Laplacian, exactly
+    :func:`make_cov_nu4_filter`'s arithmetic), overwrites the scratch
+    interiors with the filtered fields, and runs the plain stage-1
+    advective RHS + combine on the result.  Emits the filtered base
+    state ``(h0f, u0f)`` so stages 2/3 combine against the same y0 the
+    split stepper would have produced.
+    """
+    import numpy as np
+
+    n, halo = grid.n, grid.halo
+    if halo < 2:
+        raise ValueError(f"re-fused nu4 needs halo >= 2 (ring-1 first "
+                         f"Laplacian), got halo={halo}")
+    m = n + 2 * halo
+    i0, i1 = halo, halo + n
+    d = float(grid.dalpha)
+    radius = float(grid.radius)
+    h = halo
+    precision = resolve_stage_precision(precision)
+    sdt = jnp.float32 if precision is None else precision.strips_dtype
+    wide = ((lambda x: x.astype(jnp.float32))
+            if sdt != jnp.float32 else (lambda x: x))
+    recon = pick_recon_precision(scheme, halo, n, limiter, precision)
+    fill_ghosts, emit_strips = _make_fill(n, halo, i0, i1, corners=True,
+                                          precision=precision)
+    x_row, xf_row, x_col, xf_col, _ = coord_rows(n, halo)
+    frames_z = jnp.asarray(np.asarray(FACE_AXES)[:, None, :, 2], jnp.float32)
+    (fz_spec, coord_specs, hi_blk, ui_blk, be_blk, gsn_blk, gwe_blk,
+     ssn_blk, swe_blk) = _cov_blockspecs(n, halo)
+
+    def kernel(*refs):
+        (fz_ref, xr_ref, xfr_ref, yc_ref, yfc_ref,
+         hc_ref, uc_ref, gsn_ref, gwe_ref, b_ref,
+         ho_ref, uo_ref, h0f_ref, u0f_ref, ssn_ref, swe_ref,
+         *scratch) = refs
+
+        gsn = gsn_ref[0]
+        gwe = gwe_ref[0]
+        damp = jnp.float32(dt * nu4)
+
+        filt = []
+        exts = []
+        for fi, (int_ref, lead) in enumerate(
+                ((hc_ref, ()), (uc_ref, (0,)), (uc_ref, (1,)))):
+            iv = int_ref[lead + (0,)]
+            fill_ghosts(scratch[fi], iv, gsn, gwe, fi)
+            fv = _nu4_filtered_value(
+                xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+                scratch[fi][:], iv, n=n, halo=halo, d=d,
+                radius=radius, damp=damp)
+            # Filtered interior + unfiltered ghosts: the O(damp) seam
+            # inconsistency documented in the section comment.
+            scratch[fi][i0:i1, i0:i1] = fv
+            filt.append(fv)
+            exts.append(scratch[fi][:])
+
+        fz = (fz_ref[0, 0, 0], fz_ref[0, 0, 1], fz_ref[0, 0, 2])
+        ssn = wide(gsn[6 * h : 6 * h + 2])
+        swe = wide(gwe[:, 6 * h : 6 * h + 2])
+        dh, dua, dub = rhs_core_cov(
+            fz, xr_ref[:], xfr_ref[:], yc_ref[:], yfc_ref[:],
+            exts[0], exts[1], exts[2], b_ref[0], ssn, swe,
+            n=n, halo=halo, d=d, radius=radius,
+            gravity=gravity, omega=omega, recon=recon,
+            seam_scratch=(scratch[3], scratch[4]),
+            sym_prescaled=True, precision=precision,
+        )
+
+        fg = jnp.float32(dt)                 # stage 1: a = 0, b = 1
+        for fi, (tend, out_ref, base_ref, lead) in enumerate(
+                ((dh, ho_ref, h0f_ref, ()),
+                 (dua, uo_ref, u0f_ref, (0,)),
+                 (dub, uo_ref, u0f_ref, (1,)))):
+            int_new = filt[fi] + fg * tend
+            out_ref[lead + (0,)] = int_new
+            base_ref[lead + (0,)] = filt[fi]
+            emit_strips(ssn_ref, swe_ref, int_new, fi)
+
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=pl.GridSpec(
+            grid=(6,),
+            in_specs=[fz_spec] + coord_specs
+                     + [hi_blk, ui_blk, gsn_blk, gwe_blk, be_blk],
+            out_specs=[hi_blk, ui_blk, hi_blk, ui_blk, ssn_blk, swe_blk],
+            scratch_shapes=[pltpu.VMEM((m, m), jnp.float32)
+                            for _ in range(3)]
+                           + [pltpu.VMEM((n, n + 1), jnp.float32),
+                              pltpu.VMEM((n + 1, n), jnp.float32)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, 6, n, n), jnp.float32),
+            jax.ShapeDtypeStruct((6, 6 * h, n), sdt),
+            jax.ShapeDtypeStruct((6, n, 6 * h), sdt),
+        ],
+        compiler_params=tpu_compiler_params(
+            vmem_limit_bytes=110 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )
+
+    def stage1f(hc, uc, gsn, gwe, b_ext):
+        return tuple(call(frames_z, x_row, xf_row, x_col, xf_col,
+                          hc, uc, gsn, gwe, b_ext))
+
+    return stage1f
+
+
+def make_fused_ssprk3_cov_refused_nu4(
+    grid,
+    gravity: float,
+    omega: float,
+    dt: float,
+    b_ext,
+    nu4: float,
+    scheme: str = "plr",
+    limiter: str = "mc",
+    interpret: bool = False,
+    precision=None,
+):
+    """``step(y, t) -> y``: the re-fused del^4 stepper — 3 kernels + 3
+    routes per step (the split form's 4 + 4 with the filter commuted
+    into stage 1; see the section comment for the equivalence story).
+    Carry/router identical to :func:`make_fused_ssprk3_cov_compact`
+    (prescaled sym rows); composes with the stage precision policy, and
+    with temporal blocking via the caller's generic exact-fusion wrap
+    (``stepping.blocked`` — the filter is inside the stage, so blocking
+    adds no extra routes).  No ``interval`` support: filter-cycling
+    stays on the split path.
+    """
+    from .swe_step import SSPRK3_COEFFS
+
+    precision = resolve_stage_precision(precision)
+    route = make_cov_strip_router_split(grid, prescale_sym=True,
+                                        precision=precision)
+    stage1f = make_cov_stage_refused_nu4(
+        grid, gravity, omega, dt, nu4, scheme=scheme, limiter=limiter,
+        interpret=interpret, precision=precision)
+    mk = lambda a, b: make_cov_stage_compact(
+        grid.n, grid.halo, float(grid.dalpha), float(grid.radius),
+        gravity, omega, dt, a, b, scheme=scheme, limiter=limiter,
+        interpret=interpret, seam=True, sym_prescaled=True,
+        precision=precision,
+    )
+    (_, _), (a2, b2), (a3, b3) = SSPRK3_COEFFS
+    stage2 = mk(a2, b2)
+    stage3 = mk(a3, b3)
+
+    def step1(y, t):
+        del t
+        with named_scope("rk_stage1_nu4"):
+            gsn, gwe = route(y["strips_sn"], y["strips_we"])
+            h1, u1, h0f, u0f, sn1, we1 = stage1f(y["h"], y["u"],
+                                                 gsn, gwe, b_ext)
+        with named_scope("rk_stage2"):
+            gsn, gwe = route(sn1, we1)
+            h2, u2, sn2, we2 = stage2(h0f, u0f, h1, u1, gsn, gwe, b_ext)
+        with named_scope("rk_stage3"):
+            gsn, gwe = route(sn2, we2)
+            h3, u3, sn3, we3 = stage3(h0f, u0f, h2, u2, gsn, gwe, b_ext)
+        return {"h": h3, "u": u3, "strips_sn": sn3, "strips_we": we3}
+
+    return step1
 
 

@@ -36,7 +36,8 @@ __all__ = ["make_sharded_stepper", "make_stepper_for", "shard_params"]
 
 def make_stepper_for(model, setup, example_state, dt: float,
                      scheme: str = "ssprk3", temporal_block: int = None,
-                     ensemble: int = 0, donate: bool = False):
+                     ensemble: int = 0, donate: bool = False,
+                     precision=None):
     """Dispatch on the config's ``use_shard_map`` flag.
 
     Explicit ppermute path when requested (and the mesh fits), otherwise
@@ -57,7 +58,28 @@ def make_stepper_for(model, setup, example_state, dt: float,
     batch the inferred collectives.  ``donate=True`` donates the state
     carry at the top-level jit (callers must then treat each input
     state as consumed).
+
+    ``precision`` (round 10): the per-stage dtype policy is wired for
+    the single-device fused covariant stepper
+    (``CovariantShallowWater.make_fused_step(precision=...)``, where it
+    composes with temporal blocking, ensembles and donation); the
+    steppers this dispatcher builds run the classic jnp RHS inside
+    shard_map / GSPMD, which has no bf16 stage form — a non-f32 policy
+    is rejected here with that pointer rather than silently ignored.
+    The sharded tiers' 16-bit-strip *wire accounting* is available
+    without a stepper change: ``scripts/comm_probe.py --strip-dtype
+    bf16`` / ``comm_probe.temporal_block_plan(strip_dtype_bytes=2)``.
     """
+    from ..ops.pallas.precision import resolve_stage_precision
+
+    if resolve_stage_precision(precision) is not None:
+        raise ValueError(
+            "the per-stage precision policy rides the single-device "
+            "fused covariant stepper (make_fused_step(precision=...)); "
+            "the sharded/classic tiers built here run f32 numerics — "
+            "drop the precision: block, or run single-device; wire-byte "
+            "accounting for 16-bit strips is available via "
+            "scripts/comm_probe.py --strip-dtype bf16")
     if temporal_block is None:
         k = 1 if setup is None else getattr(setup, "temporal_block", 1)
     else:

@@ -149,6 +149,16 @@ class Simulation:
             self.model, self.state = self._build_model_and_state()
 
             par = cfg.parallelization
+            # The sharded tiers run f32 numerics: hand them the
+            # precision spec ONLY when they are the executing path
+            # (num_devices > 1) so make_stepper_for rejects a non-f32
+            # policy with its pointer.  Single-device runs ride the
+            # fused stepper below (the classic _step built here is its
+            # fallback, and the fused-or-raise check at the end of this
+            # constructor guards that case).
+            pspec = ({"stage": cfg.precision.stage,
+                      "strips": cfg.precision.strips}
+                     if par.num_devices > 1 else None)
             if self.members > 1:
                 self.state = self._build_ensemble_state()
                 if par.num_devices > 1:
@@ -158,7 +168,7 @@ class Simulation:
                 self._step = make_stepper_for(
                     self.model, self.setup, self.state, cfg.time.dt,
                     cfg.time.scheme, temporal_block=par.temporal_block,
-                    ensemble=self.members,
+                    ensemble=self.members, precision=pspec,
                 )
             else:
                 if par.num_devices > 1:
@@ -167,6 +177,7 @@ class Simulation:
                 self._step = make_stepper_for(
                     self.model, self.setup, self.state, cfg.time.dt,
                     cfg.time.scheme, temporal_block=par.temporal_block,
+                    precision=pspec,
                 )
         # Single-device Pallas SWE runs use the fused extended-state
         # SSPRK3 stepper (the bench flagship): extend/restrict happen once
@@ -174,10 +185,16 @@ class Simulation:
         # I/O strides.  Sharded runs are handled by make_stepper_for.
         self._fused_step = None
         self._fused_prep = None
+        # Decode hook for 16-bit carry encodings (precision.carry):
+        # applied to every restrict_state exit so self.state, history,
+        # checkpoints, diagnostics and the in-loop metrics all see
+        # absolute f32 fields; None = identity (the f32 carry).
+        self._fused_post = None
         m = self.model
         # nu4 > 0 is fused only where the model declares support (the
         # covariant model's two-kernel del^4 stage pair).
         tb = cfg.parallelization.temporal_block
+        pkw, p_enc, p_dec = self._resolve_precision()
         if (self.members > 1 and self.setup is None
                 and cfg.time.scheme == "ssprk3"
                 and getattr(m, "backend", "").startswith("pallas")
@@ -188,11 +205,24 @@ class Simulation:
             # launch per stage (jaxstream.ops.pallas.swe_cov).
             try:
                 self._fused_step = m.make_fused_step(
-                    cfg.time.dt, temporal_block=tb, ensemble=self.members)
-                self._fused_prep = m.ensemble_compact_state
+                    cfg.time.dt, temporal_block=tb, ensemble=self.members,
+                    **pkw)
+                if p_enc is not None:
+                    # Strip narrowing only (carry encodings are
+                    # rejected for ensembles in _resolve_precision).
+                    self._fused_prep = (
+                        lambda s, _e=p_enc: _e(m.ensemble_compact_state(s)))
+                else:
+                    self._fused_prep = m.ensemble_compact_state
                 log.info("using batched ensemble fused SSPRK3 stepper "
                          "(%d members per kernel launch)", self.members)
             except Exception as e:
+                if pkw:
+                    raise ValueError(
+                        "precision: block configured but the batched "
+                        f"fused stepper failed to build ({type(e).__name__}"
+                        f": {e}); the policy has no classic-path form, so "
+                        "refusing to silently run f32") from e
                 log.warning(
                     "batched fused stepper unavailable (%s: %s); falling "
                     "back to the vmapped classic path",
@@ -213,8 +243,13 @@ class Simulation:
                     exact k-step fusion via stepping.blocked otherwise."""
                     try:
                         return m.make_fused_step(cfg.time.dt,
-                                                 temporal_block=tb)
+                                                 temporal_block=tb, **pkw)
                     except TypeError:
+                        if pkw:
+                            # The precision/nu4_mode kwargs have no
+                            # generic fallback — a model that doesn't
+                            # know them can't honor the config.
+                            raise
                         step = m.make_fused_step(cfg.time.dt)
                         if tb > 1:
                             from .stepping import blocked
@@ -225,20 +260,45 @@ class Simulation:
 
                 if hasattr(m, "compact_state"):
                     self._fused_step = _mk_fused()
-                    self._fused_prep = m.compact_state
+                    if p_enc is not None:
+                        self._fused_prep = (
+                            lambda s, _e=p_enc: _e(m.compact_state(s)))
+                        self._fused_post = p_dec
+                    else:
+                        self._fused_prep = m.compact_state
                     log.info("using compact fused SSPRK3 stepper "
                              "(interior-only carry)")
                 else:
+                    if pkw.get("precision") or p_enc is not None:
+                        raise ValueError(
+                            "precision: block needs the compact-carry "
+                            "fused stepper (this model only has the "
+                            "extended-state form)")
                     self._fused_step = _mk_fused()
                     self._fused_prep = functools.partial(
                         m.extend_state, with_strips=True)
                     log.info("using fused extended-state SSPRK3 stepper")
             except Exception as e:
+                if pkw or p_enc is not None:
+                    raise ValueError(
+                        "precision: block configured but the fused "
+                        f"stepper failed to build ({type(e).__name__}: "
+                        f"{e}); the policy has no classic-path form, so "
+                        "refusing to silently run f32") from e
                 log.warning(
                     "fused stepper unavailable (%s: %s); falling back to "
                     "the classic path (~2x slower on TPU)",
                     type(e).__name__, e,
                 )
+        if (pkw or p_enc is not None) and self._fused_step is None:
+            raise ValueError(
+                "the precision: block (stage/strips/carry != f32) and "
+                "model.nu4_mode != 'split' ride the single-device fused "
+                "covariant stepper: they need model.backend: pallas, "
+                "time.scheme: ssprk3, model.numerics: dense and "
+                "parallelization.num_devices: 1 (sharded tiers take the "
+                "wire accounting only — scripts/comm_probe.py "
+                "--strip-dtype bf16)")
         self._segment_cache: Dict[int, Callable] = {}
 
         # Async host pipeline (io.async_pipeline, round 9): the writer
@@ -321,7 +381,12 @@ class Simulation:
             self.grid, self.model, ex, o.metrics, tc.dt, p.gravity)
         if self._fused_step is not None:
             m = self.model
-            loop_prep = m.restrict_state
+            if self._fused_post is not None:
+                # 16-bit carry: metrics must see absolute f32 fields.
+                loop_prep = (lambda y, _m=m, _p=self._fused_post:
+                             _p(_m.restrict_state(y)))
+            else:
+                loop_prep = m.restrict_state
         else:
             def loop_prep(y):
                 return {k: v for k, v in y.items() if k in _PROG_KEYS}
@@ -357,6 +422,74 @@ class Simulation:
                  ms.k, o.interval, o.guards,
                  f", sink={o.sink}" if o.sink else "")
         return _ObsRuntime(o, ms, metric_fn, monitor, sink, ref)
+
+    def _resolve_precision(self):
+        """``precision:`` + ``model.nu4_mode`` config -> fused-stepper
+        kwargs and carry encode/decode hooks.
+
+        Returns ``(kwargs, encode, decode)``: ``kwargs`` feed
+        ``make_fused_step`` (``precision=`` stage/strips policy,
+        ``nu4_mode=``, and the ``carry_dtype``/``h_offset``/``h_scale``
+        encoding triple); ``encode`` wraps the carry prep, ``decode``
+        every carry exit (both None for the f32 carry).  All-default
+        config returns ``({}, None, None)`` — the stepper factories are
+        called exactly as before, bit-for-bit.  The mixed16 offset is
+        the initial state's h mid-range, the same choice bench.py's
+        gated mixed16 variant makes; re-encoding at segment boundaries
+        is idempotent (round-to-grid of an on-grid value), so segment
+        length never changes the trajectory.
+        """
+        from .ops.pallas.precision import (encode_strips,
+                                           resolve_stage_precision)
+
+        pcfg = self.config.precision
+        kw = {}
+        if self.config.model.nu4_mode != "split":
+            kw["nu4_mode"] = self.config.model.nu4_mode
+        if pcfg.stage != "f32" or pcfg.strips not in ("auto", "f32"):
+            kw["precision"] = {"stage": pcfg.stage, "strips": pcfg.strips}
+        # Under a 16-bit strips policy the stage kernels EMIT bf16
+        # strips, so the initial carry's strips must be narrowed before
+        # the jitted segment loop (fori_loop carry types are fixed);
+        # composed below with the carry encoding when both are on.
+        pol = resolve_stage_precision(kw.get("precision"))
+        narrow = ((lambda y, _p=pol: encode_strips(y, _p))
+                  if pol is not None and pol.strips == "bf16" else None)
+        if pcfg.carry == "f32":
+            return kw, narrow, None
+        if pcfg.carry not in ("bf16", "mixed16"):
+            raise ValueError(
+                f"precision.carry={pcfg.carry!r}; valid: 'f32', 'bf16', "
+                "'mixed16'")
+        if self.members > 1:
+            raise ValueError(
+                "precision.carry encodings are wired for single runs "
+                "(members: 1); the batched ensemble carry stays f32")
+        m = self.model
+        if m is None or not hasattr(m, "encode_carry"):
+            raise ValueError(
+                "precision.carry != 'f32' needs the covariant dense "
+                "model (model.numerics: dense, shallow-water family)")
+        import jax.numpy as jnp
+
+        h = self.state["h"]
+        if pcfg.carry == "mixed16":
+            # bench.py's gated encoding, ONE shared definition.
+            from .ops.pallas.precision import mixed16_encoding
+
+            cd, off, hs = mixed16_encoding(h)
+        else:
+            # bf16 h-anomaly + bf16 u: the wider-mass-band encoding
+            # (demoted from bench's default gate; kept for experiments).
+            off = float(0.5 * (float(jnp.min(h)) + float(jnp.max(h))))
+            cd, hs = (jnp.bfloat16, jnp.bfloat16), 1.0
+        kw.update(carry_dtype=cd, h_offset=off, h_scale=hs)
+        if narrow is not None:
+            enc = lambda s: narrow(m.encode_carry(s, cd, off, hs))
+        else:
+            enc = lambda s: m.encode_carry(s, cd, off, hs)
+        dec = lambda s: m.decode_carry(s, off, hs)
+        return kw, enc, dec
 
     def _postmortem_checkpoint(self):
         """'checkpoint_and_raise' breach callback: save the CURRENT
@@ -851,6 +984,7 @@ class Simulation:
             if self._fused_step is not None:
                 m, fused, prep = self.model, self._fused_step, \
                     self._fused_prep
+                post = self._fused_post or (lambda s: s)
 
                 def fn(y, t, step0, _n=k // spc, _dt=dt * spc,
                        _e=every, _s=samples):
@@ -858,7 +992,7 @@ class Simulation:
                     y_c, t, buf = integrate_with_metrics(
                         fused, y_c, t, _n, _dt, mfn, _e, _s, step0,
                         steps_per_call=spc, fault_step=fault)
-                    return m.restrict_state(y_c), t, buf
+                    return post(m.restrict_state(y_c)), t, buf
             else:
                 step = self._step
 
@@ -878,11 +1012,12 @@ class Simulation:
             m, fused = self.model, self._fused_step
 
             prep = self._fused_prep
+            post = self._fused_post or (lambda s: s)
 
             def fn(y, t, _k=k // spc, _dt=dt * spc):
                 y_c = prep(y)
                 y_c, t = integrate(fused, y_c, t, _k, _dt)
-                return m.restrict_state(y_c), t
+                return post(m.restrict_state(y_c)), t
 
             return jax.jit(fn, donate_argnums=(0,) if donate else ())
         # unroll=1: the generic tiers' steps are ms-scale (TT

@@ -36,7 +36,8 @@ SERIALIZED_PPERMUTES_PER_STEP = 12
 
 
 def temporal_block_plan(n: int, halo: int, temporal_block: int,
-                        rk_stages: int = 3) -> dict:
+                        rk_stages: int = 3,
+                        strip_dtype_bytes: int = 4) -> dict:
     """Static exchange/compute accounting of temporal halo blocking.
 
     Pure arithmetic — no devices, no jax — shared by the CLI report,
@@ -59,6 +60,13 @@ def temporal_block_plan(n: int, halo: int, temporal_block: int,
       the docs' headline ``((n + 2kh)^2 - n^2) / n^2`` with ``k``
       counting exchange-free RHS evaluations (``rk_stages *
       temporal_block``).
+
+    ``strip_dtype_bytes`` (round 10): bytes per exchanged strip element
+    — 4 (f32, the default) or 2 when the strips ride a 16-bit precision
+    policy (``jaxstream.ops.pallas.precision.strip_dtype_bytes``).
+    Sets ``payload_bytes_per_step`` and the reported
+    ``wire_bytes_saving_vs_f32`` fraction; element counts are
+    dtype-independent.
     """
     if temporal_block < 1:
         raise ValueError(
@@ -78,6 +86,9 @@ def temporal_block_plan(n: int, halo: int, temporal_block: int,
         "exchange_latency_ratio": (4.0 / k)
             / SERIALIZED_PPERMUTES_PER_STEP,
         "payload_elems_per_step": 3 * D * n * 4 / k,
+        "strip_dtype_bytes": strip_dtype_bytes,
+        "payload_bytes_per_step": 3 * D * n * 4 * strip_dtype_bytes / k,
+        "wire_bytes_saving_vs_f32": 1.0 - strip_dtype_bytes / 4.0,
         "redundant_compute_fraction": sum(redundant) / stages,
         "redundant_compute_fraction_first_stage": redundant[0],
     }
@@ -102,7 +113,9 @@ def batched_exchange_plan(n: int, halo: int, members: int,
     member_step`` (12/B), ``serialized_ppermutes_per_member_step`` (12),
     ``launch_latency_ratio`` (1/B), ``payload_bytes_per_ppermute``
     (each way, per edge), ``wire_bytes_per_member_step`` (invariant
-    in B).
+    in B).  ``dtype_bytes=2`` is the 16-bit-strips policy
+    (round 10) — payload and wire bytes halve; the saving fraction is
+    reported as ``wire_bytes_saving_vs_f32``.
     """
     if members < 1:
         raise ValueError(f"members must be >= 1, got {members}")
@@ -121,12 +134,15 @@ def batched_exchange_plan(n: int, halo: int, members: int,
         "payload_bytes_per_ppermute": payload,
         "wire_bytes_per_member_step": per_step * 3 * halo * n
             * dtype_bytes,
+        "strip_dtype_bytes": dtype_bytes,
+        "wire_bytes_saving_vs_f32": 1.0 - dtype_bytes / 4.0,
     }
 
 
 def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
                       temporal_block: int = 0, members: int = 0,
-                      devices=None, plan_only: bool = False):
+                      devices=None, plan_only: bool = False,
+                      strip_dtype_bytes: int = 4):
     """Full probe suite with the shared device/size policy.
 
     The one place the selection lives (CLI, bench multichip, dryrun
@@ -143,6 +159,13 @@ def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
     fakes with a ``platform`` attribute).  ``plan_only=True`` stops
     after the device/size/schedule selection — everything that needs no
     compilation — so the plumbing is testable in milliseconds.
+
+    ``strip_dtype_bytes``: bytes per exchanged strip element for the
+    PLAN accounting (2 under a 16-bit strips policy — CLI
+    ``--strip-dtype bf16``).  The measured latencies always ship f32
+    strips: the sharded steppers run f32 numerics (the 16-bit wire is
+    the single-device fused path's policy), so the plans report the
+    savings a 16-bit exchange WOULD bank, explicitly tagged.
     """
     from ..geometry.connectivity import build_connectivity, build_schedule
 
@@ -159,10 +182,11 @@ def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
     result["schedule_stages"] = len(build_schedule(build_connectivity()))
     if temporal_block > 1:
         result["temporal_block_plan"] = temporal_block_plan(
-            n, halo, temporal_block)
+            n, halo, temporal_block,
+            strip_dtype_bytes=strip_dtype_bytes)
     if members > 1:
         result["batched_exchange_plan"] = batched_exchange_plan(
-            n, halo, members)
+            n, halo, members, dtype_bytes=strip_dtype_bytes)
     if plan_only:
         return result
 
@@ -372,7 +396,10 @@ def format_report(result: dict) -> str:
             f"{be['ppermutes_per_member_step']:.2f} "
             f"(vs {be['serialized_ppermutes_per_member_step']:.0f}) "
             f"payload/ppermute={be['payload_bytes_per_ppermute']} B "
-            f"wire/member-step={be['wire_bytes_per_member_step']} B")
+            f"wire/member-step={be['wire_bytes_per_member_step']} B"
+            + (f" (16-bit strips: -"
+               f"{100 * be['wire_bytes_saving_vs_f32']:.0f}% wire)"
+               if be.get("wire_bytes_saving_vs_f32") else ""))
     tb = result.get("temporal_block_plan")
     if tb:
         lines.append(
@@ -383,5 +410,9 @@ def format_report(result: dict) -> str:
             f"redundant_compute="
             f"{tb['redundant_compute_fraction']:.3f}"
             f" (first stage "
-            f"{tb['redundant_compute_fraction_first_stage']:.3f})")
+            f"{tb['redundant_compute_fraction_first_stage']:.3f})"
+            + (f" payload/step={tb['payload_bytes_per_step']:.0f} B "
+               f"(16-bit strips: -"
+               f"{100 * tb['wire_bytes_saving_vs_f32']:.0f}% wire)"
+               if tb.get("wire_bytes_saving_vs_f32") else ""))
     return "\n".join(lines)

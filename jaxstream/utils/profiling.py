@@ -29,6 +29,7 @@ import jax
 
 __all__ = [
     "HardwareRoof", "TPU_V4_CLASS", "TPU_V5E", "TPU_V5P",
+    "TPU_V5E_VPU", "TPU_V5E_VPU_BF16", "mixed_vpu_roof",
     "cost_analysis", "analytic_cov_step_cost", "roofline", "Roofline",
     "StepTimer", "median_chain_seconds", "steady_state_rate", "trace",
 ]
@@ -61,6 +62,35 @@ TPU_V5P = HardwareRoof("TPU v5p", 2765.0, 459.0)
 # near the VPU roofline").  v5p scaled by clock/core ratio.
 TPU_V5E_VPU = HardwareRoof("TPU v5e VPU f32 stencil-mix", 819.0, 2.6)
 TPU_V5P_VPU = HardwareRoof("TPU v5p VPU f32 stencil-mix", 2765.0, 5.5)
+# bf16 elementwise ops pack 2x per VPU lane, so the same stencil-mix
+# argument doubles the effective roof for the ops that actually run
+# bf16.  A MIXED kernel (the round-10 stage precision policy casts only
+# the flux face-averages + limiter algebra) lands between the two
+# roofs; mixed_vpu_roof() computes the harmonic blend for a given bf16
+# flop fraction.
+TPU_V5E_VPU_BF16 = HardwareRoof("TPU v5e VPU bf16 stencil-mix", 819.0, 5.2)
+
+
+def mixed_vpu_roof(bf16_fraction: float,
+                   f32_roof: HardwareRoof = TPU_V5E_VPU,
+                   bf16_roof: HardwareRoof = TPU_V5E_VPU_BF16
+                   ) -> HardwareRoof:
+    """Effective VPU roof for a kernel running a bf16/f32 op mix.
+
+    Time to issue F flops with fraction ``phi`` at the bf16 rate is
+    ``F*((1-phi)/P32 + phi/P16)`` — the harmonic blend, NOT the linear
+    one (a linear average would overstate the roof whenever the slow
+    class dominates the op stream).  ``phi = 0`` returns the f32 roof
+    unchanged; ``phi = 1`` the bf16 roof.
+    """
+    if not 0.0 <= bf16_fraction <= 1.0:
+        raise ValueError(
+            f"bf16_fraction must be in [0, 1], got {bf16_fraction}")
+    peak = 1.0 / ((1.0 - bf16_fraction) / f32_roof.peak_tflops
+                  + bf16_fraction / bf16_roof.peak_tflops)
+    return HardwareRoof(
+        f"{f32_roof.name} + {100 * bf16_fraction:.0f}% bf16",
+        f32_roof.hbm_gbps, peak)
 
 
 def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
@@ -108,11 +138,46 @@ def cost_analysis(fn: Callable, *args, **kwargs) -> Dict[str, float]:
 # ~2 TFLOP/s sustained; treat the count as +-15%.
 _RECON_FLOPS = {"none": 6, "minmod": 14, "mc": 19, "vanleer": 16}
 
+# Of those, the ops the round-10 bf16 stage policy actually runs in
+# bfloat16 (ops/pallas/precision.py): the flux face-average velocity
+# adds/halvings (~4 of the 7 "face-average + contraction" ops per
+# direction — the metric contraction accumulates f32) and the limiter
+# slope chain (the candidate/min/max algebra, ~15 of the 4+19 recon
+# ops; the f32-cell +- f32(bf16 half-slope) assembly stays f32).
+# Per cell/stage (MC): 2 * (4 + 15) = 38 of 137 -> bf16 fraction ~0.28.
+# Everything else — metric terms, upwind products, divergences,
+# gradients, RK combines — is f32 by policy.  Same +-15% caveat.
+_BF16_STAGE_FLOPS = {"none": 2 * (4 + 4), "minmod": 2 * (4 + 11),
+                     "mc": 2 * (4 + 15), "vanleer": 2 * (4 + 13)}
+
+# del^4 filter per-cell flop count (ops/pallas/swe_cov.py::lap_core,
+# applied twice + the damp axpy, per prognostic field).  Per Laplacian
+# application per direction: face gradient dpa (diff + mul) 2,
+# cross-gradient dpb_c 2, face-average dpb_f 2, frame consumed entries
+# ~5, flux contraction 3 -> 14; divergence + inv_sqrtg scaling ~6.
+# Per Laplacian: 2*14 + 6 = 34; per field: 2 Laplacians + axpy = 70;
+# 3 fields -> 210 flops/cell/step.  (The round-6..9 bench billed the
+# filter at scale=4/3 == one extra 137-flop stage — ~35% under this
+# count; re-derived here per the round-10 accounting satellite.)
+# The filter arithmetic is identical in 'split' and 'refused' placement
+# — re-fusion changes kernel/route COUNT and bytes, not flops.
+_NU4_FILTER_FLOPS = 3 * (2 * 34 + 2)
+
+#: Extra f32 field passes the filter adds per step.  'split': its own
+#: kernel reads 3 fields (+ghost strips, <1%) and writes 3 fields + new
+#: strips -> ~6 passes.  'refused': the filter rides the stage-1 kernel
+#: (ghosts already resident); the only NEW traffic is the filtered-base
+#: (h0f, u0f) output stages 2-3 combine against -> 3 passes.
+_NU4_FIELD_PASSES = {"split": 6, "refused": 3}
+
 
 def analytic_cov_step_cost(n: int, *, limiter: str = "mc",
                            dtype_bytes: int = 4, stages: int = 3,
                            n_faces: int = 6,
-                           ensemble: int = 1) -> Dict[str, float]:
+                           ensemble: int = 1,
+                           carry_bytes: int = None,
+                           nu4: str = None,
+                           precision: str = None) -> Dict[str, float]:
     """Analytic flops/bytes for ONE fused covariant SSPRK3 step at C``n``.
 
     Pallas custom calls are invisible to :func:`cost_analysis`; this is
@@ -134,23 +199,71 @@ def analytic_cov_step_cost(n: int, *, limiter: str = "mc",
     is real extra traffic the model already charges — b rides the
     per-stage field-pass count.)
 
-    Returns ``{"flops", "bytes", "ai", "flops_per_cell_stage"}``.
+    ``carry_bytes`` (round-10 accounting satellite): bytes per element
+    of the h/u CARRY storage — 2 for the 16-bit encodings (mixed16 /
+    bf16), default = ``dtype_bytes``.  Only the 24 carry field passes
+    scale; the orography re-read (1 pass/stage) stays at
+    ``dtype_bytes`` — the earlier coarse ``bytes * 0.5`` model
+    overstated the 16-bit savings by billing b at 2 bytes too
+    (0.500x vs the honest 0.556x at the default shape), overstating AI
+    for the 16-bit-carry variants.
+
+    ``nu4``: ``'split'`` / ``'refused'`` adds the del^4 filter —
+    identical arithmetic (+``_NU4_FILTER_FLOPS`` = 210 flops/cell/step,
+    re-derived from lap_core; the old ``scale = 4/3`` billed it as one
+    extra 137-flop stage, ~35% under) but different bytes: the split
+    form's standalone kernel pays ~6 extra f32 field passes, the
+    re-fused form only the 3 filtered-base output passes
+    (``_NU4_FIELD_PASSES``).  Filter traffic is f32 at any
+    ``carry_bytes`` (the nu4 paths reject carry encodings).
+
+    ``precision='bf16'``: tags the fraction of flops the stage policy
+    runs in bfloat16 (``bf16_flop_fraction``, from
+    ``_BF16_STAGE_FLOPS``; filter flops are always f32) so callers can
+    plot against :func:`mixed_vpu_roof`.  Flops/bytes themselves are
+    unchanged — the policy re-types ops, it does not remove them (the
+    strip-storage halving is <1% of bytes at C384, folded like the f32
+    strip traffic).
+
+    Returns ``{"flops", "bytes", "ai", "flops_per_cell_stage",
+    "bf16_flop_fraction"}``.
     """
     if ensemble < 1:
         raise ValueError(f"ensemble must be >= 1, got {ensemble}")
+    if nu4 not in (None, "split", "refused"):
+        raise ValueError(f"nu4 must be None, 'split' or 'refused', "
+                         f"got {nu4!r}")
+    if precision not in (None, "f32", "bf16"):
+        raise ValueError(f"precision must be None, 'f32' or 'bf16', "
+                         f"got {precision!r}")
+    if carry_bytes is None:
+        carry_bytes = dtype_bytes
     recon = _RECON_FLOPS.get(limiter, _RECON_FLOPS["mc"])
     per_cell_stage = 2 * (17 + recon) + 9 + 44 + 12
     cells = n_faces * n * n * ensemble
     flops = float(per_cell_stage * cells * stages)
     # field passes: stage1 reads y(3)+b(1) writes 3 = 7;
     # stages 2,3 read y(3)+y0(3)+b(1) write 3 = 10  -> 27 per 3 stages.
+    # Of those, 1 pass/stage is the orography (always dtype_bytes);
+    # the rest are the carry fields (carry_bytes).
     field_passes = 7 + 10 * (stages - 1)
-    nbytes = float(field_passes * cells * dtype_bytes)
+    carry_passes = field_passes - stages
+    nbytes = float(cells * (carry_passes * carry_bytes
+                            + stages * dtype_bytes))
+    bf16_flops = 0.0
+    if precision == "bf16":
+        bf16_flops = float(
+            _BF16_STAGE_FLOPS.get(limiter, _BF16_STAGE_FLOPS["mc"])
+            * cells * stages)
+    if nu4 is not None:
+        flops += float(_NU4_FILTER_FLOPS * cells)
+        nbytes += float(_NU4_FIELD_PASSES[nu4] * cells * dtype_bytes)
     return {
         "flops": flops,
         "bytes": nbytes,
         "ai": flops / nbytes,
         "flops_per_cell_stage": float(per_cell_stage),
+        "bf16_flop_fraction": bf16_flops / flops if flops else 0.0,
     }
 
 

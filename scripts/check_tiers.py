@@ -34,6 +34,15 @@ every gate run self-checking):
    acceptance criteria of the overlap path — they must run in every
    fast gate, not rot in the slow tier.
 
+5. **Precision-parity tests stay tier-1** (round-10 satellite): the
+   same rule for modules importing ``jaxstream.ops.pallas.precision``.
+   The precision ladder's acceptance criteria — policy-off bitwise
+   identity, the measured bf16-stage truncation budgets, the re-fused
+   del^4 parity — are exactly what certifies that a refactor didn't
+   silently change which ops run reduced; they must run in every fast
+   gate (a slow-marked parity would let a bad policy ship between
+   offline TPU bench runs).
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -60,6 +69,11 @@ _ASYNC_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.io\.async_pipeline\b"
     r"|import\s+jaxstream\.io\.async_pipeline\b"
     r"|from\s+jaxstream\.io\s+import\s+(\w+\s*,\s*)*async_pipeline\b)",
+    re.MULTILINE)
+_PRECISION_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.ops\.pallas\.precision\b"
+    r"|import\s+jaxstream\.ops\.pallas\.precision\b"
+    r"|from\s+jaxstream\.ops\.pallas\s+import\s+(\w+\s*,\s*)*precision\b)",
     re.MULTILINE)
 
 
@@ -106,6 +120,13 @@ def lint_file(path: str, allowed: set):
                f"flush-on-exception, thread hygiene) must run in every "
                f"fast gate; move the slow test to a module that does "
                f"not import jaxstream.io.async_pipeline")
+    if _PRECISION_IMPORT_RE.search(src) and "slow" in used:
+        yield (f"{rel}: imports jaxstream.ops.pallas.precision but "
+               f"marks tests slow — the precision-ladder parities "
+               f"(policy-off bitwise, bf16-stage truncation budgets, "
+               f"re-fused del^4) must run in every fast gate; move the "
+               f"slow test to a module that does not import "
+               f"jaxstream.ops.pallas.precision")
 
 
 def main(repo_root: str = None) -> int:

@@ -9,7 +9,7 @@ Usage::
 
     python scripts/comm_probe.py [n] [--iters K] [--steps K]
                                  [--temporal-block K] [--members B]
-                                 [--json]
+                                 [--strip-dtype f32|bf16] [--json]
 
 ``--temporal-block K`` adds the deep-halo blocked stepper's rate and
 the static exchanges/step + redundant-compute accounting
@@ -17,6 +17,11 @@ the static exchanges/step + redundant-compute accounting
 ``--members B`` adds the batched ensemble stepper's member-steps/s and
 the batched-exchange payload/ppermute accounting
 (:func:`jaxstream.utils.comm_probe.batched_exchange_plan`).
+``--strip-dtype bf16`` (round 10) re-bills the PLAN accounting at
+2 bytes per exchanged strip element — the wire-byte savings a 16-bit
+strips policy banks (``jaxstream.ops.pallas.precision``).  Measured
+latencies still ship f32 strips (the sharded steppers run f32
+numerics); the plans tag the savings explicitly.
 
 Device selection: uses the DEFAULT platform's devices when at least 6
 exist (a real slice measures real ICI); otherwise falls back to 6
@@ -42,12 +47,14 @@ def main():
     steps = 30
     temporal_block = 0
     members = 0
+    strip_dtype = "f32"
     as_json = "--json" in args
     for i, a in enumerate(args):
         if a in ("--iters", "--steps", "--temporal-block", "--members"):
             if i + 1 >= len(args) or not args[i + 1].isdigit():
                 print(f"usage: comm_probe.py [n] [--iters K] [--steps K] "
-                      f"[--temporal-block K] [--members B] [--json] "
+                      f"[--temporal-block K] [--members B] "
+                      f"[--strip-dtype f32|bf16] [--json] "
                       f"({a} needs an integer value)",
                       file=sys.stderr)
                 raise SystemExit(2)
@@ -59,13 +66,20 @@ def main():
                 members = int(args[i + 1])
             else:
                 temporal_block = int(args[i + 1])
+        elif a == "--strip-dtype":
+            if i + 1 >= len(args) or args[i + 1] not in ("f32", "bf16"):
+                print("usage: comm_probe.py ... --strip-dtype f32|bf16",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            strip_dtype = args[i + 1]
 
+    from jaxstream.ops.pallas.precision import strip_dtype_bytes
     from jaxstream.utils import comm_probe
 
-    result = comm_probe.run_default_probe(iters=iters, steps=steps,
-                                          n=n_arg,
-                                          temporal_block=temporal_block,
-                                          members=members)
+    result = comm_probe.run_default_probe(
+        iters=iters, steps=steps, n=n_arg,
+        temporal_block=temporal_block, members=members,
+        strip_dtype_bytes=strip_dtype_bytes(strip_dtype))
     if as_json:
         print(json.dumps(result))
     else:

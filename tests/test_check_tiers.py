@@ -120,3 +120,25 @@ def test_obs_importing_module_with_slow_marker_detected(tmp_path):
         "@pytest." + "mark.slow\n"
         "def test_b():\n    pass\n")
     assert check_tiers.main(str(tmp_path)) == 0
+
+
+def test_precision_module_with_slow_marker_detected(tmp_path):
+    """Rule 5 (round-10 satellite): precision-parity tests stay tier-1
+    — a module importing jaxstream.ops.pallas.precision must carry no
+    slow markers (the policy-off bitwise / truncation-budget parities
+    are what certify the ladder between offline TPU bench runs)."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_p.py").write_text(
+        "import pytest\n"
+        "from jaxstream.ops.pallas.precision import encode_strips\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module without the marker is clean.
+    (tests / "test_p.py").write_text(
+        "from jaxstream.ops.pallas import precision\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0

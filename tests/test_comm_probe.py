@@ -99,3 +99,46 @@ def test_format_report_includes_temporal_block_lines():
     assert "temporal_block=7.5 (x1.500)" in rep
     assert "exchanges/step=2.00" in rep
     assert "redundant_compute=" in rep
+    # f32 strips: no savings line.
+    assert "16-bit strips" not in rep
+
+
+def test_strip_dtype_wire_byte_accounting():
+    """Round-10 satellite: the plans re-bill wire bytes when the
+    exchanged strips ride a 16-bit policy — elements invariant, bytes
+    halved, savings fraction reported and formatted."""
+    from jaxstream.ops.pallas.precision import strip_dtype_bytes
+    from jaxstream.utils.comm_probe import batched_exchange_plan
+
+    n, halo, k = 96, 2, 4
+    p32 = temporal_block_plan(n, halo, k)
+    p16 = temporal_block_plan(n, halo, k,
+                              strip_dtype_bytes=strip_dtype_bytes("bf16"))
+    assert p32["strip_dtype_bytes"] == 4
+    assert p32["wire_bytes_saving_vs_f32"] == 0.0
+    assert p16["strip_dtype_bytes"] == 2
+    # Element counts are dtype-independent; bytes halve exactly.
+    assert p16["payload_elems_per_step"] == p32["payload_elems_per_step"]
+    assert p16["payload_bytes_per_step"] == pytest.approx(
+        0.5 * p32["payload_bytes_per_step"])
+    assert p16["wire_bytes_saving_vs_f32"] == pytest.approx(0.5)
+
+    b32 = batched_exchange_plan(n, halo, members=4)
+    b16 = batched_exchange_plan(n, halo, members=4, dtype_bytes=2)
+    assert b16["payload_bytes_per_ppermute"] == pytest.approx(
+        0.5 * b32["payload_bytes_per_ppermute"])
+    assert b16["wire_bytes_per_member_step"] == pytest.approx(
+        0.5 * b32["wire_bytes_per_member_step"])
+    assert b16["wire_bytes_saving_vs_f32"] == pytest.approx(0.5)
+
+    # plan_only threads the CLI's --strip-dtype bytes into BOTH plans.
+    out = run_default_probe(devices=[FakeDev("cpu")] * 6, plan_only=True,
+                            temporal_block=2, members=4,
+                            strip_dtype_bytes=2)
+    assert out["temporal_block_plan"]["strip_dtype_bytes"] == 2
+    assert out["batched_exchange_plan"]["strip_dtype_bytes"] == 2
+
+    rep = format_report({"platform": "cpu",
+                         "temporal_block_plan": p16,
+                         "batched_exchange_plan": b16})
+    assert rep.count("16-bit strips: -50% wire") == 2

@@ -1,0 +1,364 @@
+"""Round-10 precision ladder: reduced precision IN the stage kernels.
+
+``mixed16`` used to be a carry STORAGE encoding only — every arithmetic
+op still ran f32.  Round 10 moves bf16 into the stage arithmetic itself
+(flux face-averages, PLR limiter algebra, router rotations; f32
+accumulators and metric terms — jaxstream/ops/pallas/precision.py is
+the one definition of the op split) and re-fuses the split del^4
+filter into the stage-1 kernel.  This module pins:
+
+* policy-off is BITWISE the historical path (the factories take the
+  ``precision is None`` fast path);
+* the bf16-stage truncation budgets, measured like PR 2's deep-halo
+  budgets (C24/C32 TC2, 8 steps, dt CFL-matched across grids):
+  h 1.4e-3 / 1.1e-3 rel, u 6.4e-3 / 6.1e-3 rel, mass drift 3.4e-7 —
+  mass stays at f32 roundoff BY CONSTRUCTION (the router's symmetrized
+  edge value is rounded once and shared by both faces, so cross-seam
+  flux equality survives any strips dtype);
+* the re-fused del^4 stepper vs the split form (filter commuted from
+  step-end into stage 1: trajectories differ by endpoint filter
+  applications only — measured 3.7e-7 h / 1.0e-6 u rel at C16 Galewsky
+  3 steps; day-6 physics equivalence at C384 is bench_galewsky's gate);
+* composition: temporal_block (bitwise vs k single calls), ensemble
+  member-axis kernels, donation (dtype-stable carry), and the
+  sharded-tier rejection with its pointer;
+* the ``precision:`` config block end to end through Simulation,
+  including the mixed16 carry decode at segment exits.
+
+All interpret-mode (this host has no TPU); kernel-compile cost
+dominates, so grids are tiny and steppers are shared within tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jaxstream.config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+from jaxstream.geometry.cubed_sphere import build_grid
+from jaxstream.models.shallow_water_cov import CovariantShallowWater
+from jaxstream.ops.pallas.precision import (StagePrecision, encode_strips,
+                                            resolve_stage_precision,
+                                            strip_dtype_bytes)
+from jaxstream.physics.initial_conditions import (galewsky, williamson_tc2,
+                                                  williamson_tc5)
+
+
+def _model(n, case="tc2", nu4=0.0, halo=2):
+    grid = build_grid(n, halo=halo, radius=EARTH_RADIUS, dtype=jnp.float32)
+    if case == "tc2":
+        h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+        b_ext = None
+    elif case == "tc5":
+        h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY,
+                                             EARTH_OMEGA)
+    else:
+        h_ext, v_ext = galewsky(grid, EARTH_GRAVITY, EARTH_OMEGA)
+        b_ext = None
+    m = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                              omega=EARTH_OMEGA, b_ext=b_ext, nu4=nu4,
+                              backend="pallas_interpret")
+    return grid, m, m.initial_state(h_ext, v_ext)
+
+
+def _mass(grid, h):
+    area = np.asarray(grid.interior(grid.area), np.float64)
+    return float((area * np.asarray(h, np.float64)).sum())
+
+
+def _rel(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    return float(np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-300))
+
+
+# ---------------------------------------------------------------- units
+
+def test_resolve_policy_semantics():
+    # Off spellings all collapse to None — the factories' bitwise path.
+    for off in (None, "f32", "off", "none", "", StagePrecision(),
+                {"stage": "f32"}, {"stage": "f32", "strips": "auto"}):
+        assert resolve_stage_precision(off) is None, off
+    pol = resolve_stage_precision("bf16")
+    assert pol == StagePrecision(compute="bf16", strips="bf16")
+    assert pol.compute_dtype == jnp.bfloat16
+    # Mapping form: 'strips: auto' follows the compute policy; the two
+    # knobs are independent otherwise.
+    assert (resolve_stage_precision({"stage": "bf16"})
+            == StagePrecision("bf16", "bf16"))
+    assert (resolve_stage_precision({"stage": "bf16", "strips": "f32"})
+            == StagePrecision("bf16", "f32"))
+    assert (resolve_stage_precision({"compute": "f32", "strips": "bf16"})
+            == StagePrecision("f32", "bf16"))
+    # Resolution is idempotent.
+    assert resolve_stage_precision(pol) == pol
+    with pytest.raises(ValueError, match="unknown precision policy"):
+        resolve_stage_precision("fp8")
+    # A misspelled dict key must fail loudly, never resolve to f32-off.
+    with pytest.raises(ValueError, match="unknown precision keys"):
+        resolve_stage_precision({"stages": "bf16"})
+    with pytest.raises(ValueError, match="compute must be"):
+        StagePrecision(compute="f16")
+    with pytest.raises(TypeError, match="precision must be"):
+        resolve_stage_precision(16)
+
+
+def test_encode_strips_and_wire_bytes():
+    f32 = jnp.float32
+    y = {"h": jnp.ones((6, 4, 4), f32), "u": jnp.ones((2, 6, 4, 4), f32),
+         "strips_sn": jnp.ones((6, 2, 2, 3, 4), f32),
+         "strips_we": jnp.ones((6, 2, 2, 3, 4), f32)}
+    off = encode_strips(y, None)
+    assert off is y                       # identity, not a copy
+    enc = encode_strips(y, "bf16")
+    assert enc["strips_sn"].dtype == jnp.bfloat16
+    assert enc["strips_we"].dtype == jnp.bfloat16
+    assert enc["h"].dtype == jnp.float32  # h/u are the carry's business
+    assert enc["u"].dtype == jnp.float32
+    # Wire accounting hook (comm_probe / bench).
+    assert strip_dtype_bytes(None) == 4
+    assert strip_dtype_bytes("f32") == 4
+    assert strip_dtype_bytes("bf16") == 2
+    assert strip_dtype_bytes({"stage": "bf16", "strips": "f32"}) == 4
+
+
+def test_analytic_cost_precision_knobs():
+    from jaxstream.utils.profiling import (TPU_V5E_VPU, TPU_V5E_VPU_BF16,
+                                           analytic_cov_step_cost,
+                                           mixed_vpu_roof)
+
+    base = analytic_cov_step_cost(96)
+    c16 = analytic_cov_step_cost(96, carry_bytes=2)
+    # 16-bit carry: fewer bytes -> higher AI, flops unchanged; but the
+    # orography re-read stays f32, so the corrected model saves LESS
+    # than the old coarse bytes*0.5 (which overstated AI).
+    assert c16["flops"] == base["flops"]
+    assert c16["ai"] > base["ai"]
+    assert c16["bytes"] > 0.5 * base["bytes"]
+    # nu4 placement: identical filter arithmetic, different traffic —
+    # the split form's standalone kernel pays ~6 extra field passes,
+    # the re-fused form 3.
+    sp = analytic_cov_step_cost(96, nu4="split")
+    rf = analytic_cov_step_cost(96, nu4="refused")
+    assert sp["flops"] == rf["flops"] > base["flops"]
+    assert base["bytes"] < rf["bytes"] < sp["bytes"]
+    # bf16 stage policy re-types ops, it does not remove them.
+    bf = analytic_cov_step_cost(96, precision="bf16")
+    assert bf["flops"] == base["flops"]
+    assert bf["bytes"] == base["bytes"]
+    assert 0.0 < bf["bf16_flop_fraction"] < 0.5
+    assert base["bf16_flop_fraction"] == 0.0
+    # Mixed roof: harmonic blend between the f32 and bf16 roofs.
+    assert mixed_vpu_roof(0.0).peak_tflops == TPU_V5E_VPU.peak_tflops
+    assert mixed_vpu_roof(1.0).peak_tflops == pytest.approx(
+        TPU_V5E_VPU_BF16.peak_tflops)
+    phi = bf["bf16_flop_fraction"]
+    blend = mixed_vpu_roof(phi).peak_tflops
+    linear = ((1 - phi) * TPU_V5E_VPU.peak_tflops
+              + phi * TPU_V5E_VPU_BF16.peak_tflops)
+    assert TPU_V5E_VPU.peak_tflops < blend < linear
+    with pytest.raises(ValueError, match="bf16_fraction"):
+        mixed_vpu_roof(1.5)
+    with pytest.raises(ValueError, match="precision must be"):
+        analytic_cov_step_cost(96, precision="fp8")
+
+
+def test_sharded_tier_rejects_stage_policy():
+    """The classic/sharded tiers run f32 numerics: a non-f32 policy is
+    rejected with the pointer, never silently ignored — and the off
+    policy passes through to the resolve without touching the model."""
+    from jaxstream.parallel.sharded_model import make_stepper_for
+
+    with pytest.raises(ValueError, match="comm_probe.py --strip-dtype"):
+        make_stepper_for(None, None, {}, 60.0, precision="bf16")
+    with pytest.raises(ValueError, match="single-device"):
+        make_stepper_for(None, None, {}, 60.0,
+                         precision={"stage": "f32", "strips": "bf16"})
+
+
+def test_config_precision_block():
+    from jaxstream.config import Config, load_config
+
+    cfg = load_config("precision:\n  stage: bf16\n  carry: mixed16\n")
+    assert cfg.precision.stage == "bf16"
+    assert cfg.precision.strips == "auto"
+    assert cfg.precision.carry == "mixed16"
+    assert Config().precision.stage == "f32"          # default off
+    with pytest.raises(ValueError, match="unknown"):
+        load_config("precision:\n  stag: bf16\n")
+
+
+# ------------------------------------------------------- parity budgets
+
+def test_policy_off_bitwise_and_bf16_budget_c24():
+    """One C24 TC2 trajectory serves both pins: precision='f32' (and
+    the dict spelling) is BITWISE the default stepper, and the bf16
+    stage policy lands inside the measured truncation budget
+    (8 steps dt=300: h 1.37e-3, u 6.4e-3 rel; mass 3.4e-7 — budgets
+    2-3x the measurement, like PR 2's deep-halo pins)."""
+    n, dt, steps = 24, 300.0, 8
+    grid, m, state = _model(n, "tc2")
+    y0 = m.compact_state(state)
+    s_ref = m.make_fused_step(dt)
+    s_off = m.make_fused_step(dt, precision={"stage": "f32",
+                                             "strips": "auto"})
+    s_bf = m.make_fused_step(dt, precision="bf16")
+    y, yo = dict(y0), dict(y0)
+    yb = encode_strips(dict(y0), "bf16")
+    assert yb["strips_sn"].dtype == jnp.bfloat16
+    for _ in range(steps):
+        y = s_ref(y, 0.0)
+        yo = s_off(yo, 0.0)
+        yb = s_bf(yb, 0.0)
+    for k in y:
+        assert bool(jnp.all(y[k] == yo[k])), f"policy-off not bitwise: {k}"
+    hb = yb["h"].astype(jnp.float32)
+    relh = _rel(y["h"], hb)
+    relu = _rel(y["u"], yb["u"].astype(jnp.float32))
+    assert relh < 4e-3, relh
+    assert relu < 2e-2, relu
+    # The policy must PROVABLY engage: bf16 quantization is visible.
+    assert relh > 1e-5, "bf16 stage policy did not quantize anything"
+    # Mass at f32 roundoff — the once-rounded shared seam value.
+    m0 = _mass(grid, state["h"])
+    assert abs(_mass(grid, hb) - m0) / abs(m0) < 1e-5
+
+
+def test_bf16_stage_budget_c32():
+    """The C32 rung of the budget ladder (dt CFL-matched at 225 s):
+    measured h 1.08e-3 / u 6.1e-3 rel, mass 3.4e-7 — the h budget does
+    NOT grow with resolution (the bf16 ops quantize local corrections,
+    not cell values; DESIGN.md 'Precision ladder')."""
+    n, dt, steps = 32, 225.0, 8
+    grid, m, state = _model(n, "tc2")
+    y0 = m.compact_state(state)
+    s_ref = m.make_fused_step(dt)
+    s_bf = m.make_fused_step(dt, precision="bf16")
+    y = dict(y0)
+    yb = encode_strips(dict(y0), "bf16")
+    for _ in range(steps):
+        y = s_ref(y, 0.0)
+        yb = s_bf(yb, 0.0)
+    hb = yb["h"].astype(jnp.float32)
+    assert _rel(y["h"], hb) < 4e-3
+    assert _rel(y["u"], yb["u"].astype(jnp.float32)) < 2e-2
+    m0 = _mass(grid, state["h"])
+    assert abs(_mass(grid, hb) - m0) / abs(m0) < 1e-5
+
+
+def test_refused_nu4_matches_split():
+    """Re-fused del^4 vs the split reference on the Galewsky jet: the
+    filter commutes from step-end into stage 1, so k-step trajectories
+    differ by endpoint filter applications only — O(damp) on the
+    endpoints, measured 3.7e-7 h / 1.0e-6 u rel (C16, 3 steps,
+    nu4=1e15).  Mass stays at f32 roundoff (flux-form filter).  The
+    full-resolution equivalence claim is re-proven by bench_galewsky's
+    day-6 physics gate on the refused line every bench run."""
+    n, dt, steps = 16, 300.0, 3
+    grid, m, state = _model(n, "galewsky", nu4=1.0e15)
+    y0 = m.compact_state(state)
+    s_sp = m.make_fused_step(dt, nu4_mode="split")
+    s_rf = m.make_fused_step(dt, nu4_mode="refused")
+    ys, yr = dict(y0), dict(y0)
+    for _ in range(steps):
+        ys = s_sp(ys, 0.0)
+        yr = s_rf(yr, 0.0)
+    assert _rel(ys["h"], yr["h"]) < 1e-5
+    assert _rel(ys["u"], yr["u"]) < 1e-5
+    m0 = _mass(grid, state["h"])
+    assert abs(_mass(grid, yr["h"]) - m0) / abs(m0) < 1e-6
+    # The refused stepper is 3 kernels + 3 routes; its blocked form
+    # exposes the contract integrators rely on.
+    s_b = m.make_fused_step(dt, nu4_mode="refused", temporal_block=2)
+    assert s_b.steps_per_call == 2
+    with pytest.raises(ValueError, match="parity oracle"):
+        m.make_fused_step(dt, nu4_mode="stage", precision="bf16")
+
+
+# ---------------------------------------------------------- composition
+
+def test_bf16_composes_with_blocking_ensemble_donation():
+    """The policy threads through the EXISTING factories, so it must
+    compose rather than fork: temporal_block k=2 is bitwise two single
+    bf16 steps (exact fusion — same kernels, same order), the batched
+    ensemble kernel advances each member to the vmapped-reference
+    values (<= 1e-6 rel, PR 3's B>1 XLA-refusion band — jit-vs-eager
+    of the SAME bf16 step measures 6.3e-8 on u), and a donated jit
+    carry round-trips with stable dtypes (bf16 strips in == out, the
+    aliasing precondition)."""
+    n, dt = 12, 600.0
+    grid, m, state = _model(n, "tc5")
+    y0 = m.compact_state(state)
+    s1 = m.make_fused_step(dt, precision="bf16")
+
+    # temporal blocking: bitwise exact fusion.
+    s2 = m.make_fused_step(dt, precision="bf16", temporal_block=2)
+    assert s2.steps_per_call == 2
+    ya = encode_strips(dict(y0), "bf16")
+    for _ in range(2):
+        ya = s1(ya, 0.0)
+    yb = s2(encode_strips(dict(y0), "bf16"), 0.0)
+    for k in ya:
+        assert bool(jnp.all(ya[k] == yb[k])), f"temporal_block broke {k}"
+
+    # ensemble member axis: B=2 through the batched stage kernels.
+    sB = m.make_fused_step(dt, ensemble=2, ensemble_impl="kernel",
+                           precision="bf16")
+    batched = m.ensemble_compact_state(m.stack_ensemble([state, state]))
+    zB = sB(encode_strips(batched, "bf16"), 0.0)
+    z1 = s1(encode_strips(dict(y0), "bf16"), 0.0)
+    for i in range(2):
+        relh = _rel(z1["h"].astype(jnp.float32),
+                    zB["h"][i].astype(jnp.float32))
+        relu = _rel(z1["u"].astype(jnp.float32),
+                    zB["u"][:, i].astype(jnp.float32))
+        assert relh <= 1e-6 and relu <= 1e-6, (i, relh, relu)
+
+    # donation: dtype-stable carry, donated jit matches eager at the
+    # XLA-refusion band.
+    yin = encode_strips(dict(y0), "bf16")
+    in_dtypes = {k: v.dtype for k, v in yin.items()}
+    yj = jax.jit(s1, donate_argnums=0)(yin, 0.0)
+    assert {k: v.dtype for k, v in yj.items()} == in_dtypes
+    assert _rel(z1["h"].astype(jnp.float32),
+                yj["h"].astype(jnp.float32)) <= 1e-6
+    assert _rel(z1["u"].astype(jnp.float32),
+                yj["u"].astype(jnp.float32)) <= 1e-6
+
+
+def test_simulation_precision_config_end_to_end():
+    """The ``precision:`` block through Simulation: bf16 stage policy +
+    mixed16 carry storage stack on the fused stepper; segment exits
+    decode to absolute f32 (history/diagnostics/metrics contract);
+    mass holds to the mixed16 quantization band.  Ensembles reject
+    carry encodings with the pointer."""
+    from jaxstream.simulation import Simulation
+
+    cfg = {
+        "grid": {"n": 12, "halo": 2},
+        "model": {"name": "shallow_water_cov",
+                  "initial_condition": "tc5",
+                  "backend": "pallas_interpret"},
+        "time": {"dt": 600.0, "nsteps": 4},
+        "parallelization": {"num_devices": 1, "device_type": "cpu"},
+        "precision": {"stage": "bf16", "carry": "mixed16"},
+        "io": {},
+    }
+    sim = Simulation(cfg)
+    assert sim._fused_step is not None, \
+        "precision block must ride the fused stepper or raise"
+    m0 = sim.diagnostics()["mass"]
+    sim.run()
+    assert sim.step_count == 4
+    h = np.asarray(sim.state["h"])
+    assert h.dtype == np.float32          # decoded at the segment exit
+    assert np.all(np.isfinite(h))
+    # mixed16 h quanta are 1/16 m about the mid-range offset on a
+    # ~5-6 km field: per-sample rel ~1e-5 (measured drift 3.7e-5 over
+    # 4 steps); the band is bench's mixed16 mass gate, 1e-3.
+    assert abs(sim.diagnostics()["mass"] - m0) / abs(m0) < 1e-3
+
+    bad = dict(cfg)
+    bad["ensemble"] = {"members": 2}
+    with pytest.raises(ValueError, match="members: 1"):
+        Simulation(bad)
