@@ -28,8 +28,13 @@ B-scaled rooflines and the B=1 bitwise acceptance check.  The ``io``
 field (round 9) reports the async-host-pipeline section
 (``bench_io``): steps/s with history+checkpoint+telemetry on, async
 vs sync, against the io-off baseline, plus the per-mode
-``host_wait_s`` totals from the runs' own telemetry.  ``python
-bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
+``host_wait_s`` totals from the runs' own telemetry.  The ``serving``
+field (round 11) reports the continuous-batching ensemble server
+section (``bench_serving``): packed heterogeneous-run-length traffic
+vs serial B=1 aggregate sim-days/sec/chip, slot occupancy, request
+latency p50/p99, warmup compile count and the zero-steady-state-
+recompile check, plus the >= 0.9x floor vs the static-B=16 ensemble
+rate.  ``python bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
 wired into tier-1 via tests/test_bench_smoke.py); ``python bench.py
 --compile-report`` prints cold-vs-warm compile seconds for the
 ``JAXSTREAM_COMPILE_CACHE`` persistent-cache opt-in; ``python bench.py
@@ -993,6 +998,127 @@ def bench_ensemble(n=96, dt=300.0, members=(1, 4, 16), warm=6,
     return out
 
 
+def bench_serving(n=96, dt=300.0, bucket=16, n_requests=48, seg=8,
+                  backend="pallas", lengths=None, ic="tc5", gates=True):
+    """Serving section: continuous batching vs serial B=1 (round 11).
+
+    The throughput headline of the ensemble server (jaxstream.serve):
+    ``n_requests`` heterogeneous-run-length scenario requests (same IC
+    family, distinct perturbation seeds, lengths cycling a ragged
+    ladder so members finish mid-batch and slots refill continuously)
+    are served twice —
+
+      * **packed**: one bucket of size ``bucket`` — requests ride the
+        member axis, per-member masking + boundary refill keep the
+        slots busy;
+      * **serial_B1**: the same trace through a B=1 bucket — the
+        no-batching reference every request-at-a-time deployment runs.
+
+    Reports per mode: aggregate member-steps/s and sim-days/sec/chip
+    (the serving metric), slot occupancy and step utilization, request
+    latency p50/p99 (requests are all admitted up front, so the serial
+    tail latency IS the queue wait the packed mode removes), warmup
+    compile count, and the steady-state recompile count (must be 0 —
+    the shape-bucketing claim).  ``main()`` divides the packed
+    member-steps/s by the ensemble section's static-B=16 rate: the
+    acceptance floor is >= 0.9x (masking + refill overhead must stay
+    under 10% of the PR-3 batched rate).  Warmup/compile time is
+    excluded from the timed window (steady-state serving).  Never
+    raises (returns ``{"skipped": ...}``).
+    """
+    try:
+        from jaxstream.serve import EnsembleServer, ScenarioRequest
+
+        if lengths is None:
+            lengths = (seg * 3, seg * 5 + 3, seg * 2 + 1, seg * 7,
+                       seg * 4 + 5)
+        out = {"n": n, "dt": dt, "bucket": bucket,
+               "n_requests": n_requests, "segment_steps": seg,
+               "ic": ic, "lengths": list(lengths)}
+        group = "oro" if ic == "tc5" else "flat"
+
+        def mk_requests():
+            return [ScenarioRequest(
+                id=f"r{i}", ic=ic, nsteps=lengths[i % len(lengths)],
+                seed=i, amplitude=1e-3)
+                for i in range(n_requests)]
+
+        def run_mode(b):
+            cfg = {"grid": {"n": n, "halo": 2, "dtype": "float32"},
+                   "time": {"dt": dt},
+                   "model": {"name": "shallow_water_cov",
+                             "backend": backend},
+                   "serve": {"buckets": str(b), "segment_steps": seg,
+                             "queue_capacity": n_requests + 1}}
+            srv = EnsembleServer(cfg)
+            try:
+                srv.warmup(groups=(group,))       # compiles excluded
+                for r in mk_requests():
+                    srv.submit(r)
+                t0 = time.perf_counter()
+                srv.serve()
+                wall = time.perf_counter() - t0
+                lat = srv.latencies()
+                ms = srv.stats["member_steps"]
+                entry = {
+                    "completed": srv.stats["completed"],
+                    "evicted": srv.stats["evicted"],
+                    "segments": srv.stats["segments"],
+                    "refills": srv.stats["refills"],
+                    "occupancy_mean": round(srv.occupancy_mean, 4),
+                    "utilization_mean": round(srv.utilization_mean, 4),
+                    "member_steps": ms,
+                    "member_steps_per_sec": round(ms / wall, 2),
+                    "agg_sim_days_per_sec_per_chip": round(
+                        ms * dt / 86400.0 / wall, 4),
+                    "latency_p50_s": round(
+                        float(np.percentile(lat, 50)), 4),
+                    "latency_p99_s": round(
+                        float(np.percentile(lat, 99)), 4),
+                    "warmup_compiles": srv.stats["warmup_compiles"],
+                    "steady_recompiles": (
+                        srv.compile_count()
+                        - srv.stats["warmup_compiles"]),
+                    "impl": srv._impls.get(group),
+                    "wall_s": round(wall, 3),
+                }
+                if srv.stats["completed"] != n_requests:
+                    raise RuntimeError(
+                        f"serving B={b}: only {srv.stats['completed']}"
+                        f"/{n_requests} requests completed")
+                if gates:
+                    for r in srv.results.values():
+                        h = np.asarray(r.fields["h"], np.float64)
+                        if not (np.all(np.isfinite(h))
+                                and 3000.0 < h.min()
+                                and h.max() < 6500.0):
+                            raise RuntimeError(
+                                f"serving B={b}: request {r.id} gate "
+                                f"breached (h=[{h.min():.0f},"
+                                f"{h.max():.0f}])")
+                return entry
+            finally:
+                srv.close()
+
+        out["packed"] = run_mode(bucket)
+        out["serial_B1"] = run_mode(1)
+        p, s = (out["packed"]["member_steps_per_sec"],
+                out["serial_B1"]["member_steps_per_sec"])
+        out["packed_vs_serial"] = round(p / s, 4) if s else None
+        log(f"bench serving C{n} {ic} {n_requests} reqs "
+            f"(bucket {bucket}, seg {seg}): packed "
+            f"{p:.1f} member-steps/s (occ "
+            f"{out['packed']['occupancy_mean']:.2f}, p50/p99 "
+            f"{out['packed']['latency_p50_s']:.2f}/"
+            f"{out['packed']['latency_p99_s']:.2f}s, "
+            f"{out['packed']['steady_recompiles']} steady recompiles) "
+            f"vs serial-B1 {s:.1f} -> {out['packed_vs_serial']}x")
+        return out
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench serving: unavailable ({type(e).__name__}: {e})")
+        return {"skipped": f"{type(e).__name__}: {e}"}
+
+
 def bench_io(n=48, dt=600.0, nsteps=96, stride=12, warm=12, ic="tc2",
              gates=True):
     """IO-overlap section: history+telemetry cost, async vs sync vs off.
@@ -1349,6 +1475,15 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
     # still fire at steps 2 and 4).
     io_sec = bench_io(n=12, dt=dt, nsteps=2, stride=2, warm=2,
                       gates=False)
+    # Serving canary (round 11): the continuous-batching server end to
+    # end at C16 — packing, per-member masking, boundary refill, the
+    # zero-steady-state-recompile bucket claim and the packed-vs-serial
+    # comparison all exercised through the REAL bench_serving code path
+    # (vmapped classic steppers; rates are smoke windows, NOT
+    # measurements).  Asserted by tests/test_bench_smoke.py.
+    serving = bench_serving(n=16, dt=dt, bucket=2, n_requests=4, seg=2,
+                            backend="jnp", lengths=(4, 7, 2, 5),
+                            ic="tc2", gates=False)
     # Precision-ladder canary: all four rows (f32 / bf16_stage /
     # mixed16_carry / stacked) through the REAL report code path in
     # interpret mode — structural coverage of the row builders, carry
@@ -1373,6 +1508,7 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
         "ok": bool(ok),
         "ensemble": ens,
         "io": io_sec,
+        "serving": serving,
         "precision_report": prec,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
@@ -1473,6 +1609,21 @@ def main():
     except Exception as e:  # never fail the headline metric on this
         log(f"bench ensemble: unavailable ({type(e).__name__}: {e})")
         ensemble = {"skipped": f"{type(e).__name__}: {e}"}
+    # Serving section (round 11): packed heterogeneous traffic through
+    # the continuous-batching server at the ensemble section's config
+    # (C96, dt=300, B=16).  The acceptance floor: the packed rate must
+    # recover >= 0.9x the static-B=16 ensemble rate measured above —
+    # masking + refill overhead under 10%.
+    serving = bench_serving()
+    if isinstance(ensemble, dict) and "packed" in serving:
+        msps = (ensemble.get("B16") or {}).get("member_steps_per_sec")
+        if msps:
+            ratio = serving["packed"]["member_steps_per_sec"] / msps
+            serving["vs_static_B16"] = round(ratio, 4)
+            serving["meets_0p9_floor"] = bool(ratio >= 0.9)
+            log(f"bench serving: packed/static-B16 = {ratio:.3f}x "
+                f"(floor 0.9: "
+                f"{'OK' if ratio >= 0.9 else 'BREACHED'})")
     try:
         vg, rg = bench_galewsky()
         # nu4='split': the re-derived 210 flops/cell/step filter count
@@ -1506,6 +1657,7 @@ def main():
         value = 0.0
         variants = {}
         ensemble = {"suppressed": "accuracy/stability gate breach"}
+        serving = {"suppressed": "accuracy/stability gate breach"}
     # dt is part of the metric's definition (sim-days/sec = steps/s * dt);
     # emit it top-level, with the dt=60-equivalent rate adjacent, so
     # cross-round comparisons of `value` are self-describing.
@@ -1523,6 +1675,17 @@ def main():
                             "value": v["sim_days_per_sec"],
                             "unit": "sim-days/sec/chip",
                             "steps_per_sec": v.get("steps_per_sec")})
+        if isinstance(serving, dict) and "packed" in serving:
+            p = serving["packed"]
+            sink.write({
+                "kind": "bench", "metric": "serving_packed",
+                "value": p["agg_sim_days_per_sec_per_chip"],
+                "unit": "aggregate sim-days/sec/chip",
+                "member_steps_per_sec": p["member_steps_per_sec"],
+                "occupancy_mean": p["occupancy_mean"],
+                "latency_p50_s": p["latency_p50_s"],
+                "latency_p99_s": p["latency_p99_s"],
+                "vs_static_B16": serving.get("vs_static_B16")})
         sink.close()
     print(json.dumps({
         "metric": "sim_days_per_sec_per_chip_TC5_C384",
@@ -1535,6 +1698,7 @@ def main():
                      if value > 0 else None),
         "variants": variants,
         "ensemble": ensemble,
+        "serving": serving,
         "io": io_section,
         "multichip": multichip,
     }))

@@ -27,6 +27,7 @@ __all__ = [
     "EnsembleConfig",
     "ObservabilityConfig",
     "PrecisionConfig",
+    "ServeConfig",
     "Config",
     "load_config",
 ]
@@ -225,6 +226,56 @@ class PrecisionConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Continuous-batching ensemble server (``jaxstream.serve``, round
+    11) — scenario requests packed into the member axis the way LLM
+    servers pack prompts into a batch.  The server keeps one compiled
+    masked-segment stepper warm per batch-size bucket (steady-state
+    serving triggers ZERO recompiles once the bucket set is warm;
+    ``JAXSTREAM_COMPILE_CACHE`` makes the warmup itself cheap across
+    restarts) and refills a finished member's slot from the bounded
+    request queue at the next segment boundary (docs/USAGE.md
+    "Serving")."""
+    # Comma-separated batch-size buckets.  A batch's size is the
+    # smallest bucket >= the number of packable requests, so the whole
+    # serving life of a deployment compiles len(buckets) segment
+    # steppers per scenario group and nothing else.
+    buckets: str = "1,4,16"
+    # Steps per compiled masked segment — the refill granularity: a
+    # finished member idles at most segment_steps - 1 steps before its
+    # slot is refilled.  Smaller = tighter packing, more host
+    # boundaries.
+    segment_steps: int = 8
+    # Bounded request queue (admission control): submit raises
+    # QueueFull at capacity instead of buffering unboundedly.
+    queue_capacity: int = 64
+    # Per-request zarr result stores are written under this directory
+    # (streamed through the async BackgroundWriter); '' = results are
+    # only retained in memory (server.results).
+    output_dir: str = ""
+    # Serving telemetry JSONL (obs.sink format: 'serve' records with
+    # slot occupancy + queue depth); '' = none.
+    sink: str = ""
+    # On a member's nonfinite state: 'evict' (default — fail only that
+    # request, refill the slot, keep the batch alive), 'halt' (raise,
+    # stopping the server), 'off' (no per-member guard).
+    guards: str = "evict"
+    # Admission control driven by the HealthMonitor: once this many
+    # guard events have been recorded the server refuses NEW requests
+    # (AdmissionRefused) — a deployment that keeps blowing up members
+    # should fail fast, not accept more traffic.  0 disables.
+    max_guard_events: int = 16
+    # Testing hook (pairs with observability.fault_step): mark this
+    # member's health count bad when its own step count reaches
+    # fault_step — injected into the monitor STREAM on the host, never
+    # the state — so the evict->refill path is testable without
+    # integrating a real blowup.  -1 = disabled.
+    fault_member: int = -1
+    # Donate the segment carry (XLA aliases input/output state).
+    donate: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grid: GridConfig = GridConfig()
     parallelization: ParallelConfig = ParallelConfig()
@@ -235,6 +286,7 @@ class Config:
     ensemble: EnsembleConfig = EnsembleConfig()
     observability: ObservabilityConfig = ObservabilityConfig()
     precision: PrecisionConfig = PrecisionConfig()
+    serve: ServeConfig = ServeConfig()
 
 
 _SECTIONS = {
@@ -247,6 +299,7 @@ _SECTIONS = {
     "ensemble": EnsembleConfig,
     "observability": ObservabilityConfig,
     "precision": PrecisionConfig,
+    "serve": ServeConfig,
 }
 
 

@@ -35,17 +35,30 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: Dict[str, Any], t: float) -> None:
+    def save(self, step: int, state: Dict[str, Any], t: float,
+             meta: Optional[Dict[str, Any]] = None) -> None:
         """Save one ``(state, t)`` pair (blocking until durable).
 
         ``state`` leaves may be device arrays (the synchronous loop) or
         host numpy arrays (the async pipeline saves the already-fetched
         boundary snapshot — the restored values are identical either
-        way).  The manager is NOT thread-safe; all callers serialize
+        way).  ``meta``: optional small NUMERIC mapping stored beside
+        the state (round 11: the postmortem path records the offending
+        ensemble member id here); ``None``-valued and non-numeric
+        entries are dropped — Orbax's StandardSave handles scalars and
+        arrays only, so a string would fail the whole save.
+        The manager is NOT thread-safe; all callers serialize
         through one thread at a time — under the async pipeline that is
         the background writer's FIFO, and the postmortem path drains it
         before saving inline."""
         payload = {"state": state, "t": float(t)}
+        if meta:
+            meta = {k: int(v) if isinstance(v, bool) else v
+                    for k, v in meta.items()
+                    if isinstance(v, (bool, int, float,
+                                      np.integer, np.floating))}
+            if meta:
+                payload["meta"] = meta
         self.mgr.save(step, args=self._ocp.args.StandardSave(payload))
         self.mgr.wait_until_finished()
 
@@ -77,3 +90,30 @@ class CheckpointManager:
         out = self.mgr.restore(step,
                                args=self._ocp.args.StandardRestore())
         return out["state"], float(np.asarray(out["t"]))
+
+    def restore_meta(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """The ``meta`` mapping saved with a checkpoint ({} if none) —
+        e.g. the postmortem record's offending member id."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.path}")
+        out = self.mgr.restore(step,
+                               args=self._ocp.args.StandardRestore())
+        meta = out.get("meta") or {}
+        return {k: (v.item() if hasattr(v, "item") else v)
+                for k, v in meta.items()}
+
+    def restore_member(self, i: int, step: Optional[int] = None):
+        """Member ``i``'s ``(state, t)`` out of a member-batched
+        checkpoint — the per-member extraction (round 11) that lets a
+        single scenario resume from an ensemble run's save.  The
+        returned field shapes are exactly what a B=1 run checkpoints
+        (byte-comparable)."""
+        from .history import extract_member
+
+        state, t = self.restore_host(step)
+        h = np.asarray(state.get("h", next(iter(state.values()))))
+        if h.ndim < 4:
+            raise ValueError(
+                "checkpoint state is not member-batched; use restore()")
+        return extract_member(state, i), t

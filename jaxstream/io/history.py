@@ -22,8 +22,43 @@ import numpy as np
 from ..geometry.cubed_sphere import CubedSphereGrid
 from .zarrlite import ZarrGroup, open_group
 
-__all__ = ["HistoryWriter", "geometry_matches", "save_geometry",
-           "load_geometry_arrays"]
+__all__ = ["HistoryWriter", "extract_member", "geometry_matches",
+           "member_axis", "save_geometry", "load_geometry_arrays"]
+
+
+def member_axis(a) -> int:
+    """Member-axis position of a member-batched cubed-sphere field.
+
+    Panel fields end in ``(6, n, n)``; the ensemble layout rule
+    (``ENSEMBLE_STATE_AXES``) puts the member axis directly before
+    those, after any leading component/record axes — so the member axis
+    is ``ndim - 4`` for scalar fields ``(B, 6, n, n)``, vector fields
+    ``(c, B, 6, n, n)``, and their record-stacked history forms
+    ``(T, B, 6, n, n)`` / ``(T, c, B, 6, n, n)`` alike.  Only valid on
+    member-BATCHED arrays (an unbatched vector field has the same rank
+    as a batched scalar — callers must know the state is batched, e.g.
+    from ``Simulation.members`` or the store's ``members`` attr).
+    """
+    ax = np.ndim(a) - 4
+    if ax < 0:
+        raise ValueError(
+            f"array of rank {np.ndim(a)} is too small to be a "
+            "member-batched panel field (needs >= (B, 6, n, n))")
+    return ax
+
+
+def extract_member(state: Dict, i: int) -> Dict:
+    """Member ``i``'s fields out of a member-batched state dict.
+
+    The inverse of the stacking in ``stack_ensemble`` /
+    ``Simulation._build_ensemble_state`` — the per-member extraction
+    the ensemble history/checkpoint path rides (round 11): each value
+    is sliced on its :func:`member_axis`, so the result has exactly the
+    shapes an unbatched (B=1) run writes and can be byte-compared
+    against one.
+    """
+    return {k: np.take(np.asarray(v), i, axis=member_axis(v))
+            for k, v in state.items()}
 
 
 class HistoryWriter:
@@ -145,6 +180,30 @@ class HistoryWriter:
             B = self.group[name + "__ttB"].read()[:self._len]
             return np.einsum("...ir,...rj->...ij", A, B)
         raise KeyError(name)
+
+    def read_member(self, name: str, i: int) -> np.ndarray:
+        """Read ONE ensemble member's record axis of a batched field.
+
+        Generalizes the old member-0-only story (ensemble runs used to
+        reject history outright): the store's ``members`` attr — which
+        ``Simulation`` stamps on every history store — marks the fields
+        as member-batched, and the member axis of the record-stacked
+        array follows the :func:`member_axis` rule.  The returned array
+        has the exact shapes an unbatched run's :meth:`read` produces
+        (byte-comparable against a B=1 run of the same member).
+        """
+        members = self.group.attrs.get("members") or 0
+        if members < 2:
+            raise ValueError(
+                f"store {self.group.path!r} is not member-batched "
+                f"(members attr {members!r}); use read()")
+        a = self.read(name)
+        ax = member_axis(a)
+        if not 0 <= i < a.shape[ax]:
+            raise IndexError(
+                f"member {i} out of range for {name!r} with "
+                f"{a.shape[ax]} members")
+        return np.take(a, i, axis=ax)
 
     @property
     def times(self) -> np.ndarray:

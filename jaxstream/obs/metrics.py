@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from ..utils import diagnostics as diag
 
 __all__ = ["MetricSpec", "METRICS", "MetricSet", "build_metric_set",
-           "default_metrics", "fetch_buffer", "state_family"]
+           "default_metrics", "fetch_buffer", "member_nonfinite_specs",
+           "state_family"]
 
 #: Invariants whose relative drift vs step 0 is worth a sink column.
 CONSERVED = ("mass", "energy", "enstrophy", "tracer_mass", "heat")
@@ -173,6 +174,34 @@ _register("heat", "integral T dA", {"diffusion"},
           lambda c: diag.total_mass(c.grid, c.field0))
 
 
+def member_nonfinite_specs(members: int):
+    """Per-member nonfinite-count rows for a member-batched state.
+
+    One :class:`MetricSpec` per member, named ``nonfinite_m{i}`` — the
+    names :class:`jaxstream.obs.monitor.HealthMonitor` attributes guard
+    events to a member index from, so an ensemble/serving run can evict
+    only the failing member instead of halting the batch (round 11).
+    The member axis of an interior prognostic leaf is ``ndim - 4``
+    (scalar fields ``(B, 6, n, n)``, vector fields ``(c, B, 6, n, n)``
+    — the ``ENSEMBLE_STATE_AXES`` layout rule).
+    """
+
+    def mk(i):
+        def fn(c, _i=i):
+            total = 0
+            for a in c.all_arrays:
+                sl = jnp.take(a, _i, axis=a.ndim - 4)
+                total = total + jnp.sum(~jnp.isfinite(sl))
+            return jnp.asarray(total, c.field0.dtype)
+        return fn
+
+    return tuple(
+        MetricSpec(f"nonfinite_m{i}",
+                   f"number of non-finite state entries in member {i}",
+                   frozenset(), mk(i))
+        for i in range(members))
+
+
 def state_family(state) -> str:
     """'swe' | 'advection' | 'diffusion' from the prognostic keys."""
     if "h" in state:
@@ -259,19 +288,27 @@ def resolve_metric_names(names, family: str, cov: bool) -> tuple:
 
 
 def build_metric_set(grid, model, example_state, names, dt: float,
-                     gravity: float) -> MetricSet:
+                     gravity: float, member_rows: bool = False) -> MetricSet:
     """Resolve ``names`` against a model/state and precompute statics.
 
     ``example_state``: an interior prognostic dict (used for family
     detection only — no values are read).  ``model`` may be ``None``
     for the scalar families; SWE metrics need it (velocity frame,
-    orography, vorticity operator).
+    orography, vorticity operator).  ``member_rows``: on a member-
+    batched state, append one ``nonfinite_m{i}`` row per member
+    (:func:`member_nonfinite_specs`) so the health monitor can name the
+    offending member; ignored for unbatched states.
     """
     family = state_family(example_state)
     cov = family == "swe" and "u" in example_state
     names = resolve_metric_names(names, family, cov)
     specs = tuple(METRICS[n] for n in names)
     field_key = {"swe": "h", "advection": "q", "diffusion": "T"}[family]
+    field = example_state[field_key]
+    if member_rows and getattr(field, "ndim", 0) == 4:
+        extra = member_nonfinite_specs(field.shape[0])
+        names = names + tuple(s.name for s in extra)
+        specs = specs + extra
     ms = MetricSet(names=names, specs=specs, grid=grid, model=model,
                    dt=dt, gravity=gravity, field_key=field_key, cov=cov)
     if family == "swe":

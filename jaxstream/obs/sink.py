@@ -40,6 +40,13 @@ RECORD_KINDS: Dict[str, tuple] = {
     "guard": ("event", "step", "t", "value", "policy",
               "last_good_step", "last_good_t"),
     "bench": ("metric", "value", "unit"),
+    # One continuous-batching server segment (jaxstream.serve, round
+    # 11): slot occupancy of the segment just run (active/B) and the
+    # request-queue depth after refill — the columns
+    # scripts/telemetry_report.py aggregates into the serving section.
+    # Notable optional keys: "completed"/"evicted"/"refilled" per-
+    # boundary counts, "member_steps" advanced this segment, "group".
+    "serve": ("bucket", "occupancy", "queue_depth", "wall_s"),
 }
 
 SCHEMA_VERSION = 1

@@ -1,0 +1,26 @@
+"""Continuous-batching ensemble server: simulation-as-a-service.
+
+The member axis ``B`` (the round-7 batched ensemble substrate) is an
+inference-style batch dimension; this package feeds it the way LLM
+servers feed theirs — independent scenario requests (IC family,
+perturbation seed, run length, output subset) packed into one batched
+stepper whose compiled executables stay warm across requests, with
+per-member run-length masking so a finished member's slot is refilled
+from the request queue at the next segment boundary instead of idling
+until the slowest member drains (ROADMAP open item 1; docs/USAGE.md
+"Serving", docs/DESIGN.md "Continuous batching").
+"""
+
+from .queue import AdmissionRefused, QueueFull, RequestQueue
+from .request import ScenarioRequest, RequestResult
+from .server import EnsembleServer, serve_requests
+
+__all__ = [
+    "AdmissionRefused",
+    "EnsembleServer",
+    "QueueFull",
+    "RequestQueue",
+    "RequestResult",
+    "ScenarioRequest",
+    "serve_requests",
+]

@@ -1,0 +1,97 @@
+"""Bounded scenario-request queue with admission control.
+
+The queue is the server's backpressure surface: capacity is a hard
+bound (``submit`` raises :class:`QueueFull` — or blocks, for callers
+that want producer-side flow control) so a traffic burst shows up as
+rejected admissions, never as unbounded host memory.  Group-aware pops
+(:meth:`RequestQueue.pop_group`) keep FIFO order *within* a batching
+group while letting the server refill a batch with packable requests
+only — requests of the other group keep their queue position.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from .request import ScenarioRequest
+
+__all__ = ["AdmissionRefused", "QueueFull", "RequestQueue"]
+
+
+class QueueFull(RuntimeError):
+    """submit() on a queue at capacity (non-blocking admission)."""
+
+
+class AdmissionRefused(RuntimeError):
+    """The server refused the request (health-driven admission
+    control: too many guard events — see ``serve.max_guard_events``)."""
+
+
+class RequestQueue:
+    """FIFO of :class:`ScenarioRequest` with a hard capacity bound.
+
+    Thread-safe: the CLI/benchmark submit from the main thread while a
+    server drains, and tests hammer it from worker threads.  ``pop`` /
+    ``pop_group`` are non-blocking (the serving loop polls at segment
+    boundaries — its natural cadence — rather than parking a thread).
+    """
+
+    def __init__(self, capacity: int = 64):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def submit(self, req: ScenarioRequest, block: bool = False,
+               timeout: Optional[float] = None) -> None:
+        """Enqueue; at capacity raise :class:`QueueFull` (default) or
+        block until a slot frees (``block=True``)."""
+        with self._not_full:
+            if len(self._q) >= self.capacity:
+                if not block:
+                    raise QueueFull(
+                        f"request queue at capacity {self.capacity}; "
+                        "retry later (admission control)")
+                if not self._not_full.wait_for(
+                        lambda: len(self._q) < self.capacity,
+                        timeout=timeout):
+                    raise QueueFull(
+                        f"request queue still at capacity "
+                        f"{self.capacity} after {timeout}s")
+            self._q.append(req)
+
+    def pop(self) -> Optional[ScenarioRequest]:
+        """Oldest request, or None when empty."""
+        with self._not_full:
+            if not self._q:
+                return None
+            req = self._q.popleft()
+            self._not_full.notify()
+            return req
+
+    def pop_group(self, group: str) -> Optional[ScenarioRequest]:
+        """Oldest request of one batching group (None if none queued).
+
+        Requests of other groups keep their positions — group-local
+        FIFO, which is what makes the refill order deterministic for a
+        given submission order.
+        """
+        with self._not_full:
+            for i, req in enumerate(self._q):
+                if req.group == group:
+                    del self._q[i]
+                    self._not_full.notify()
+                    return req
+            return None

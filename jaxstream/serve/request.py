@@ -1,0 +1,111 @@
+"""Scenario requests and their results — the server's wire schema.
+
+A request names WHAT to simulate (IC family, perturbation seed and
+amplitude, run length) and WHICH outputs to return; everything else
+(grid, dt, physics) is fixed per server deployment, which is what makes
+requests packable into one batched stepper.  The families are the
+Galewsky/Williamson scenario set (Galewsky et al. 2004; Williamson et
+al. 1992) the repo's IC module provides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = ["SWE_FAMILIES", "ScenarioRequest", "RequestResult"]
+
+#: IC families a server can pack, keyed to their batching group: tc5
+#: carries orography (a stepper-baked static), the rest are flat — only
+#: requests sharing a group can ride one batch (the orography array is
+#: a compile-time constant of the stepper, not per-member state).
+SWE_FAMILIES: Dict[str, str] = {
+    "tc2": "flat",
+    "tc5": "oro",
+    "tc6": "flat",
+    "galewsky": "flat",
+}
+
+#: Fields a request may ask back (interior prognostics).
+OUTPUT_FIELDS = ("h", "u")
+
+
+@dataclasses.dataclass
+class ScenarioRequest:
+    """One user scenario: IC family + perturbation + run length.
+
+    ``seed``/``amplitude`` perturb the family's base height field with
+    the deterministic ``perturbed_ensemble`` recipe (``amplitude = 0``
+    or ``seed < 0`` = the unperturbed base IC).  ``nsteps`` is the run
+    length in stepper calls — requests of ANY length pack together
+    (per-member masking handles the remainders).  ``outputs`` is the
+    subset of interior prognostic fields returned/written.
+    """
+    id: str
+    ic: str = "tc5"
+    nsteps: int = 1
+    seed: int = -1
+    amplitude: float = 1.0e-3
+    outputs: Tuple[str, ...] = ("h",)
+    #: wall-clock bookkeeping, stamped by the server
+    submitted_wall: Optional[float] = None
+
+    def __post_init__(self):
+        if self.ic not in SWE_FAMILIES:
+            raise ValueError(
+                f"request {self.id!r}: unknown ic {self.ic!r}; valid: "
+                f"{sorted(SWE_FAMILIES)}")
+        if self.nsteps < 1:
+            raise ValueError(
+                f"request {self.id!r}: nsteps must be >= 1, got "
+                f"{self.nsteps}")
+        self.outputs = tuple(self.outputs)
+        bad = [f for f in self.outputs if f not in OUTPUT_FIELDS]
+        if bad:
+            raise ValueError(
+                f"request {self.id!r}: unknown output fields {bad}; "
+                f"valid: {list(OUTPUT_FIELDS)}")
+
+    @property
+    def group(self) -> str:
+        return SWE_FAMILIES[self.ic]
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScenarioRequest":
+        """Build from a JSONL trace line (unknown keys rejected)."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"request mapping has unknown keys {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Outcome of one served request.
+
+    ``status``: ``'ok'`` or ``'evicted'`` (the member went non-finite
+    and was evicted by the health guard; ``guard_event`` then carries
+    the monitor's event, including the member index).  ``fields`` holds
+    the requested interior output arrays (host numpy) for completed
+    requests — byte-identical, for a request served alone through the
+    B=1 bucket, to an unbatched ``Simulation`` run of the same
+    scenario.  ``latency_s`` is submit-to-completion wall time;
+    ``steps_run`` how many steps actually executed (< ``nsteps`` only
+    for evictions).
+    """
+    id: str
+    ic: str
+    nsteps: int
+    status: str
+    t_final: float
+    steps_run: int
+    latency_s: float
+    fields: Dict[str, "object"] = dataclasses.field(default_factory=dict)
+    guard_event: Optional[dict] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"

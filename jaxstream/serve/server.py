@@ -1,0 +1,612 @@
+"""The continuous-batching ensemble server.
+
+One :class:`EnsembleServer` deployment fixes the grid, dt and physics
+(the ``grid:``/``time:``/``physics:``/``model:`` config sections) and
+serves :class:`ScenarioRequest` traffic — IC family, perturbation
+seed, run length, output subset — by packing requests into the member
+axis of the round-7 batched steppers:
+
+* **Shape-bucketed batching**: batch sizes come from a fixed bucket
+  set (``serve.buckets``, default ``1,4,16``) and every bucket's
+  masked-segment executable is compiled once and kept warm
+  (``JAXSTREAM_COMPILE_CACHE`` persists even that across restarts), so
+  steady-state serving triggers ZERO recompiles —
+  :meth:`EnsembleServer.compile_count` is the proof surface the tests
+  assert on.
+* **Per-member run-length masking** (:func:`jaxstream.stepping.
+  integrate_masked`): requests of any length share a batch; a member
+  that finishes mid-segment is frozen bit-for-bit at its own final
+  step and its slot is refilled from the queue at the next segment
+  boundary instead of idling until the slowest member drains.
+* **Slot-refill invariant**: refills happen ONLY at segment boundaries
+  — injections are ``dynamic_update_slice`` on the member axis of the
+  live carry, so the carry layout (and therefore the compiled
+  executable) never changes (docs/DESIGN.md "Continuous batching").
+* **Health-guarded eviction**: a per-member nonfinite count rides the
+  compiled segment; a failing member is evicted alone (guard event
+  carries the member index, ``serve.guards: evict``) while the rest of
+  the batch keeps integrating, and admission control refuses NEW
+  traffic once ``serve.max_guard_events`` trips have accumulated.
+* **Async result streaming**: per-member extraction starts its
+  device->host copies behind the next segment's dispatch
+  (:class:`jaxstream.io.async_pipeline.HostFetch`) and lands on the
+  bounded :class:`...BackgroundWriter` — results never stall the
+  batch.
+
+Scope (deliberate, documented): single-process, single-chip serving of
+the dense covariant shallow-water tier — the regime bench r05 showed
+batching pays in (members x moderate resolution).  Requests are packed
+only with requests of the same *batching group* (``tc5`` bakes an
+orography array into the stepper as a compile-time static; the flat
+families tc2/tc6/galewsky share one group) — group-local FIFO keeps
+that deterministic.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config, load_config
+from ..geometry.cubed_sphere import build_grid
+from ..io.async_pipeline import BackgroundWriter, HostFetch
+from ..obs.monitor import HealthMonitor
+from ..obs.sink import TelemetrySink, run_manifest
+from ..physics import initial_conditions as ics
+from ..stepping import integrate_masked, vmap_ensemble
+from ..utils.logging import get_logger
+from .queue import AdmissionRefused, QueueFull, RequestQueue
+from .request import RequestResult, ScenarioRequest
+
+__all__ = ["EnsembleServer", "serve_requests"]
+
+log = get_logger(__name__)
+
+#: Thread name of the server's background result writer.
+SERVE_WRITER_THREAD_NAME = "jaxstream-serve-writer"
+
+
+def _member_nonfinite(y, axes):
+    """Per-member nonfinite count over every carry leaf: ``(B,)``.
+
+    The on-device health stream of the serving loop — one small vector
+    per segment, fetched at the boundary the refill already pays for.
+    """
+    total = None
+    for k, ax in axes.items():
+        a = y[k]
+        bad = jnp.sum((~jnp.isfinite(a)).astype(jnp.int32),
+                      axis=tuple(i for i in range(a.ndim) if i != ax))
+        total = bad if total is None else total + bad
+    return total
+
+
+class _Slot:
+    """One member slot's host bookkeeping."""
+
+    def __init__(self, req: ScenarioRequest):
+        self.req = req
+        self.done = 0                       # steps executed so far
+
+    @property
+    def remaining(self) -> int:
+        return self.req.nsteps - self.done
+
+
+class _Bucket:
+    """One (group, B) compiled runtime: segment/extract/inject jits."""
+
+    def __init__(self, group: str, B: int, seg_fn, extract_fn, inject_fn,
+                 axes, init_carry, member_carry):
+        self.group = group
+        self.B = B
+        self.seg = seg_fn
+        self.extract = extract_fn
+        self.inject = inject_fn
+        self.axes = axes
+        self.init_carry = init_carry        # list of B states -> carry
+        self.member_carry = member_carry    # interior state -> member leaves
+
+    def jits(self):
+        return (self.seg, self.extract, self.inject)
+
+
+class EnsembleServer:
+    """Config -> warm bucketed steppers -> packed request serving.
+
+    ``config`` is the standard :class:`jaxstream.config.Config` surface
+    (grid/time/physics/model + the ``serve:`` block); ``on_result`` is
+    called with each :class:`RequestResult` from the background writer
+    thread (after its fields are on host).  Use as a context manager,
+    or call :meth:`close` when done.
+    """
+
+    def __init__(self, config=None,
+                 on_result: Optional[Callable] = None):
+        self.config: Config = load_config(config)
+        cfg = self.config
+        s = cfg.serve
+        if cfg.model.numerics != "dense":
+            raise ValueError(
+                "the serving tier runs the dense covariant solvers; "
+                "set model.numerics: dense")
+        if cfg.model.name != "shallow_water_cov":
+            # 'auto' would make the same config's Simulation build the
+            # CARTESIAN model for tc2/tc5 — a server that silently
+            # swapped models would break the documented B=1
+            # bitwise-vs-Simulation contract.
+            raise ValueError(
+                f"model.name={cfg.model.name!r}: the serving tier runs "
+                "the covariant production solver only — set model.name: "
+                "shallow_water_cov (so an unbatched Simulation of the "
+                "same config is the bitwise reference)")
+        if (cfg.precision.stage != "f32"
+                or cfg.precision.strips not in ("auto", "f32")
+                or cfg.precision.carry != "f32"):
+            raise ValueError(
+                "the serving tier runs f32 numerics; the precision: "
+                "block is not threaded through the bucket steppers yet "
+                "— drop it rather than silently serving f32")
+        if cfg.parallelization.temporal_block > 1:
+            raise ValueError(
+                "parallelization.temporal_block > 1 is not wired into "
+                "the serving tier (per-member masking counts single "
+                "steps); set temporal_block: 1")
+        if (cfg.parallelization.use_shard_map
+                or cfg.parallelization.tiles_per_edge > 1):
+            raise ValueError(
+                "the serving tier is single-chip for now (the member "
+                "axis IS the batch dimension; scale out with one "
+                "server process per chip) — drop use_shard_map/"
+                "tiles_per_edge from the parallelization block")
+        if s.guards not in ("off", "evict", "halt"):
+            raise ValueError(
+                f"serve.guards={s.guards!r}; valid: 'off', 'evict', "
+                "'halt'")
+        try:
+            self.buckets = tuple(sorted(
+                {int(b) for b in str(s.buckets).split(",") if b.strip()}))
+        except ValueError:
+            raise ValueError(
+                f"serve.buckets={s.buckets!r} must be a comma-separated "
+                "list of positive ints") from None
+        if not self.buckets or min(self.buckets) < 1:
+            raise ValueError(
+                f"serve.buckets={s.buckets!r} must name at least one "
+                "positive batch size")
+        if s.segment_steps < 1:
+            raise ValueError(
+                f"serve.segment_steps must be >= 1, got {s.segment_steps}")
+
+        halo = cfg.grid.halo
+        if cfg.model.scheme == "ppm":
+            halo = max(halo, 3)
+        dtype = {"float32": jnp.float32, "float64": jnp.float64,
+                 "bfloat16": jnp.bfloat16}[cfg.grid.dtype]
+        self.grid = build_grid(cfg.grid.n, halo=halo,
+                               radius=cfg.grid.radius, dtype=dtype,
+                               metrics=cfg.grid.metrics)
+        self.queue = RequestQueue(s.queue_capacity)
+        self.monitor = (HealthMonitor(
+            (), policy="warn" if s.guards == "evict" else "halt")
+            if s.guards != "off" else None)
+        self.on_result = on_result
+        self.results: Dict[str, RequestResult] = {}
+        self.stats = {
+            "submitted": 0, "refused": 0, "completed": 0, "evicted": 0,
+            "batches": 0, "segments": 0, "refills": 0,
+            "member_steps": 0, "occupancy_sum": 0.0,
+            "utilization_sum": 0.0, "warmup_compiles": 0,
+        }
+        self._models: Dict[str, object] = {}
+        self._ics: Dict[str, tuple] = {}
+        self._impls: Dict[str, str] = {}
+        self._buckets: Dict[tuple, _Bucket] = {}
+        self._writer: Optional[BackgroundWriter] = None
+        self._sink = None
+        if s.sink:
+            self._sink = TelemetrySink(s.sink, run_manifest(
+                config={
+                    "serving": True, "grid_n": cfg.grid.n,
+                    "dt": cfg.time.dt, "buckets": list(self.buckets),
+                    "segment_steps": s.segment_steps,
+                    "queue_capacity": s.queue_capacity,
+                    "guards": s.guards,
+                }))
+        self._fault_fired = False
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        """Drain the result writer and close the telemetry sink."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._writer is not None:
+            w, self._writer = self._writer, None
+            w.close()
+        if self._sink is not None:
+            self._sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- building
+    def _ic(self, family: str):
+        """Cached base IC fields ``(h_ext, v_ext, b_ext)`` per family."""
+        if family not in self._ics:
+            p, m, g = self.config.physics, self.config.model, self.grid
+            b_ext = None
+            if family == "tc2":
+                h, v = ics.williamson_tc2(g, p.gravity, p.omega,
+                                          alpha_rot=m.ic_angle)
+            elif family == "tc5":
+                h, v, b_ext = ics.williamson_tc5(g, p.gravity, p.omega)
+            elif family == "tc6":
+                h, v = ics.williamson_tc6(g, p.gravity, p.omega)
+            else:
+                h, v = ics.galewsky(g, p.gravity, p.omega)
+            self._ics[family] = (h, v, b_ext)
+        return self._ics[family]
+
+    def _model(self, group: str):
+        """Cached model per batching group (orography is stepper-baked)."""
+        if group not in self._models:
+            from ..models.shallow_water_cov import CovariantShallowWater
+
+            cfg = self.config
+            p, m = cfg.physics, cfg.model
+            b_ext = self._ic("tc5")[2] if group == "oro" else None
+            self._models[group] = CovariantShallowWater(
+                self.grid, gravity=p.gravity, omega=p.omega, b_ext=b_ext,
+                scheme=m.scheme, limiter=m.limiter,
+                nu4=p.hyperdiffusion, backend=m.backend)
+        return self._models[group]
+
+    def _request_state(self, req: ScenarioRequest):
+        """A request's interior initial state (deterministic in seed)."""
+        h, v, _ = self._ic(req.ic)
+        if req.seed >= 0 and req.amplitude != 0.0:
+            h = ics.perturbed_ensemble(self.grid, h, 2, seed=req.seed,
+                                       amplitude=req.amplitude)[1]
+        return self._model(req.group).initial_state(h, v)
+
+    def _build_bucket(self, group: str, B: int, impl: str) -> _Bucket:
+        cfg = self.config
+        model = self._model(group)
+        dt, seg = cfg.time.dt, cfg.serve.segment_steps
+        if impl == "fused":
+            step = model.make_fused_step(dt, ensemble=B)
+            axes = {"h": 0, "u": 1, "strips_sn": 0, "strips_we": 0}
+            member_carry = model.compact_state
+            init_carry = (lambda states:
+                          model.ensemble_compact_state(
+                              model.stack_ensemble(states)))
+        else:
+            base = model.make_step(dt, cfg.time.scheme)
+            axes = {"h": 0, "u": 1}
+            step = vmap_ensemble(base, axes)
+            member_carry = lambda st: st
+            init_carry = model.stack_ensemble
+
+        def seg_body(y, rem):
+            y, _, rem = integrate_masked(step, y, 0.0, rem, seg, dt, axes)
+            return y, rem, _member_nonfinite(y, axes)
+
+        def extract_body(y, idx):
+            return {k: jnp.take(y[k], idx, axis=axes[k])
+                    for k in ("h", "u")}
+
+        def inject_body(y, idx, member):
+            out = dict(y)
+            for k, ax in axes.items():
+                upd = jnp.expand_dims(member[k].astype(y[k].dtype), ax)
+                out[k] = jax.lax.dynamic_update_slice_in_dim(
+                    y[k], upd, idx, axis=ax)
+            return out
+
+        donate = (0,) if cfg.serve.donate else ()
+        return _Bucket(group, B,
+                       jax.jit(seg_body, donate_argnums=donate),
+                       jax.jit(extract_body), jax.jit(inject_body),
+                       axes, init_carry, member_carry)
+
+    def _bucket(self, group: str, B: int) -> _Bucket:
+        """The warm (group, B) runtime — built, compiled and probed on
+        first use (fused kernels where they execute, the vmapped
+        classic stepper otherwise; the probe run IS the warmup)."""
+        key = (group, B)
+        bk = self._buckets.get(key)
+        if bk is not None:
+            return bk
+        cfg = self.config
+        impls = [self._impls[group]] if group in self._impls else []
+        if not impls:
+            fused_ok = (cfg.time.scheme == "ssprk3"
+                        and cfg.model.backend.startswith("pallas")
+                        and self.config.physics.hyperdiffusion == 0.0)
+            impls = (["fused", "vmap"] if fused_ok else ["vmap"])
+        err = None
+        for impl in impls:
+            try:
+                bk = self._build_bucket(group, B, impl)
+                self._warm_bucket(bk)
+                self._impls[group] = impl
+                self._buckets[key] = bk
+                self.stats["warmup_compiles"] = self.compile_count()
+                log.info("serve: bucket (%s, B=%d) warm (%s stepper)",
+                         group, B, impl)
+                return bk
+            except Exception as e:
+                err = e
+                if impl != impls[-1]:
+                    log.warning(
+                        "serve: %s stepper unavailable for bucket "
+                        "(%s, B=%d) (%s: %s); falling back",
+                        impl, group, B, type(e).__name__, e)
+        raise RuntimeError(
+            f"serve: no stepper builds for bucket ({group}, B={B})"
+        ) from err
+
+    def _warm_bucket(self, bk: _Bucket):
+        """One dummy masked segment + extract + inject: compiles (and
+        probes) every executable the bucket will ever run."""
+        family = "tc5" if bk.group == "oro" else "tc2"
+        st = self._model(bk.group).initial_state(*self._ic(family)[:2])
+        carry = bk.init_carry([st] * bk.B)
+        rem = jnp.zeros((bk.B,), jnp.int32
+                        ).at[0].set(self.config.serve.segment_steps)
+        carry, _, nf = bk.seg(carry, rem)
+        jax.block_until_ready(nf)
+        ex = bk.extract(carry, jnp.int32(0))
+        carry = bk.inject(carry, jnp.int32(0), bk.member_carry(st))
+        jax.block_until_ready((ex["h"], carry["h"]))
+
+    def warmup(self, groups=("flat",), buckets=None):
+        """Pre-compile the bucket set so the first real traffic hits
+        warm executables (steady-state = zero recompiles).  ``groups``:
+        which batching groups to warm ('flat' and/or 'oro')."""
+        for g in groups:
+            if g not in ("flat", "oro"):
+                raise ValueError(f"unknown batching group {g!r}")
+            for B in (buckets or self.buckets):
+                self._bucket(g, B)
+        return self.compile_count()
+
+    def compile_count(self) -> int:
+        """Total compiled executables across every bucket's jits — the
+        zero-steady-state-recompile assertion surface (-1 when the jax
+        build exposes no cache-size introspection)."""
+        total = 0
+        for bk in self._buckets.values():
+            for f in bk.jits():
+                cs = getattr(f, "_cache_size", None)
+                if cs is None:
+                    return -1
+                total += cs()
+        return total
+
+    # ------------------------------------------------------------ admission
+    def submit(self, req: ScenarioRequest, block: bool = False,
+               timeout: Optional[float] = None) -> None:
+        """Admit one request (raises :class:`QueueFull` at capacity,
+        :class:`AdmissionRefused` when the health monitor has recorded
+        ``serve.max_guard_events`` guard trips)."""
+        if self._closed:
+            raise RuntimeError("EnsembleServer is closed")
+        mx = self.config.serve.max_guard_events
+        if (mx > 0 and self.monitor is not None
+                and len(self.monitor.events) >= mx):
+            self.stats["refused"] += 1
+            raise AdmissionRefused(
+                f"server refused {req.id!r}: {len(self.monitor.events)} "
+                f"guard events >= serve.max_guard_events={mx} — the "
+                "deployment is unhealthy; investigate before admitting "
+                "more traffic")
+        req.submitted_wall = time.perf_counter()
+        self.queue.submit(req, block=block, timeout=timeout)
+        self.stats["submitted"] += 1
+
+    # -------------------------------------------------------------- serving
+    def serve(self):
+        """Drain the queue: pack -> masked segments -> refill, batch by
+        batch, until no requests remain.  Returns ``self.results``."""
+        try:
+            while True:
+                req = self.queue.pop()
+                if req is None:
+                    break
+                self._run_batch(req)
+        finally:
+            if self._writer is not None:
+                self._writer.flush()
+        return self.results
+
+    def _ensure_writer(self) -> BackgroundWriter:
+        if self._writer is None or not self._writer.alive:
+            self._writer = BackgroundWriter(
+                max_pending=8, name=SERVE_WRITER_THREAD_NAME)
+        return self._writer
+
+    def _run_batch(self, first: ScenarioRequest):
+        """One batch's life: pack up to the best bucket, then segment /
+        evict / extract / refill until every slot drains."""
+        cfg = self.config
+        s, dt = cfg.serve, cfg.time.dt
+        group = first.group
+        batch: List[ScenarioRequest] = [first]
+        while len(batch) < max(self.buckets):
+            r = self.queue.pop_group(group)
+            if r is None:
+                break
+            batch.append(r)
+        B = next(b for b in self.buckets if b >= len(batch))
+        bk = self._bucket(group, B)
+        self.stats["batches"] += 1
+
+        states = [self._request_state(r) for r in batch]
+        carry = bk.init_carry(states + [states[0]] * (B - len(batch)))
+        slots: List[Optional[_Slot]] = (
+            [_Slot(r) for r in batch] + [None] * (B - len(batch)))
+        rem = np.zeros(B, np.int64)
+        rem[:len(batch)] = [r.nsteps for r in batch]
+        seg = s.segment_steps
+
+        while any(sl is not None for sl in slots):
+            w0 = time.perf_counter()
+            active_before = sum(sl is not None for sl in slots)
+            carry, _, nf = bk.seg(carry, jnp.asarray(rem, jnp.int32))
+            nf_host = np.asarray(jax.device_get(nf), np.float64)
+            wall = time.perf_counter() - w0
+            new_rem = np.maximum(rem - seg, 0)
+            member_steps = int(np.sum(rem - new_rem))
+            rem = new_rem
+            for i, sl in enumerate(slots):
+                if sl is not None:
+                    sl.done = sl.req.nsteps - int(rem[i])
+            # Testing hook: host-side injection into the health STREAM
+            # (never the state), mirroring observability.fault_step.
+            fi = s.fault_member
+            if (fi >= 0 and cfg.observability.fault_step >= 0
+                    and not self._fault_fired and fi < B
+                    and slots[fi] is not None
+                    and slots[fi].done >= cfg.observability.fault_step):
+                nf_host[fi] = max(nf_host[fi], 1.0)
+                self._fault_fired = True
+            completed = evicted = 0
+            if self.monitor is not None:
+                counts = np.where(
+                    [sl is not None for sl in slots], nf_host, 0.0)
+                steps = [sl.done if sl is not None else 0 for sl in slots]
+                ts = [d * dt for d in steps]
+                # 'halt' policy raises here (HealthError) — the writer
+                # flush in serve()'s finally still lands prior results.
+                for ev in self.monitor.check_members(steps, ts, counts):
+                    i = ev["member"]
+                    self._finish(slots[i], "evicted", None, ev)
+                    rem[i] = 0
+                    slots[i] = None
+                    evicted += 1
+            for i, sl in enumerate(slots):
+                if sl is not None and rem[i] == 0:
+                    fetch = HostFetch(bk.extract(carry, jnp.int32(i)))
+                    self._finish(sl, "ok", fetch)
+                    slots[i] = None
+                    completed += 1
+            refilled = 0
+            for i in range(B):
+                if slots[i] is not None:
+                    continue
+                r = self.queue.pop_group(group)
+                if r is None:
+                    break
+                carry = bk.inject(carry, jnp.int32(i),
+                                  bk.member_carry(self._request_state(r)))
+                rem[i] = r.nsteps
+                slots[i] = _Slot(r)
+                refilled += 1
+            st = self.stats
+            st["segments"] += 1
+            st["refills"] += refilled
+            st["member_steps"] += member_steps
+            st["occupancy_sum"] += active_before / B
+            st["utilization_sum"] += member_steps / (B * seg)
+            st["completed"] += completed
+            st["evicted"] += evicted
+            if self._sink is not None:
+                self._sink.write({
+                    "kind": "serve", "bucket": B, "group": group,
+                    "occupancy": round(active_before / B, 4),
+                    "utilization": round(member_steps / (B * seg), 4),
+                    "queue_depth": len(self.queue),
+                    "wall_s": round(wall, 6),
+                    "completed": completed, "evicted": evicted,
+                    "refilled": refilled, "member_steps": member_steps,
+                })
+
+    def _finish(self, slot: _Slot, status: str,
+                fetch: Optional[HostFetch], event: Optional[dict] = None):
+        """Queue one request's finalization on the background writer —
+        the d2h copies (already in flight) resolve there, overlapping
+        the next segment's compute."""
+        latency = (time.perf_counter() - slot.req.submitted_wall
+                   if slot.req.submitted_wall is not None else 0.0)
+        self._ensure_writer().submit(
+            self._finalize, slot.req, status, slot.done, latency, fetch,
+            event)
+
+    def _finalize(self, req: ScenarioRequest, status: str, done: int,
+                  latency: float, fetch: Optional[HostFetch],
+                  event: Optional[dict]):
+        fields = {}
+        if fetch is not None:
+            host = fetch.resolve()
+            fields = {k: host[k] for k in req.outputs if k in host}
+        t_final = done * self.config.time.dt
+        res = RequestResult(
+            id=req.id, ic=req.ic, nsteps=req.nsteps, status=status,
+            t_final=t_final, steps_run=done, latency_s=latency,
+            fields=fields, guard_event=event)
+        out_dir = self.config.serve.output_dir
+        if out_dir and fields:
+            from ..io.history import HistoryWriter
+
+            hw = HistoryWriter(
+                os.path.join(out_dir, req.id),
+                attrs={"request": req.id, "ic": req.ic,
+                       "nsteps": req.nsteps, "status": status})
+            hw.append(fields, t_final)
+        self.results[req.id] = res
+        if self.on_result is not None:
+            self.on_result(res)
+
+    # ------------------------------------------------------------ reporting
+    @property
+    def occupancy_mean(self) -> float:
+        n = self.stats["segments"]
+        return self.stats["occupancy_sum"] / n if n else 0.0
+
+    @property
+    def utilization_mean(self) -> float:
+        n = self.stats["segments"]
+        return self.stats["utilization_sum"] / n if n else 0.0
+
+    def latencies(self) -> np.ndarray:
+        return np.asarray(sorted(
+            r.latency_s for r in self.results.values()))
+
+
+def serve_requests(config, requests, warm_groups=None):
+    """One-call serving: build a server, admit ``requests`` (blocking
+    at the queue bound), drain, close.  Returns the server (results in
+    ``server.results``, counters in ``server.stats``)."""
+    server = EnsembleServer(config)
+    try:
+        if warm_groups:
+            server.warmup(groups=warm_groups)
+        pending = list(requests)
+        while pending:
+            # Admit what fits, serve a batch, repeat — producer-side
+            # backpressure without a second thread.
+            while pending:
+                try:
+                    server.submit(pending[0])
+                except QueueFull:
+                    break
+                pending.pop(0)
+            req = server.queue.pop()
+            if req is not None:
+                server._run_batch(req)
+        server.serve()
+    finally:
+        server.close()
+    return server

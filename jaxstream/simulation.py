@@ -126,18 +126,16 @@ class Simulation:
         if self.members < 1:
             raise ValueError(
                 f"ensemble.members must be >= 1, got {self.members}")
-        if self.members > 1:
-            if cfg.model.numerics != "dense":
-                raise ValueError(
-                    "ensemble.members > 1 runs the dense tier only; set "
-                    "model.numerics: dense (the factored TT state has no "
-                    "batched stepper yet)")
-            if cfg.io.history_stride > 0 or cfg.io.checkpoint_stride > 0:
-                raise ValueError(
-                    "ensemble.members > 1 does not write history/"
-                    "checkpoints yet (the IO layers assume unbatched "
-                    "states); set io.history_stride: 0 and "
-                    "io.checkpoint_stride: 0")
+        if self.members > 1 and cfg.model.numerics != "dense":
+            raise ValueError(
+                "ensemble.members > 1 runs the dense tier only; set "
+                "model.numerics: dense (the factored TT state has no "
+                "batched stepper yet)")
+        # (Round 11: ensemble history/checkpoints are supported — the
+        # member-batched arrays are written as-is and extracted
+        # per-member via io.history.extract_member /
+        # HistoryWriter.read_member / CheckpointManager.restore_member;
+        # member 0 byte-matches an unbatched run on the vmapped path.)
         if cfg.model.numerics == "tt":
             self.model = None
             self.state, self._step = self._build_tt()
@@ -323,7 +321,10 @@ class Simulation:
             self.history = HistoryWriter(
                 io.history_path,
                 attrs={"model": mcfg.name, "ic": mcfg.initial_condition,
-                       "numerics": mcfg.numerics},
+                       "numerics": mcfg.numerics,
+                       # Marks the fields member-batched so read_member
+                       # can slice the right axis (round 11).
+                       "members": self.members},
                 tt_rank=hist_rank,
             )
         if io.checkpoint_stride > 0:
@@ -377,8 +378,13 @@ class Simulation:
                 "fire; lower the interval or raise the io strides")
         p, tc = self.config.physics, self.config.time
         ex = {k: v for k, v in self.state.items() if k in _PROG_KEYS}
+        # Ensemble runs with guards on get one nonfinite row PER member
+        # appended, so a guard event (and the postmortem checkpoint it
+        # triggers) names the offending member instead of only an
+        # all-member count (round 11).
         ms = obs_metrics.build_metric_set(
-            self.grid, self.model, ex, o.metrics, tc.dt, p.gravity)
+            self.grid, self.model, ex, o.metrics, tc.dt, p.gravity,
+            member_rows=(self.members > 1 and o.guards != "off"))
         if self._fused_step is not None:
             m = self.model
             if self._fused_post is not None:
@@ -491,10 +497,15 @@ class Simulation:
         dec = lambda s: m.decode_carry(s, off, hs)
         return kw, enc, dec
 
-    def _postmortem_checkpoint(self):
+    def _postmortem_checkpoint(self, event=None):
         """'checkpoint_and_raise' breach callback: save the CURRENT
         (possibly corrupt) state for inspection — the HealthError's
         last-good step is the restart target, this save is evidence.
+        ``event``: the guard event (the monitor passes it when the
+        callback accepts one); its ``member`` attribution — when the
+        breach names one ensemble member — is recorded in the
+        checkpoint's ``meta`` so the postmortem says WHICH member blew
+        up (round 11).
 
         Async-pipeline aware: queued background saves are drained FIRST
         (the Orbax manager is used serially — writer FIFO, then this),
@@ -520,9 +531,13 @@ class Simulation:
                 t = float(jax.device_get(self._t_carry))
             except Exception:
                 pass
-        self.checkpoints.save(self.step_count, self.state, t)
-        log.warning("guard breach: postmortem checkpoint saved at step %d",
-                    self.step_count)
+        member = (event or {}).get("member")
+        self.checkpoints.save(
+            self.step_count, self.state, t,
+            meta={"postmortem": True, "member": member})
+        log.warning("guard breach: postmortem checkpoint saved at step "
+                    "%d%s", self.step_count,
+                    f" (member {member})" if member is not None else "")
 
     def _ensure_writer(self) -> BackgroundWriter:
         if self._writer is None or not self._writer.alive:
@@ -912,6 +927,37 @@ class Simulation:
             self.step_count = step
             log.info("resumed factored (TT) state from checkpoint step %d "
                      "(t=%.0f s)", step, self.t)
+            return
+        if self.members > 1:
+            # Ensemble resume (round 11): the checkpoint holds the
+            # member-batched arrays; validate the batch shape against
+            # this run and place directly (cross-resolution regrid is
+            # dense-unbatched-only).
+            hb = np.asarray(state["h"]) if "h" in state else None
+            if hb is None or hb.ndim != 4:
+                raise ValueError(
+                    "ensemble.members > 1 but the checkpoint state is "
+                    "not member-batched — it was written by an "
+                    "unbatched run; point io.checkpoint_path elsewhere")
+            if hb.shape[0] != self.members:
+                raise ValueError(
+                    f"checkpoint has {hb.shape[0]} ensemble members but "
+                    f"the run configures {self.members}; set "
+                    f"ensemble.members: {hb.shape[0]} (per-member resume: "
+                    "CheckpointManager.restore_member)")
+            if hb.shape[-1] != n_new:
+                raise ValueError(
+                    f"ensemble checkpoint is C{hb.shape[-1]} but the run "
+                    f"is C{n_new}: cross-resolution resume is "
+                    "unbatched-dense-only")
+            if self.setup is not None and self.setup.mesh is not None:
+                state = shard_ensemble_state(self.setup, state)
+            else:
+                state = jax.tree_util.tree_map(jnp.asarray, state)
+            self.state = state
+            self.step_count = step
+            log.info("resumed %d-member ensemble state from checkpoint "
+                     "step %d (t=%.0f s)", self.members, step, self.t)
             return
         n_ckpt = infer_resolution(state)   # raises clearly on ambiguity
         if n_ckpt != n_new:

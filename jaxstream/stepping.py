@@ -21,8 +21,9 @@ import jax.numpy as jnp
 from jax import tree_util as jtu
 
 __all__ = ["ssprk3_step", "rk4_step", "euler_step", "make_stepper",
-           "blocked", "time_carry", "integrate", "integrate_with_history",
-           "integrate_with_metrics", "vmap_ensemble", "jit_integrate",
+           "blocked", "time_carry", "integrate", "integrate_masked",
+           "integrate_with_history", "integrate_with_metrics",
+           "vmap_ensemble", "jit_integrate",
            "jit_integrate_with_history"]
 
 
@@ -168,6 +169,49 @@ def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,
         return jax.lax.fori_loop(0, nsteps, body, (y0, t0a))
     y, t = jax.lax.fori_loop(0, nsteps // unroll, body_u, (y0, t0a))
     return jax.lax.fori_loop(0, nsteps % unroll, body, (y, t))
+
+
+def integrate_masked(step: Callable, y0, t0: float, rem0, nsteps: int,
+                     dt: float, axes):
+    """:func:`integrate` over a member-batched carry with per-member
+    run-length masking — the continuous-batching serving loop's inner
+    segment (``jaxstream.serve``).
+
+    ``rem0`` is a ``(B,)`` integer vector of *remaining* stepper calls
+    per member; ``axes`` is a pytree matching ``y0`` giving each leaf's
+    member-axis position (the :func:`vmap_ensemble` convention, e.g.
+    ``{"h": 0, "u": 1}``).  Every iteration steps the WHOLE batch, then
+    keeps the new value only for members whose remaining count is still
+    positive — a finished member's state is frozen bit-for-bit at its
+    own final step while the rest of the batch drains, so a slot can be
+    refilled at the next segment boundary instead of idling.  For a
+    member with ``rem0[i] >= nsteps`` the masking select is
+    ``where(True, new, old)`` — bitwise the unmasked :func:`integrate`
+    with ``unroll=1`` (same step ops, same order).
+
+    The time scalar is a single batch-wide carry (the shallow-water
+    steppers are autonomous — ``t`` only sequences ``t + dt`` adds);
+    per-member model time is host bookkeeping (``steps_done * dt``).
+    Returns ``(y, t, rem)`` with ``rem`` decremented once per iteration
+    for each then-active member (floor 0).
+    """
+
+    def body(_, carry):
+        y, t, rem = carry
+        y2 = step(y, t)
+        active = rem > 0
+
+        def sel(new, old, ax):
+            shape = [1] * new.ndim
+            shape[ax] = active.shape[0]
+            return jnp.where(active.reshape(shape), new, old)
+
+        y = jtu.tree_map(sel, y2, y, axes)
+        return y, t + dt, rem - active.astype(rem.dtype)
+
+    return jax.lax.fori_loop(
+        0, nsteps, body,
+        (y0, time_carry(t0), jnp.asarray(rem0, jnp.int32)))
 
 
 def integrate_with_history(step: Callable, y0, t0: float, nsteps: int, dt: float,

@@ -43,6 +43,15 @@ every gate run self-checking):
    gate (a slow-marked parity would let a bad policy ship between
    offline TPU bench runs).
 
+6. **Serving tests stay tier-1** (round-11 satellite): the same rule
+   for modules importing ``jaxstream.serve``.  The continuous-batching
+   server's acceptance criteria — packing/refill determinism, the
+   B=1-request bitwise parity vs a plain Simulation run, eviction-
+   under-injected-NaN, queue backpressure, and the zero-steady-state-
+   recompile warm-bucket claim — must run in every fast gate (the real
+   throughput numbers only exist on offline TPU bench runs; the fast
+   gate is what certifies the machinery between them).
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -74,6 +83,10 @@ _PRECISION_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.ops\.pallas\.precision\b"
     r"|import\s+jaxstream\.ops\.pallas\.precision\b"
     r"|from\s+jaxstream\.ops\.pallas\s+import\s+(\w+\s*,\s*)*precision\b)",
+    re.MULTILINE)
+_SERVE_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.serve\b|import\s+jaxstream\.serve\b"
+    r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*serve\b)",
     re.MULTILINE)
 
 
@@ -127,6 +140,13 @@ def lint_file(path: str, allowed: set):
                f"re-fused del^4) must run in every fast gate; move the "
                f"slow test to a module that does not import "
                f"jaxstream.ops.pallas.precision")
+    if _SERVE_IMPORT_RE.search(src) and "slow" in used:
+        yield (f"{rel}: imports jaxstream.serve but marks tests slow — "
+               f"the serving acceptance criteria (packing/refill "
+               f"determinism, B=1 bitwise parity vs Simulation, "
+               f"eviction, backpressure, zero steady-state recompiles) "
+               f"must run in every fast gate; move the slow test to a "
+               f"module that does not import jaxstream.serve")
 
 
 def main(repo_root: str = None) -> int:

@@ -1,0 +1,125 @@
+"""Continuous-batching ensemble serving CLI (jaxstream.serve).
+
+Usage::
+
+    python scripts/serve.py config.yaml --requests trace.jsonl \
+        [--output-dir DIR] [--warm flat,oro]
+
+``config.yaml`` is the standard config surface (grid/time/physics/
+model + the ``serve:`` block); ``trace.jsonl`` holds one scenario
+request per line::
+
+    {"id": "r0", "ic": "tc5", "nsteps": 288, "seed": 7,
+     "amplitude": 1e-3, "outputs": ["h"]}
+
+Requests are admitted with producer-side backpressure (submission
+blocks at the queue bound while batches drain), served by packing into
+the member axis, and — when ``--output-dir``/``serve.output_dir`` is
+set — written as one zarr store per request through the background
+writer.  Prints exactly ONE JSON summary line on stdout (request
+statuses, occupancy/utilization, latency percentiles, compile counts);
+everything else goes to stderr.  Set ``serve.sink`` for per-segment
+occupancy/queue-depth telemetry readable by
+``scripts/telemetry_report.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_requests(path: str):
+    from jaxstream.serve import ScenarioRequest
+
+    reqs = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                reqs.append(ScenarioRequest.from_dict(json.loads(line)))
+            except (json.JSONDecodeError, TypeError, ValueError) as e:
+                raise SystemExit(f"{path}:{i + 1}: bad request ({e})")
+    if not reqs:
+        raise SystemExit(f"{path}: no requests")
+    return reqs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Serve scenario requests through the "
+                    "continuous-batching ensemble server.")
+    ap.add_argument("config", help="server config YAML (grid/time/"
+                                   "physics/model + serve: block)")
+    ap.add_argument("--requests", required=True,
+                    help="JSONL request trace (one scenario per line)")
+    ap.add_argument("--output-dir", default="",
+                    help="override serve.output_dir (one zarr store "
+                         "per request)")
+    ap.add_argument("--warm", default="",
+                    help="comma-separated batching groups to pre-"
+                         "compile before admitting traffic "
+                         "(e.g. 'flat,oro')")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from jaxstream.config import load_config
+    from jaxstream.serve import serve_requests
+
+    cfg = load_config(args.config)
+    if args.output_dir:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, serve=dataclasses.replace(cfg.serve,
+                                           output_dir=args.output_dir))
+    reqs = load_requests(args.requests)
+    warm = tuple(g.strip() for g in args.warm.split(",") if g.strip())
+
+    wall0 = time.perf_counter()
+    server = serve_requests(cfg, reqs, warm_groups=warm or None)
+    wall = time.perf_counter() - wall0
+
+    lat = server.latencies()
+    dt = cfg.time.dt
+    member_steps = server.stats["member_steps"]
+    summary = {
+        "metric": "serve_summary",
+        "n_requests": len(reqs),
+        "completed": server.stats["completed"],
+        "evicted": server.stats["evicted"],
+        "batches": server.stats["batches"],
+        "segments": server.stats["segments"],
+        "refills": server.stats["refills"],
+        "occupancy_mean": round(server.occupancy_mean, 4),
+        "utilization_mean": round(server.utilization_mean, 4),
+        "member_steps": member_steps,
+        "member_steps_per_sec": round(member_steps / wall, 2),
+        "aggregate_sim_days_per_sec": round(
+            member_steps * dt / 86400.0 / wall, 4),
+        "latency_p50_s": round(float(np.percentile(lat, 50)), 4)
+        if len(lat) else None,
+        "latency_p99_s": round(float(np.percentile(lat, 99)), 4)
+        if len(lat) else None,
+        "warmup_compiles": server.stats["warmup_compiles"],
+        "steady_recompiles": (server.compile_count()
+                              - server.stats["warmup_compiles"]),
+        "wall_s": round(wall, 3),
+        "requests": {r.id: r.status
+                     for r in server.results.values()},
+    }
+    print(json.dumps(summary))
+    return 0 if server.stats["evicted"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

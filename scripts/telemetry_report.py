@@ -49,6 +49,7 @@ def summarize(records):
     segments = [r for r in records if r.get("kind") == "segment"]
     guards = [r for r in records if r.get("kind") == "guard"]
     benches = [r for r in records if r.get("kind") == "bench"]
+    serves = [r for r in records if r.get("kind") == "serve"]
 
     drift = {}
     if segments:
@@ -70,9 +71,39 @@ def summarize(records):
         for s in segments if s["steps"] > 0
     ]
     host_wait_total = sum(t["host_wait_s"] for t in timeline)
+    # The continuous-batching server's occupancy/queue-depth columns
+    # (jaxstream.serve 'serve' records, round 11): slot occupancy says
+    # how full the member axis ran, queue depth how much traffic waited.
+    serving = None
+    if serves:
+        occ = [s["occupancy"] for s in serves]
+        util = [s.get("utilization") for s in serves
+                if s.get("utilization") is not None]
+        serving = {
+            "segments": len(serves),
+            "occupancy_mean": sum(occ) / len(occ),
+            "occupancy_min": min(occ),
+            "utilization_mean": (sum(util) / len(util)) if util else None,
+            "queue_depth_max": max(s["queue_depth"] for s in serves),
+            "completed": sum(s.get("completed", 0) for s in serves),
+            "evicted": sum(s.get("evicted", 0) for s in serves),
+            "refilled": sum(s.get("refilled", 0) for s in serves),
+            "member_steps": sum(s.get("member_steps", 0)
+                                for s in serves),
+            "timeline": [
+                {"bucket": s["bucket"],
+                 "occupancy": s["occupancy"],
+                 "utilization": s.get("utilization"),
+                 "queue_depth": s["queue_depth"],
+                 "wall_s": s["wall_s"],
+                 "completed": s.get("completed", 0),
+                 "evicted": s.get("evicted", 0),
+                 "refilled": s.get("refilled", 0)}
+                for s in serves],
+        }
     return {"manifest": manifest, "drift": drift, "timeline": timeline,
             "host_wait_total_s": host_wait_total,
-            "guards": guards, "bench": benches,
+            "guards": guards, "bench": benches, "serving": serving,
             "n_segments": len(segments)}
 
 
@@ -114,11 +145,33 @@ def print_report(s):
               f"{s['host_wait_total_s']:.4f}s "
               f"(io.async_pipeline moves this off the critical path)")
 
+    if s.get("serving"):
+        sv = s["serving"]
+        print("\nserving (continuous-batching server):")
+        print(f"  {'bucket':>6} {'occupancy':>9} {'util':>6} "
+              f"{'queue':>5} {'wall s':>9} {'done':>5} {'evict':>5} "
+              f"{'refill':>6}")
+        for seg in sv["timeline"]:
+            util = seg["utilization"]
+            print(f"  {seg['bucket']:>6} {seg['occupancy']:>9.3f} "
+                  f"{util if util is None else format(util, '>6.3f')} "
+                  f"{seg['queue_depth']:>5} {seg['wall_s']:>9.4f} "
+                  f"{seg['completed']:>5} {seg['evicted']:>5} "
+                  f"{seg['refilled']:>6}")
+        print(f"  {sv['segments']} segments: occupancy mean "
+              f"{sv['occupancy_mean']:.3f} (min {sv['occupancy_min']:.3f}"
+              f"), max queue depth {sv['queue_depth_max']}, "
+              f"{sv['completed']} completed / {sv['evicted']} evicted / "
+              f"{sv['refilled']} refilled, {sv['member_steps']} "
+              f"member-steps")
+
     if s["guards"]:
         print("\nguard events:")
         for g in s["guards"]:
+            who = (f", member {g['member']}" if g.get("member") is not None
+                   else "")
             print(f"  step {g['step']}: {g['event']} (value {g['value']:g},"
-                  f" policy {g['policy']}, last good step "
+                  f" policy {g['policy']}{who}, last good step "
                   f"{g['last_good_step']})")
     else:
         print("\nguard events: none")

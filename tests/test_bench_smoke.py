@@ -63,6 +63,28 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert "host_wait_s_total" in io_sec["async"]
     assert isinstance(io_sec["async_overhead_smaller"], bool)
 
+    # The serving canary (round 11) ran the continuous-batching server
+    # end to end at C16: every request completed, slots stayed
+    # occupied, refills happened (the 4 request lengths are ragged vs
+    # the 2-step segment), the request latencies are ordered sanely,
+    # and — the bucket claim — serving compiled NOTHING after warmup.
+    # Rates are smoke windows; no throughput assertion (the >= 0.9x
+    # vs-static-B16 floor is asserted on the TPU bench run's JSON).
+    srv = rec["serving"]
+    assert "skipped" not in srv, srv
+    for mode in ("packed", "serial_B1"):
+        m = srv[mode]
+        assert m["completed"] == srv["n_requests"], (mode, m)
+        assert m["evicted"] == 0, (mode, m)
+        assert m["steady_recompiles"] == 0, (mode, m)
+        assert m["warmup_compiles"] > 0, (mode, m)
+        assert 0.0 < m["occupancy_mean"] <= 1.0, (mode, m)
+        assert 0.0 < m["utilization_mean"] <= 1.0, (mode, m)
+        assert m["member_steps_per_sec"] > 0.0, (mode, m)
+        assert 0.0 < m["latency_p50_s"] <= m["latency_p99_s"], (mode, m)
+    assert srv["packed"]["refills"] > 0
+    assert srv["packed"]["member_steps"] == srv["serial_B1"]["member_steps"]
+
     # The precision ladder (round 10) ran all four rows through the
     # real --precision-report code path: reduced-precision stage
     # kernels, carry encoders, and the precision-corrected roofline

@@ -122,6 +122,28 @@ def test_obs_importing_module_with_slow_marker_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 0
 
 
+def test_serve_module_with_slow_marker_detected(tmp_path):
+    """Rule 6 (round-11 satellite): serving tests stay tier-1 — a
+    module importing jaxstream.serve must carry no slow markers (the
+    packing/refill/eviction/backpressure/zero-recompile criteria are
+    what certify the server between offline TPU bench runs)."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_s.py").write_text(
+        "import pytest\n"
+        "from jaxstream.serve import EnsembleServer\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module without the marker is clean.
+    (tests / "test_s.py").write_text(
+        "import jaxstream.serve\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_precision_module_with_slow_marker_detected(tmp_path):
     """Rule 5 (round-10 satellite): precision-parity tests stay tier-1
     — a module importing jaxstream.ops.pallas.precision must carry no

@@ -1,0 +1,365 @@
+"""Continuous-batching ensemble server (jaxstream.serve, round 11).
+
+Acceptance criteria of the serving tier, all tier-1 (check_tiers rule
+6 keeps this module fast):
+
+  * per-member run-length masking freezes finished members bit-for-bit
+    (stepping.integrate_masked unit);
+  * a single request through the B=1 bucket is BITWISE identical to a
+    plain unbatched ``Simulation`` run of the same scenario;
+  * packing + boundary refill are deterministic (two identical servers
+    produce byte-identical results) and each packed member's trajectory
+    is exactly its own solo run;
+  * a member whose state goes non-finite is EVICTED alone (guard event
+    carries the member index) while the batch keeps serving, and the
+    health monitor drives admission control;
+  * the bounded queue raises at capacity (backpressure);
+  * the shape-bucketed steppers compile during warmup and NEVER again
+    (zero steady-state recompiles);
+  * the serving telemetry sink's occupancy/queue-depth records are
+    schema-valid and aggregated by scripts/telemetry_report.py.
+
+Configs are tiny (C8, jnp backend: the vmapped classic stepper — the
+fused-kernel member fold has its own parity suite in
+tests/test_ensemble.py and cannot execute on CPU anyway).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jaxstream.serve import (AdmissionRefused, EnsembleServer, QueueFull,
+                             RequestQueue, ScenarioRequest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+N, DT = 8, 600.0
+
+
+def _cfg(**over):
+    cfg = {
+        "grid": {"n": N},
+        "time": {"dt": DT},
+        "model": {"name": "shallow_water_cov", "backend": "jnp"},
+        "parallelization": {"num_devices": 1},
+        "serve": {"buckets": "2", "segment_steps": 2,
+                  "queue_capacity": 8},
+    }
+    for k, v in over.items():
+        cfg.setdefault(k, {}).update(v)
+    return cfg
+
+
+# --------------------------------------------------------------- units
+def test_integrate_masked_freezes_members_bitwise():
+    """Member i's state stops changing exactly when its remaining count
+    hits zero; a member with rem >= nsteps matches plain stepping."""
+    from jaxstream.stepping import integrate_masked
+
+    step = lambda y, t: {"y": y["y"] * 2.0 + 1.0}
+    y0 = {"y": jnp.ones((3, 2), jnp.float32)}
+    run = jax.jit(lambda y, r: integrate_masked(
+        step, y, 0.0, r, 4, 1.0, {"y": 0}))
+    y, t, rem = run(y0, jnp.asarray([2, 4, 0], jnp.int32))
+    # 1 -> 3 -> 7 -> 15 -> 31 under 4 steps; member 0 froze at 7,
+    # member 2 never advanced.
+    np.testing.assert_array_equal(
+        np.asarray(y["y"]), [[7, 7], [31, 31], [1, 1]])
+    np.testing.assert_array_equal(np.asarray(rem), [0, 0, 0])
+    assert float(t) == 4.0
+
+
+def test_request_queue_backpressure_and_group_fifo():
+    q = RequestQueue(2)
+    r = [ScenarioRequest(id=f"r{i}", ic=ic, nsteps=1)
+         for i, ic in enumerate(["tc2", "tc5", "tc6"])]
+    q.submit(r[0])
+    q.submit(r[1])
+    with pytest.raises(QueueFull):
+        q.submit(r[2])                      # hard capacity bound
+    # Group-local FIFO: popping the 'flat' group skips the queued tc5
+    # request without disturbing its position.
+    assert q.pop_group("flat").id == "r0"
+    q.submit(r[2])
+    assert q.pop_group("flat").id == "r2"
+    assert q.pop().id == "r1"
+    assert q.pop() is None
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="unknown ic"):
+        ScenarioRequest(id="x", ic="tc9", nsteps=1)
+    with pytest.raises(ValueError, match="nsteps"):
+        ScenarioRequest(id="x", ic="tc2", nsteps=0)
+    with pytest.raises(ValueError, match="output fields"):
+        ScenarioRequest(id="x", ic="tc2", nsteps=1, outputs=("zeta",))
+    with pytest.raises(ValueError, match="unknown keys"):
+        ScenarioRequest.from_dict({"id": "x", "ic": "tc2", "nsteps": 1,
+                                   "color": "red"})
+    r = ScenarioRequest.from_dict(
+        {"id": "x", "ic": "tc5", "nsteps": 3, "outputs": ["h", "u"]})
+    assert r.group == "oro" and r.outputs == ("h", "u")
+
+
+# --------------------------------------------- the packed serving pair
+LENGTHS = (3, 5, 2, 4)     # heterogeneous, none a segment multiple
+
+
+def _run_trace(sink_path):
+    cfg = _cfg(serve={"sink": sink_path})
+    srv = EnsembleServer(cfg)
+    for i, ns in enumerate(LENGTHS):
+        srv.submit(ScenarioRequest(id=f"r{i}", ic="tc2", nsteps=ns,
+                                   seed=i, amplitude=1e-3,
+                                   outputs=("h", "u")))
+    srv.serve()
+    srv.close()
+    return srv
+
+
+@pytest.fixture(scope="module")
+def served_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serve")
+    return (_run_trace(str(d / "a.jsonl")),
+            _run_trace(str(d / "b.jsonl")), d)
+
+
+def test_packing_and_refill_are_deterministic(served_pair):
+    a, b, _ = served_pair
+    assert set(a.results) == {f"r{i}" for i in range(len(LENGTHS))}
+    for rid, ra in a.results.items():
+        rb = b.results[rid]
+        assert ra.status == rb.status == "ok"
+        assert ra.steps_run == ra.nsteps
+        for k in ("h", "u"):
+            np.testing.assert_array_equal(np.asarray(ra.fields[k]),
+                                          np.asarray(rb.fields[k]))
+    # Four requests through two slots: at least two boundary refills,
+    # and the slots stayed busy.
+    assert a.stats["refills"] >= 2
+    assert a.stats["batches"] == 1
+    assert a.stats["member_steps"] == sum(LENGTHS)
+    assert 0.5 < a.occupancy_mean <= 1.0
+    assert 0.0 < a.utilization_mean <= 1.0
+
+
+def test_packed_member_matches_its_solo_trajectory(served_pair):
+    """Masked packed stepping = each member's own run: replay request
+    r0 (3 steps, a non-multiple of the segment) step by step with the
+    same classic stepper.  h is bitwise; u carries the repo's
+    established B>1 per-member bound (<= 1e-6 rel — shape-dependent
+    XLA FMA contraction under the member batching, DESIGN.md "Batched
+    ensemble execution"; the bitwise claim belongs to the B=1 path,
+    tested below)."""
+    a, _, _ = served_pair
+    req = ScenarioRequest(id="r0", ic="tc2", nsteps=3, seed=0,
+                          amplitude=1e-3)
+    model = a._model("flat")
+    y = a._request_state(req)
+    step = jax.jit(model.make_step(DT, "ssprk3"))
+    t = 0.0
+    for _ in range(req.nsteps):
+        y = step(y, t)
+        t += DT
+    np.testing.assert_array_equal(np.asarray(a.results["r0"].fields["h"]),
+                                  np.asarray(y["h"]))
+    got = np.asarray(a.results["r0"].fields["u"], np.float64)
+    want = np.asarray(y["u"], np.float64)
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel <= 1e-6, rel
+
+
+def test_zero_steady_state_recompiles(served_pair):
+    """The shape-bucketing claim: every executable compiles during the
+    bucket warmup (first use) and serving adds NONE."""
+    a, _, _ = served_pair
+    warm = a.stats["warmup_compiles"]
+    assert warm > 0
+    assert a.compile_count() == warm
+
+
+def test_serve_sink_records_and_report(served_pair):
+    a, _, d = served_pair
+    from jaxstream.obs.sink import read_records
+
+    recs = read_records(str(d / "a.jsonl"))       # schema-validates
+    serves = [r for r in recs if r["kind"] == "serve"]
+    assert len(serves) == a.stats["segments"]
+    assert all(0.0 <= r["occupancy"] <= 1.0 for r in serves)
+
+    import telemetry_report
+
+    s = telemetry_report.summarize(recs)
+    sv = s["serving"]
+    assert sv["segments"] == a.stats["segments"]
+    assert sv["completed"] == len(LENGTHS)
+    assert sv["evicted"] == 0
+    assert sv["refilled"] == a.stats["refills"]
+    assert 0.0 < sv["occupancy_mean"] <= 1.0
+    assert sv["queue_depth_max"] >= 0
+
+
+# ------------------------------------------------- parity & resilience
+def test_b1_request_bitwise_vs_plain_simulation(tmp_path):
+    """A request served alone through the B=1 bucket is bitwise the
+    unbatched Simulation run of the same scenario — the single-request
+    acceptance criterion."""
+    from jaxstream.simulation import Simulation
+
+    base = {"grid": {"n": N},
+            "time": {"dt": DT, "nsteps": 5},
+            "model": {"name": "shallow_water_cov",
+                      "initial_condition": "tc2", "backend": "jnp"},
+            "parallelization": {"num_devices": 1}}
+    ref = Simulation(base)
+    ref.run()
+
+    srv = EnsembleServer(_cfg(serve={"buckets": "1"}))
+    srv.submit(ScenarioRequest(id="solo", ic="tc2", nsteps=5, seed=-1,
+                               outputs=("h", "u")))
+    srv.serve()
+    srv.close()
+    res = srv.results["solo"]
+    assert res.status == "ok"
+    np.testing.assert_array_equal(np.asarray(res.fields["h"]),
+                                  np.asarray(ref.state["h"]))
+    np.testing.assert_array_equal(np.asarray(res.fields["u"]),
+                                  np.asarray(ref.state["u"]))
+    assert res.t_final == 5 * DT
+
+
+def test_eviction_under_injected_nan_keeps_batch_alive():
+    """observability.fault_step + serve.fault_member mark one member's
+    health stream bad: that member alone is evicted (guard event with
+    its index), its slot refills, everyone else completes — and the
+    accumulated guard events drive admission control."""
+    cfg = _cfg(serve={"fault_member": 1, "max_guard_events": 1},
+               observability={"fault_step": 2})
+    srv = EnsembleServer(cfg)
+    for i, ns in enumerate((6, 6, 4)):
+        srv.submit(ScenarioRequest(id=f"r{i}", ic="tc2", nsteps=ns,
+                                   seed=i))
+    srv.serve()
+    assert srv.results["r1"].status == "evicted"
+    assert srv.results["r1"].guard_event["member"] == 1
+    assert srv.results["r1"].steps_run < 6
+    for rid in ("r0", "r2"):
+        r = srv.results[rid]
+        assert r.status == "ok"
+        assert np.all(np.isfinite(np.asarray(r.fields["h"])))
+    assert srv.stats["evicted"] == 1 and srv.stats["completed"] == 2
+    assert srv.stats["refills"] >= 1          # the slot was reused
+    # Admission control: 1 guard event >= max_guard_events=1.
+    with pytest.raises(AdmissionRefused):
+        srv.submit(ScenarioRequest(id="late", ic="tc2", nsteps=1))
+    assert srv.stats["refused"] == 1
+    srv.close()
+
+
+def test_monitor_member_attribution_and_breach_callback():
+    """HealthMonitor names the offending member (nonfinite_m{i} rows)
+    in events, HealthError, and the on_breach callback's event — the
+    postmortem-records-the-member-id satellite at the monitor level."""
+    from jaxstream.obs.monitor import HealthError, HealthMonitor
+
+    seen = []
+    names = ("mass", "nonfinite_count", "nonfinite_m0", "nonfinite_m1")
+    mon = HealthMonitor(names, policy="checkpoint_and_raise",
+                        on_breach=lambda ev: seen.append(ev))
+    buf = np.array([[1.0], [1.0], [0.0], [2.0]])
+    with pytest.raises(HealthError) as ei:
+        mon.check([4], [2400.0], buf)
+    assert ei.value.member == 1
+    assert seen and seen[0]["member"] == 1
+    assert mon.events[0]["member"] == 1
+
+    # Zero-arg callbacks keep working, and a clean buffer advances the
+    # last-good cursor without attribution.
+    calls = []
+    mon2 = HealthMonitor(names, policy="checkpoint_and_raise",
+                         on_breach=lambda: calls.append(1))
+    mon2.check([2], [1200.0], np.zeros((4, 1)))
+    assert mon2.last_good_step == 2
+    with pytest.raises(HealthError):
+        mon2.check([4], [2400.0], buf)
+    assert calls == [1]
+
+    # check_members: one event PER failing member, warn never raises.
+    mon3 = HealthMonitor((), policy="warn")
+    evs = mon3.check_members([3, 7, 5], [0.0, 0.0, 0.0],
+                             np.array([2.0, 0.0, np.nan]))
+    assert [e["member"] for e in evs] == [0, 2]
+    assert all(e["kind"] == "guard" for e in evs)
+
+
+def test_server_config_validation():
+    with pytest.raises(ValueError, match="buckets"):
+        EnsembleServer(_cfg(serve={"buckets": "zero"}))
+    with pytest.raises(ValueError, match="guards"):
+        EnsembleServer(_cfg(serve={"guards": "retry"}))
+    with pytest.raises(ValueError, match="dense"):
+        EnsembleServer(_cfg(model={"numerics": "tt"}))
+    with pytest.raises(ValueError, match="single-chip"):
+        EnsembleServer(_cfg(parallelization={"use_shard_map": True,
+                                             "num_devices": 6}))
+    # Knobs the serving tier does not thread must be REJECTED, never
+    # silently ignored (the bitwise-vs-Simulation contract depends on
+    # the model name; the precision policy must never silently run f32).
+    with pytest.raises(ValueError, match="shallow_water_cov"):
+        EnsembleServer(_cfg(model={"name": "auto"}))
+    with pytest.raises(ValueError, match="precision"):
+        EnsembleServer(_cfg(precision={"stage": "bf16"}))
+    with pytest.raises(ValueError, match="temporal_block"):
+        EnsembleServer(_cfg(parallelization={"temporal_block": 4}))
+
+
+def test_serve_cli_summary(tmp_path):
+    """scripts/serve.py end to end: YAML config + JSONL trace -> one
+    JSON summary line + per-request zarr stores."""
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        f"grid: {{n: {N}}}\n"
+        f"time: {{dt: {DT}}}\n"
+        "model: {name: shallow_water_cov, backend: jnp}\n"
+        "serve: {buckets: '2', segment_steps: 2, queue_capacity: 2}\n")
+    trace = tmp_path / "reqs.jsonl"
+    trace.write_text(
+        '{"id": "a", "ic": "tc2", "nsteps": 3, "seed": 0}\n'
+        '{"id": "b", "ic": "tc2", "nsteps": 2, "seed": 1}\n'
+        '{"id": "c", "ic": "tc2", "nsteps": 4, "seed": 2}\n')
+
+    import serve as serve_cli
+
+    out_dir = str(tmp_path / "out")
+    import io as _io
+    from contextlib import redirect_stdout
+
+    buf = _io.StringIO()
+    with redirect_stdout(buf):
+        rc = serve_cli.main([str(cfg), "--requests", str(trace),
+                             "--output-dir", out_dir])
+    assert rc == 0
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) == 1, "CLI must print exactly ONE JSON line"
+    summary = json.loads(lines[0])
+    assert summary["completed"] == 3 and summary["evicted"] == 0
+    assert summary["steady_recompiles"] == 0
+    assert summary["requests"] == {"a": "ok", "b": "ok", "c": "ok"}
+    # The capacity-2 queue forced interleaved admission (producer-side
+    # backpressure), and every request landed a result store.
+    from jaxstream.io.history import HistoryWriter
+
+    for rid, ns in (("a", 3), ("b", 2), ("c", 4)):
+        hw = HistoryWriter(os.path.join(out_dir, rid))
+        assert len(hw) == 1
+        h = hw.read("h")
+        assert h.shape == (1, 6, N, N)
+        assert np.all(np.isfinite(h))
+        assert hw.times[0] == ns * DT
