@@ -27,6 +27,7 @@ __all__ = [
     "EnsembleConfig",
     "ObservabilityConfig",
     "PrecisionConfig",
+    "PlacementConfig",
     "ServeConfig",
     "Config",
     "load_config",
@@ -176,6 +177,13 @@ class EnsembleConfig:
     # Relative height-perturbation amplitude of members 1..B-1 (member 0
     # stays unperturbed): dh = amplitude * mean|h| * smooth mode.
     amplitude: float = 1.0e-3
+    # Device-mesh layout for multi-device ensemble runs (round 12):
+    # 'auto' = the 2-D ('panel', 'member') mesh (num_devices must be a
+    # multiple of 6 — faces exchange over 'panel', members scatter over
+    # 'member'); 'member' = a 1-D ('member',) mesh sharding ONLY the
+    # member axis (any device count that divides `members`; zero wire
+    # traffic, GSPMD path only — use_shard_map needs the panel axis).
+    layout: str = "auto"      # 'auto' | 'panel_member' | 'member'
 
 
 @dataclasses.dataclass(frozen=True)
@@ -226,6 +234,27 @@ class PrecisionConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PlacementConfig:
+    """Multi-chip serving placement (``serve.placement:`` block, round
+    12) — default off, and when off the server is bit-for-bit the
+    single-chip round-11 path.  ``mode: member`` shards the packed
+    member axis across a 1-D ``('member',)`` device mesh (a B=16
+    bucket on 8 chips runs 2 members/chip; zero wire traffic; classic
+    jnp RHS only — GSPMD cannot split the fused kernels' member fold);
+    ``mode: panel`` spreads each request's 6 faces over the 2-D
+    ``('panel', 'member')`` mesh through the batched-exchange ensemble
+    stepper (large grids; num_devices must be a multiple of 6;
+    composes with ``parallelization.overlap_exchange``).  See
+    docs/USAGE.md "Serving" (multi-chip) for when each mode wins."""
+    mode: str = "off"         # 'off' | 'member' | 'panel'
+    # Devices the server may span; 0 = every available device of
+    # device_type.  Buckets that cannot use the whole pool (the plan
+    # needs equal members per chip) use the largest fitting subset.
+    num_devices: int = 0
+    device_type: str = "cpu"  # 'cpu' (virtual devices) | 'tpu' | 'gpu'
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching ensemble server (``jaxstream.serve``, round
     11) — scenario requests packed into the member axis the way LLM
@@ -273,6 +302,19 @@ class ServeConfig:
     fault_member: int = -1
     # Donate the segment carry (XLA aliases input/output state).
     donate: bool = True
+    # Round 12: orography (the TC5 mountain) rides the batch as a
+    # traced per-member field (zeros for the flat families), so
+    # tc2/tc5/tc6/galewsky requests pack into ONE bucket in strict
+    # queue FIFO order (bitwise-equal to the baked-static stepper,
+    # tested).  `true` restores the round-11 batching groups (orography
+    # baked as a stepper static; group-local FIFO; the fused
+    # member-fold kernels apply where they compile) — the parity mode,
+    # and required by placement mode 'panel' (the shard_map stepper
+    # bakes orography per device).
+    group_by_orography: bool = False
+    # Multi-chip placement sub-block (round 12; default mode 'off' =
+    # the single-chip path, byte-for-byte).
+    placement: PlacementConfig = PlacementConfig()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -307,6 +349,7 @@ _SECTIONS = {
 #: their YAML value is a mapping built recursively by _build_section.
 _NESTED_SECTIONS = {
     "AsyncPipelineConfig": AsyncPipelineConfig,
+    "PlacementConfig": PlacementConfig,
 }
 
 

@@ -185,7 +185,7 @@ class HealthMonitor:
                               member=member)
         return new_events
 
-    def check_members(self, steps, ts, counts) -> list:
+    def check_members(self, steps, ts, counts, chips=None) -> list:
         """Per-member breach scan for a serving batch (round 11).
 
         ``counts`` is a ``(B,)`` per-member nonfinite-count vector for
@@ -199,6 +199,13 @@ class HealthMonitor:
         server's ``serve.guards: evict`` mode), ``halt``/
         ``checkpoint_and_raise`` raise on the first failing member as
         :meth:`check` would.  Returns the new events.
+
+        ``chips`` (round 12, multi-chip serving): a per-member device
+        attribution — ``chips[m]`` is the member-shard index whose
+        device(s) hold member ``m`` under the serving placement — and
+        when given each guard event carries it as ``"chip"``, so a
+        fleet operator can see WHICH chip's members keep blowing up
+        (telemetry_report renders the column).
         """
         counts = np.asarray(counts)
         new_events = []
@@ -213,6 +220,8 @@ class HealthMonitor:
                 "last_good_step": self.last_good_step,
                 "last_good_t": self.last_good_t,
             }
+            if chips is not None:
+                event["chip"] = int(chips[m])
             new_events.append(event)
             self.events.append(event)
             log.warning(

@@ -45,7 +45,13 @@ RECORD_KINDS: Dict[str, tuple] = {
     # request-queue depth after refill — the columns
     # scripts/telemetry_report.py aggregates into the serving section.
     # Notable optional keys: "completed"/"evicted"/"refilled" per-
-    # boundary counts, "member_steps" advanced this segment, "group".
+    # boundary counts, "member_steps" advanced this segment, "group";
+    # round 12 adds "host_wait_s" (residual block on the health-stream
+    # HostFetch — the d2h copy overlaps the boundary's host work) and,
+    # under multi-chip placement, "placement"/"devices" plus per-
+    # member-shard "chip_occupancy"/"chip_utilization" lists (the
+    # telemetry_report per-chip columns).  Guard records appended by
+    # the server carry "member" and — under placement — "chip".
     "serve": ("bucket", "occupancy", "queue_depth", "wall_s"),
 }
 

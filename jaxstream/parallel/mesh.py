@@ -33,8 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Config, ParallelConfig
 from ..utils.logging import get_logger
 
-__all__ = ["ShardingSetup", "setup_sharding", "shard_state",
-           "setup_ensemble_sharding", "shard_ensemble_state"]
+__all__ = ["ShardingSetup", "available_devices", "setup_sharding",
+           "shard_state", "setup_ensemble_sharding",
+           "shard_ensemble_state"]
 
 log = get_logger(__name__)
 
@@ -72,11 +73,15 @@ class ShardingSetup:
         are ``(B, 6, ny, nx)`` (member axis immediately before the face
         axis, the :data:`...shallow_water_cov.ENSEMBLE_STATE_AXES`
         layout).  On the ``('panel', 'member')`` mesh the member axis
-        shards over 'member'; on the plain ``('panel', 'y', 'x')`` mesh
-        it is replicated (members stacked locally per face device)."""
+        shards over 'member' and faces over 'panel'; on the 1-D
+        ``('member',)`` mesh (layout='member' — any device count, zero
+        wire traffic) only the member axis shards; on the plain
+        ``('panel', 'y', 'x')`` mesh members are replicated (stacked
+        locally per face device)."""
         axes = self.mesh.axis_names if self.mesh is not None else ()
         if "member" in axes:
-            tail = ("member", "panel", None, None)
+            tail = ("member", "panel" if "panel" in axes else None,
+                    None, None)
         else:
             tail = (None, "panel", "y", "x")
         return P(*((None,) * (ndim - 4) + tail))
@@ -87,22 +92,31 @@ class ShardingSetup:
         return NamedSharding(self.mesh, self.ensemble_spec_for(ndim))
 
 
-def _pick_devices(kind: str, count: int):
+def available_devices(kind: str = "cpu"):
+    """Every device of ``kind`` — the pool a placement planner sizes
+    against (``'default'`` = the default platform; ``'tpu'`` falls
+    back to the 'axon' PJRT plugin this image exposes the chip
+    through).  The ONE device-selection rule — :func:`_pick_devices`
+    is this plus a count requirement."""
     kind = (kind or "cpu").lower()
     if kind == "cpu":
-        devs = jax.devices("cpu")
-    elif kind == "default":
-        devs = jax.devices()
-    elif kind in ("tpu", "gpu", "axon"):
+        return jax.devices("cpu")
+    if kind == "default":
+        return jax.devices()
+    if kind in ("tpu", "gpu", "axon"):
         try:
-            devs = jax.devices(kind)
+            return jax.devices(kind)
         except RuntimeError:
             if kind != "tpu":
                 raise
             # This image exposes the TPU through the 'axon' PJRT plugin.
-            devs = jax.devices("axon")
-    else:
-        raise ValueError(f"unknown device_type {kind!r}; use 'cpu', 'tpu' or 'gpu'")
+            return jax.devices("axon")
+    raise ValueError(
+        f"unknown device_type {kind!r}; use 'cpu', 'tpu' or 'gpu'")
+
+
+def _pick_devices(kind: str, count: int):
+    devs = available_devices(kind)
     if len(devs) < count:
         raise ValueError(
             f"requested num_devices={count} but only {len(devs)} {kind} devices "
@@ -200,7 +214,8 @@ def shard_state(setup: ShardingSetup, state):
 
 
 def setup_ensemble_sharding(config: Any = None,
-                            members: int = 1) -> ShardingSetup:
+                            members: int = 1,
+                            layout: str = "auto") -> ShardingSetup:
     """2-D ``('panel', 'member')`` device mesh for batched ensemble runs.
 
     The ensemble workload has two data-parallel axes: the six cube faces
@@ -218,16 +233,51 @@ def setup_ensemble_sharding(config: Any = None,
     blocks add seam traffic), while extra member shards are free —
     docs/USAGE.md "Ensembles" quantifies the trade.  ``members`` must be
     divisible by ``m`` so every device carries the same member count.
+
+    ``layout='member'`` (round 12) builds a 1-D ``('member',)`` mesh
+    instead: ONLY the member axis shards, one member column per device
+    — any device count that divides ``members`` works (no
+    multiple-of-6 constraint) because members never communicate.  This
+    is the GSPMD path's layout (the vmapped stepper partitions over
+    the member axis with zero wire traffic); the explicit
+    ``use_shard_map`` steppers need the panel axis and reject it.
+    ``'auto'``/``'panel_member'`` are the 2-D mesh above.
     """
     par = _coerce_parallel_config(config)
     if members < 1:
         raise ValueError(f"members must be >= 1, got {members}")
+    if layout not in ("auto", "panel_member", "member"):
+        raise ValueError(
+            f"ensemble layout {layout!r}; valid: 'auto' (the 2-D "
+            f"('panel', 'member') mesh), 'panel_member' (same, "
+            f"explicit), 'member' (1-D member-only mesh)")
     d = par.num_devices
     if d == 1:
         log.info("ensemble sharding: single device (no mesh), %d members "
                  "stacked locally", members)
         return ShardingSetup(mesh=None, num_devices=1, panel=1, sy=1, sx=1,
                              temporal_block=par.temporal_block)
+    if layout == "member":
+        if par.use_shard_map:
+            raise ValueError(
+                "ensemble.layout: member is the GSPMD layout (the "
+                "member axis only); the explicit shard_map steppers "
+                "exchange over the panel axis — set use_shard_map: "
+                "false, or layout: panel_member with num_devices a "
+                "multiple of 6")
+        if members % d:
+            raise ValueError(
+                f"ensemble.layout: member shards {members} members over "
+                f"{d} devices, which must divide evenly; use a device "
+                f"count that divides members (or fewer devices)")
+        devs = np.array(_pick_devices(par.device_type, d))
+        mesh = Mesh(devs, ("member",))
+        log.info("ensemble sharding: %d %s devices as 1-D member mesh "
+                 "(%d members -> %d per device)", d, par.device_type,
+                 members, members // d)
+        return ShardingSetup(mesh=mesh, num_devices=d, panel=1, sy=1,
+                             sx=1, overlap_exchange=par.overlap_exchange,
+                             temporal_block=par.temporal_block, member=d)
     if d % 6:
         raise ValueError(
             f"ensemble sharding factors num_devices as 6 faces x m member "

@@ -762,7 +762,8 @@ def make_sharded_cov_stepper(model, setup, dt: float, overlap=None,
 def make_sharded_cov_ensemble_stepper(model, setup, dt: float,
                                       members: int, overlap=None,
                                       temporal_block: int = 1,
-                                      donate: bool = False):
+                                      donate: bool = False,
+                                      wrap_jit: bool = True):
     """Batched ensemble stepper on the explicit covariant face tier.
 
     ``step(state, t) -> state`` over the batched interior state
@@ -790,6 +791,17 @@ def make_sharded_cov_ensemble_stepper(model, setup, dt: float,
     SPMD dispatch (exact — the face tier's deep-halo approximation is
     NOT applied here; the batched exchange already amortizes the
     latency the deep form trades accuracy for).
+
+    ``wrap_jit=False`` (round 12) returns the raw (untraced) step so a
+    caller can compose it inside its OWN compiled loop — the
+    continuous-batching server's panel-sharded masked segment traces
+    it under one ``jax.jit`` around ``stepping.integrate_masked``,
+    where a nested jit boundary would block carry donation and
+    sharding propagation; the serving loop's per-member nonfinite
+    stream is then a plain GSPMD reduction over the shard_map outputs.
+    The closed-over program tables/orography stay the device-put
+    ``P('panel')`` constants either way (``donate`` only applies to
+    the wrapped jit).
     """
     grid = model.grid
     if setup.mesh is None or setup.panel != 6 or setup.sy * setup.sx != 1:
@@ -846,12 +858,18 @@ def make_sharded_cov_ensemble_stepper(model, setup, dt: float,
     fz_sh = jax.device_put(frames_z, NamedSharding(mesh, P("panel")))
     b_sh = jax.device_put(model.b_ext, NamedSharding(mesh, P("panel")))
 
-    jitted = jax.jit(lambda state: shard_body(state, tables, fz_sh, b_sh),
-                     donate_argnums=(0,) if donate else ())
+    if wrap_jit:
+        jitted = jax.jit(
+            lambda state: shard_body(state, tables, fz_sh, b_sh),
+            donate_argnums=(0,) if donate else ())
 
-    def step(state, t):
-        del t
-        return jitted(state)
+        def step(state, t):
+            del t
+            return jitted(state)
+    else:
+        def step(state, t):
+            del t
+            return shard_body(state, tables, fz_sh, b_sh)
 
     step.ensemble = int(members)
     if temporal_block > 1:

@@ -9,18 +9,29 @@ per-member run-length masking so a finished member's slot is refilled
 from the request queue at the next segment boundary instead of idling
 until the slowest member drains (ROADMAP open item 1; docs/USAGE.md
 "Serving", docs/DESIGN.md "Continuous batching").
+
+Round 12 adds multi-chip serving: the ``serve.placement:`` block maps
+each batch-size bucket onto the available devices — member-parallel
+(the packed member axis shards across a ``('member',)`` mesh) or
+panel-sharded (each request's six faces spread over the
+``('panel', 'member')`` mesh through the batched-exchange ensemble
+stepper); :mod:`jaxstream.serve.placement` holds the pure planner.
 """
 
+from .placement import BucketPlan, plan_placement, placement_report
 from .queue import AdmissionRefused, QueueFull, RequestQueue
 from .request import ScenarioRequest, RequestResult
 from .server import EnsembleServer, serve_requests
 
 __all__ = [
     "AdmissionRefused",
+    "BucketPlan",
     "EnsembleServer",
     "QueueFull",
     "RequestQueue",
     "RequestResult",
     "ScenarioRequest",
+    "placement_report",
+    "plan_placement",
     "serve_requests",
 ]

@@ -3,10 +3,16 @@
 The queue is the server's backpressure surface: capacity is a hard
 bound (``submit`` raises :class:`QueueFull` — or blocks, for callers
 that want producer-side flow control) so a traffic burst shows up as
-rejected admissions, never as unbounded host memory.  Group-aware pops
-(:meth:`RequestQueue.pop_group`) keep FIFO order *within* a batching
-group while letting the server refill a batch with packable requests
-only — requests of the other group keep their queue position.
+rejected admissions, never as unbounded host memory.
+
+Since round 12 the default server packs EVERY family into one batch
+(orography rides as a traced per-member field), so the common pop is
+strict queue-wide FIFO.  Group-aware pops (``pop(group=...)`` /
+:meth:`RequestQueue.pop_group`) remain for the
+``serve.group_by_orography: true`` parity mode: FIFO *within* a
+batching group, letting the server refill a batch with packable
+requests only while requests of the other group keep their queue
+position.
 """
 
 from __future__ import annotations
@@ -72,26 +78,35 @@ class RequestQueue:
                         f"{self.capacity} after {timeout}s")
             self._q.append(req)
 
-    def pop(self) -> Optional[ScenarioRequest]:
-        """Oldest request, or None when empty."""
-        with self._not_full:
-            if not self._q:
-                return None
-            req = self._q.popleft()
-            self._not_full.notify()
-            return req
+    def pop(self, group: Optional[str] = None) -> Optional[ScenarioRequest]:
+        """Oldest request, or None when empty.
 
-    def pop_group(self, group: str) -> Optional[ScenarioRequest]:
-        """Oldest request of one batching group (None if none queued).
-
-        Requests of other groups keep their positions — group-local
-        FIFO, which is what makes the refill order deterministic for a
-        given submission order.
+        ``group`` restricts the pop to one batching group (the
+        ``group_by_orography: true`` parity mode): requests of other
+        groups keep their positions — group-local FIFO, which is what
+        makes the refill order deterministic for a given submission
+        order.  ``None`` (the mixed-orography default) is strict
+        queue-wide FIFO.
         """
         with self._not_full:
             for i, req in enumerate(self._q):
-                if req.group == group:
+                if group is None or req.group == group:
                     del self._q[i]
                     self._not_full.notify()
                     return req
             return None
+
+    def pop_group(self, group: str) -> Optional[ScenarioRequest]:
+        """``pop(group=group)`` — kept as the round-11 spelling."""
+        return self.pop(group)
+
+    def requeue(self, reqs) -> None:
+        """Push popped-but-unserved requests back to the FRONT, in
+        their original order — the server's unwind path when a halting
+        health guard fires after requests were speculatively popped
+        for refill prep.  May exceed ``capacity`` transiently (these
+        requests were already admitted once; dropping them on a guard
+        trip would lose accepted traffic)."""
+        with self._not_full:
+            for req in reversed(list(reqs)):
+                self._q.appendleft(req)

@@ -16,9 +16,12 @@ from typing import Dict, Optional, Tuple
 __all__ = ["SWE_FAMILIES", "ScenarioRequest", "RequestResult"]
 
 #: IC families a server can pack, keyed to their batching group: tc5
-#: carries orography (a stepper-baked static), the rest are flat — only
-#: requests sharing a group can ride one batch (the orography array is
-#: a compile-time constant of the stepper, not per-member state).
+#: carries orography, the rest are flat.  Since round 12 the groups
+#: only matter under ``serve.group_by_orography: true`` (the parity
+#: mode that bakes orography into the stepper as a compile-time
+#: static); the default server threads the mountain as a traced
+#: per-member field (zeros for the flat families) so EVERY family
+#: packs into one batch in strict queue FIFO order.
 SWE_FAMILIES: Dict[str, str] = {
     "tc2": "flat",
     "tc5": "oro",
@@ -69,6 +72,13 @@ class ScenarioRequest:
     @property
     def group(self) -> str:
         return SWE_FAMILIES[self.ic]
+
+    @property
+    def has_orography(self) -> bool:
+        """True when the family carries a bottom mountain (tc5) — the
+        request's traced per-member orography field is then the TC5
+        topography instead of zeros."""
+        return SWE_FAMILIES[self.ic] == "oro"
 
     @classmethod
     def from_dict(cls, d: dict) -> "ScenarioRequest":

@@ -22,28 +22,48 @@ axis of the round-7 batched steppers:
   — injections are ``dynamic_update_slice`` on the member axis of the
   live carry, so the carry layout (and therefore the compiled
   executable) never changes (docs/DESIGN.md "Continuous batching").
+* **Mixed-orography batches** (round 12, the default): the TC5
+  mountain rides the batch as a *traced* per-member field — zeros for
+  the flat families — so tc2/tc5/tc6/galewsky requests pack into ONE
+  bucket in strict queue FIFO order, bitwise-equal to the round-11
+  baked-static stepper (tested).  ``serve.group_by_orography: true``
+  restores the round-11 batching groups (orography a stepper static,
+  group-local FIFO, fused member-fold kernels where they compile).
 * **Health-guarded eviction**: a per-member nonfinite count rides the
   compiled segment; a failing member is evicted alone (guard event
-  carries the member index, ``serve.guards: evict``) while the rest of
-  the batch keeps integrating, and admission control refuses NEW
-  traffic once ``serve.max_guard_events`` trips have accumulated.
+  carries the member index — and its chip, under placement) while the
+  rest of the batch keeps integrating, and admission control refuses
+  NEW traffic once ``serve.max_guard_events`` trips have accumulated.
 * **Async result streaming**: per-member extraction starts its
   device->host copies behind the next segment's dispatch
   (:class:`jaxstream.io.async_pipeline.HostFetch`) and lands on the
   bounded :class:`...BackgroundWriter` — results never stall the
-  batch.
+  batch.  The health stream itself rides a :class:`HostFetch` too:
+  while its d2h copy chases the segment's compute, the host
+  pre-builds the incoming requests' initial states for the slots it
+  already knows will free (completion is host arithmetic on ``rem``),
+  and the residual block is recorded as ``host_wait_s`` in the serve
+  sink records.
 
-Scope (deliberate, documented): single-process, single-chip serving of
-the dense covariant shallow-water tier — the regime bench r05 showed
-batching pays in (members x moderate resolution).  Requests are packed
-only with requests of the same *batching group* (``tc5`` bakes an
-orography array into the stepper as a compile-time static; the flat
-families tc2/tc6/galewsky share one group) — group-local FIFO keeps
-that deterministic.
+**Multi-chip serving** (round 12, ``serve.placement:``): one server
+process drives a whole mesh.  ``mode: member`` shards the packed
+member axis across a 1-D ``('member',)`` device mesh — the SAME
+masked-segment program compiled under member-axis ``in_shardings``
+(GSPMD partitions the vmapped stepper; zero wire traffic; a B=16
+bucket on 8 chips runs 2 members/chip), with slot refill a
+sharding-preserving ``dynamic_update_slice`` whose incoming IC is
+``device_put`` onto the mesh per refill.  ``mode: panel`` spreads each
+request's six faces over the 2-D ``('panel', 'member')`` mesh through
+:func:`jaxstream.parallel.shard_cov.make_sharded_cov_ensemble_stepper`
+(the PR-3 batched exchange — one ppermute per schedule stage carries
+all members' strips — composing with the PR-1 overlap phase split).
+Placement off is byte-for-byte the single-chip round-11 path.
 """
 
 from __future__ import annotations
 
+import copy
+import dataclasses
 import os
 import time
 from typing import Callable, Dict, List, Optional
@@ -58,9 +78,11 @@ from ..geometry.cubed_sphere import build_grid
 from ..io.async_pipeline import BackgroundWriter, HostFetch
 from ..obs.monitor import HealthMonitor
 from ..obs.sink import TelemetrySink, run_manifest
+from ..parallel.mesh import available_devices, setup_ensemble_sharding
 from ..physics import initial_conditions as ics
-from ..stepping import integrate_masked, vmap_ensemble
+from ..stepping import SCHEMES, integrate_masked, vmap_ensemble
 from ..utils.logging import get_logger
+from .placement import PLACEMENT_MODES, BucketPlan, plan_placement
 from .queue import AdmissionRefused, QueueFull, RequestQueue
 from .request import RequestResult, ScenarioRequest
 
@@ -73,10 +95,15 @@ SERVE_WRITER_THREAD_NAME = "jaxstream-serve-writer"
 
 
 def _member_nonfinite(y, axes):
-    """Per-member nonfinite count over every carry leaf: ``(B,)``.
+    """Per-member nonfinite count over the prognostic carry leaves:
+    ``(B,)``.
 
     The on-device health stream of the serving loop — one small vector
     per segment, fetched at the boundary the refill already pays for.
+    Under a placement mesh this is a plain GSPMD reduction: the
+    reduced axes are unsharded, so each member's count is computed
+    entirely on the chip(s) that hold it and only the tiny ``(B,)``
+    result crosses the wire.
     """
     total = None
     for k, ax in axes.items():
@@ -100,18 +127,53 @@ class _Slot:
 
 
 class _Bucket:
-    """One (group, B) compiled runtime: segment/extract/inject jits."""
+    """One (group, B) compiled runtime: segment/extract/inject jits.
+
+    ``plan`` is the bucket's :class:`...placement.BucketPlan`;
+    ``mesh``/``carry_sh``/``rep_sh`` are set when the plan is sharded
+    (``stack``/``put_member``/``put_rem`` then pin their outputs to the
+    mesh so every steady-state call hits the same executable)."""
 
     def __init__(self, group: str, B: int, seg_fn, extract_fn, inject_fn,
-                 axes, init_carry, member_carry):
+                 axes, stack, member_carry, plan: BucketPlan,
+                 mesh=None, carry_sh=None, rep_sh=None):
         self.group = group
         self.B = B
         self.seg = seg_fn
         self.extract = extract_fn
         self.inject = inject_fn
         self.axes = axes
-        self.init_carry = init_carry        # list of B states -> carry
-        self.member_carry = member_carry    # interior state -> member leaves
+        self.plan = plan
+        self.mesh = mesh
+        self._carry_sh = carry_sh
+        self._rep = rep_sh
+        self._stack = stack
+        self._member_carry = member_carry
+
+    def stack(self, trees):
+        """Member trees -> the (device-placed) batch carry."""
+        carry = self._stack(trees)
+        if self._carry_sh is not None:
+            carry = jax.device_put(carry, self._carry_sh)
+        return carry
+
+    def put_member(self, tree):
+        """One member tree -> the inject operand (the per-slot
+        ``device_put`` of the incoming IC under placement: replicated
+        on the bucket's mesh so one inject executable serves every
+        slot)."""
+        member = self._member_carry(tree)
+        if self._rep is not None:
+            member = jax.device_put(
+                member, jax.tree_util.tree_map(lambda _: self._rep,
+                                               member))
+        return member
+
+    def put_rem(self, rem):
+        op = jnp.asarray(rem, jnp.int32)
+        if self._rep is not None:
+            op = jax.device_put(op, self._rep)
+        return op
 
     def jits(self):
         return (self.seg, self.extract, self.inject)
@@ -161,10 +223,10 @@ class EnsembleServer:
         if (cfg.parallelization.use_shard_map
                 or cfg.parallelization.tiles_per_edge > 1):
             raise ValueError(
-                "the serving tier is single-chip for now (the member "
-                "axis IS the batch dimension; scale out with one "
-                "server process per chip) — drop use_shard_map/"
-                "tiles_per_edge from the parallelization block")
+                "the serving tier drives devices through the "
+                "serve.placement: block (mode member/panel), not the "
+                "parallelization flags — drop use_shard_map/"
+                "tiles_per_edge (they configure Simulation runs)")
         if s.guards not in ("off", "evict", "halt"):
             raise ValueError(
                 f"serve.guards={s.guards!r}; valid: 'off', 'evict', "
@@ -183,6 +245,49 @@ class EnsembleServer:
         if s.segment_steps < 1:
             raise ValueError(
                 f"serve.segment_steps must be >= 1, got {s.segment_steps}")
+
+        # ------------------------------------------------ placement plan
+        self._grouping = bool(s.group_by_orography)
+        p = s.placement
+        if p.mode not in PLACEMENT_MODES:
+            raise ValueError(
+                f"serve.placement.mode={p.mode!r}; valid: "
+                f"{PLACEMENT_MODES}")
+        self._devices = None
+        if p.mode != "off":
+            devs = available_devices(p.device_type)
+            n_dev = p.num_devices or len(devs)
+            if n_dev > len(devs):
+                raise ValueError(
+                    f"serve.placement.num_devices={n_dev} but only "
+                    f"{len(devs)} {p.device_type} devices exist. For "
+                    f"CPU testing, start Python with XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={n_dev}.")
+            if p.mode == "member" and cfg.model.backend != "jnp":
+                raise ValueError(
+                    "placement mode 'member' partitions the vmapped "
+                    "classic stepper over the member mesh axis; the "
+                    "fused Pallas kernels fold every member into ONE "
+                    "custom call GSPMD cannot split — set "
+                    "model.backend: jnp, or placement mode: panel "
+                    "(the shard_map per-face kernel path)")
+            if p.mode == "panel":
+                if not self._grouping:
+                    raise ValueError(
+                        "placement mode 'panel' runs the shard_map "
+                        "ensemble stepper, which bakes orography per "
+                        "device — set serve.group_by_orography: true "
+                        "(mixed-orography batches are a member-"
+                        "parallel / single-chip feature)")
+                if cfg.time.scheme != "ssprk3":
+                    raise ValueError(
+                        "placement mode 'panel' runs the explicit "
+                        "ssprk3 face tier; set time.scheme: ssprk3")
+            self._plans: Dict[int, BucketPlan] = plan_placement(
+                self.buckets, n_dev, p.mode)
+            self._devices = list(devs[:n_dev])
+        else:
+            self._plans = plan_placement(self.buckets, 1, "off")
 
         halo = cfg.grid.halo
         if cfg.model.scheme == "ppm":
@@ -203,11 +308,15 @@ class EnsembleServer:
             "batches": 0, "segments": 0, "refills": 0,
             "member_steps": 0, "occupancy_sum": 0.0,
             "utilization_sum": 0.0, "warmup_compiles": 0,
+            "host_wait_s": 0.0,
         }
         self._models: Dict[str, object] = {}
         self._ics: Dict[str, tuple] = {}
+        self._b_zero = None
+        self._b_oro = None
         self._impls: Dict[str, str] = {}
         self._buckets: Dict[tuple, _Bucket] = {}
+        self._setups: Dict[tuple, object] = {}
         self._writer: Optional[BackgroundWriter] = None
         self._sink = None
         if s.sink:
@@ -218,6 +327,8 @@ class EnsembleServer:
                     "segment_steps": s.segment_steps,
                     "queue_capacity": s.queue_capacity,
                     "guards": s.guards,
+                    "placement": p.mode,
+                    "group_by_orography": self._grouping,
                 }))
         self._fault_fired = False
         self._closed = False
@@ -241,6 +352,15 @@ class EnsembleServer:
         self.close()
 
     # ------------------------------------------------------------- building
+    def _group(self, req: ScenarioRequest) -> str:
+        """The request's batching group: its orography group under
+        ``group_by_orography: true``, the single ``'any'`` group (all
+        families pack, strict FIFO) otherwise."""
+        return req.group if self._grouping else "any"
+
+    def _pop(self, group: str) -> Optional[ScenarioRequest]:
+        return self.queue.pop(group if self._grouping else None)
+
     def _ic(self, family: str):
         """Cached base IC fields ``(h_ext, v_ext, b_ext)`` per family."""
         if family not in self._ics:
@@ -258,8 +378,29 @@ class EnsembleServer:
             self._ics[family] = (h, v, b_ext)
         return self._ics[family]
 
+    def _b_ext(self, family: str):
+        """The request's traced orography field (mixed batches): the
+        TC5 mountain for 'tc5', cached zeros for the flat families.
+
+        The mountain is ghost-filled through the SAME halo exchange
+        ``SWEBase.__init__`` applies to a baked static — the analytic
+        IC ghosts differ from the exchanged (continuation-resampled)
+        ones, and bitwise parity with the round-11 stepper depends on
+        feeding the stencils identical ghost values."""
+        if family == "tc5":
+            if self._b_oro is None:
+                self._b_oro = self._model("any").exchange(
+                    self._ic("tc5")[2])
+            return self._b_oro
+        if self._b_zero is None:
+            self._b_zero = jnp.zeros_like(self.grid.sqrtg)
+        return self._b_zero
+
     def _model(self, group: str):
-        """Cached model per batching group (orography is stepper-baked)."""
+        """Cached model per batching group.  'oro' bakes the TC5
+        orography (the ``group_by_orography: true`` parity mode);
+        'flat' and the mixed-batch 'any' group are flat-bottom — the
+        mountain then rides the carry as a traced field."""
         if group not in self._models:
             from ..models.shallow_water_cov import CovariantShallowWater
 
@@ -278,29 +419,106 @@ class EnsembleServer:
         if req.seed >= 0 and req.amplitude != 0.0:
             h = ics.perturbed_ensemble(self.grid, h, 2, seed=req.seed,
                                        amplitude=req.amplitude)[1]
-        return self._model(req.group).initial_state(h, v)
+        return self._model(self._group(req)).initial_state(h, v)
+
+    def _member_tree(self, req: ScenarioRequest):
+        """The request's member tree: interior state, plus its traced
+        orography leaf on the mixed-batch path."""
+        st = self._request_state(req)
+        if not self._grouping:
+            st = dict(st)
+            st["b"] = self._b_ext(req.ic)
+        return st
+
+    def _setup_for(self, plan: BucketPlan):
+        """The (cached) mesh/ShardingSetup of one sharded plan."""
+        key = (plan.mode, plan.num_devices)
+        if key not in self._setups:
+            ptype = self.config.serve.placement.device_type
+            layout = ("member" if plan.mode == "member"
+                      else "panel_member")
+            self._setups[key] = setup_ensemble_sharding(
+                {"parallelization": {
+                    "num_devices": plan.num_devices,
+                    "device_type": ptype,
+                    "overlap_exchange":
+                        self.config.parallelization.overlap_exchange,
+                }},
+                members=plan.bucket, layout=layout)
+        return self._setups[key]
 
     def _build_bucket(self, group: str, B: int, impl: str) -> _Bucket:
         cfg = self.config
         model = self._model(group)
         dt, seg = cfg.time.dt, cfg.serve.segment_steps
+        plan = self._plans[B]
+        setup = self._setup_for(plan) if plan.sharded else None
+
         if impl == "fused":
             step = model.make_fused_step(dt, ensemble=B)
             axes = {"h": 0, "u": 1, "strips_sn": 0, "strips_we": 0}
             member_carry = model.compact_state
-            init_carry = (lambda states:
-                          model.ensemble_compact_state(
-                              model.stack_ensemble(states)))
-        else:
+            stack = (lambda trees:
+                     model.ensemble_compact_state(
+                         model.stack_ensemble(trees)))
+        elif impl == "vmap":
             base = model.make_step(dt, cfg.time.scheme)
             axes = {"h": 0, "u": 1}
             step = vmap_ensemble(base, axes)
             member_carry = lambda st: st
-            init_carry = model.stack_ensemble
+            stack = model.stack_ensemble
+        elif impl == "vmap_b":
+            # Mixed-orography batches: the mountain is a traced
+            # per-member carry leaf read by a per-step model rebind —
+            # bitwise-equal to the baked-static stepper (the add/grad
+            # ops are identical, only constant-ness changes; tested).
+            axes = {"h": 0, "u": 1, "b": 0}
+            scheme_fn = SCHEMES[cfg.time.scheme]
+
+            def one(y, t, _m=model, _dt=dt):
+                mm = copy.copy(_m)
+                mm.b_ext = y["b"]
+                out = scheme_fn(mm.rhs, {"h": y["h"], "u": y["u"]},
+                                t, _dt)
+                return {"h": out["h"], "u": out["u"], "b": y["b"]}
+
+            step = vmap_ensemble(one, axes)
+            member_carry = lambda st: st
+
+            def stack(trees):
+                return {"h": jnp.stack([tr["h"] for tr in trees]),
+                        "u": jnp.stack([tr["u"] for tr in trees],
+                                       axis=1),
+                        "b": jnp.stack([tr["b"] for tr in trees])}
+        elif impl == "panel":
+            from ..parallel.shard_cov import (
+                make_sharded_cov_ensemble_stepper)
+
+            axes = {"h": 0, "u": 1}
+            step = make_sharded_cov_ensemble_stepper(
+                model, setup, dt, B, wrap_jit=False)
+            member_carry = lambda st: st
+            stack = model.stack_ensemble
+        else:
+            raise ValueError(f"unknown bucket impl {impl!r}")
+
+        mesh = carry_sh = rep = None
+        if setup is not None:
+            mesh = setup.mesh
+            carry_sh = {k: setup.ensemble_sharding_for(ax + 4)
+                        for k, ax in axes.items()}
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+
+        # The health stream counts the prognostics only — the traced
+        # orography leaf is constant per member.
+        nf_axes = {k: axes[k] for k in ("h", "u")}
 
         def seg_body(y, rem):
-            y, _, rem = integrate_masked(step, y, 0.0, rem, seg, dt, axes)
-            return y, rem, _member_nonfinite(y, axes)
+            y, _, rem = integrate_masked(step, y, 0.0, rem, seg, dt,
+                                         axes, sharding=carry_sh)
+            return y, rem, _member_nonfinite(y, nf_axes)
 
         def extract_body(y, idx):
             return {k: jnp.take(y[k], idx, axis=axes[k])
@@ -315,26 +533,54 @@ class EnsembleServer:
             return out
 
         donate = (0,) if cfg.serve.donate else ()
-        return _Bucket(group, B,
-                       jax.jit(seg_body, donate_argnums=donate),
-                       jax.jit(extract_body), jax.jit(inject_body),
-                       axes, init_carry, member_carry)
+        if mesh is None:
+            seg_j = jax.jit(seg_body, donate_argnums=donate)
+            ex_j = jax.jit(extract_body)
+            inj_j = jax.jit(inject_body)
+        else:
+            member_sh = {k: rep for k in axes}
+            seg_j = jax.jit(seg_body, donate_argnums=donate,
+                            in_shardings=(carry_sh, rep),
+                            out_shardings=(carry_sh, rep, rep))
+            ex_j = jax.jit(extract_body,
+                           in_shardings=(carry_sh, rep),
+                           out_shardings={"h": rep, "u": rep})
+            inj_j = jax.jit(inject_body,
+                            in_shardings=(carry_sh, rep, member_sh),
+                            out_shardings=carry_sh)
+        return _Bucket(group, B, seg_j, ex_j, inj_j, axes, stack,
+                       member_carry, plan, mesh=mesh,
+                       carry_sh=carry_sh, rep_sh=rep)
+
+    def _impls_for(self, group: str, plan: BucketPlan) -> List[str]:
+        """Candidate stepper impls for one bucket, most preferred
+        first.  Panel-sharded plans run the shard_map ensemble stepper;
+        mixed-orography servers run the traced-b vmapped classic;
+        grouped servers keep the round-11 fused-then-vmap chain
+        (member-sharded plans restrict it to the partitionable vmap —
+        the backend gate in __init__ already enforced jnp)."""
+        if plan.mode == "panel":
+            return ["panel"]
+        if not self._grouping:
+            return ["vmap_b"]
+        if group in self._impls:
+            return [self._impls[group]]
+        cfg = self.config
+        fused_ok = (plan.mode == "single"
+                    and cfg.time.scheme == "ssprk3"
+                    and cfg.model.backend.startswith("pallas")
+                    and cfg.physics.hyperdiffusion == 0.0)
+        return ["fused", "vmap"] if fused_ok else ["vmap"]
 
     def _bucket(self, group: str, B: int) -> _Bucket:
         """The warm (group, B) runtime — built, compiled and probed on
-        first use (fused kernels where they execute, the vmapped
-        classic stepper otherwise; the probe run IS the warmup)."""
+        first use (the probe run IS the warmup)."""
         key = (group, B)
         bk = self._buckets.get(key)
         if bk is not None:
             return bk
-        cfg = self.config
-        impls = [self._impls[group]] if group in self._impls else []
-        if not impls:
-            fused_ok = (cfg.time.scheme == "ssprk3"
-                        and cfg.model.backend.startswith("pallas")
-                        and self.config.physics.hyperdiffusion == 0.0)
-            impls = (["fused", "vmap"] if fused_ok else ["vmap"])
+        plan = self._plans[B]
+        impls = self._impls_for(group, plan)
         err = None
         for impl in impls:
             try:
@@ -343,8 +589,9 @@ class EnsembleServer:
                 self._impls[group] = impl
                 self._buckets[key] = bk
                 self.stats["warmup_compiles"] = self.compile_count()
-                log.info("serve: bucket (%s, B=%d) warm (%s stepper)",
-                         group, B, impl)
+                log.info("serve: bucket (%s, B=%d) warm (%s stepper, "
+                         "placement %s x%d)", group, B, impl,
+                         plan.mode, plan.num_devices)
                 return bk
             except Exception as e:
                 err = e
@@ -357,27 +604,38 @@ class EnsembleServer:
             f"serve: no stepper builds for bucket ({group}, B={B})"
         ) from err
 
+    def _warm_member_tree(self, group: str):
+        family = "tc5" if group == "oro" else "tc2"
+        st = self._model(group).initial_state(*self._ic(family)[:2])
+        if not self._grouping:
+            st = dict(st)
+            st["b"] = self._b_ext(family)
+        return st
+
     def _warm_bucket(self, bk: _Bucket):
         """One dummy masked segment + extract + inject: compiles (and
         probes) every executable the bucket will ever run."""
-        family = "tc5" if bk.group == "oro" else "tc2"
-        st = self._model(bk.group).initial_state(*self._ic(family)[:2])
-        carry = bk.init_carry([st] * bk.B)
-        rem = jnp.zeros((bk.B,), jnp.int32
-                        ).at[0].set(self.config.serve.segment_steps)
-        carry, _, nf = bk.seg(carry, rem)
+        st = self._warm_member_tree(bk.group)
+        carry = bk.stack([st] * bk.B)
+        rem = np.zeros(bk.B, np.int64)
+        rem[0] = self.config.serve.segment_steps
+        carry, _, nf = bk.seg(carry, bk.put_rem(rem))
         jax.block_until_ready(nf)
         ex = bk.extract(carry, jnp.int32(0))
-        carry = bk.inject(carry, jnp.int32(0), bk.member_carry(st))
+        carry = bk.inject(carry, jnp.int32(0), bk.put_member(st))
         jax.block_until_ready((ex["h"], carry["h"]))
 
     def warmup(self, groups=("flat",), buckets=None):
         """Pre-compile the bucket set so the first real traffic hits
         warm executables (steady-state = zero recompiles).  ``groups``:
-        which batching groups to warm ('flat' and/or 'oro')."""
+        which batching groups to warm ('flat' and/or 'oro'; on the
+        mixed-orography default every name maps to the single packed
+        group)."""
         for g in groups:
-            if g not in ("flat", "oro"):
+            if g not in ("flat", "oro", "any"):
                 raise ValueError(f"unknown batching group {g!r}")
+            if not self._grouping:
+                g = "any"
             for B in (buckets or self.buckets):
                 self._bucket(g, B)
         return self.compile_count()
@@ -394,6 +652,20 @@ class EnsembleServer:
                     return -1
                 total += cs()
         return total
+
+    def placement_summary(self) -> Optional[dict]:
+        """The resolved per-bucket placement (None when placement is
+        off) — the CLI/bench surface of the planner."""
+        p = self.config.serve.placement
+        if p.mode == "off":
+            return None
+        return {
+            "mode": p.mode,
+            "device_type": p.device_type,
+            "devices": len(self._devices),
+            "buckets": {str(b): dataclasses.asdict(pl)
+                        for b, pl in sorted(self._plans.items())},
+        }
 
     # ------------------------------------------------------------ admission
     def submit(self, req: ScenarioRequest, block: bool = False,
@@ -442,33 +714,58 @@ class EnsembleServer:
         evict / extract / refill until every slot drains."""
         cfg = self.config
         s, dt = cfg.serve, cfg.time.dt
-        group = first.group
+        group = self._group(first)
         batch: List[ScenarioRequest] = [first]
         while len(batch) < max(self.buckets):
-            r = self.queue.pop_group(group)
+            r = self._pop(group)
             if r is None:
                 break
             batch.append(r)
         B = next(b for b in self.buckets if b >= len(batch))
         bk = self._bucket(group, B)
+        plan = bk.plan
         self.stats["batches"] += 1
 
-        states = [self._request_state(r) for r in batch]
-        carry = bk.init_carry(states + [states[0]] * (B - len(batch)))
+        trees = [self._member_tree(r) for r in batch]
+        carry = bk.stack(trees + [trees[0]] * (B - len(batch)))
         slots: List[Optional[_Slot]] = (
             [_Slot(r) for r in batch] + [None] * (B - len(batch)))
         rem = np.zeros(B, np.int64)
         rem[:len(batch)] = [r.nsteps for r in batch]
         seg = s.segment_steps
+        m_shards = plan.member_shards
+        per_shard = B // m_shards
+        chips = ([i // per_shard for i in range(B)]
+                 if m_shards > 1 else None)
 
         while any(sl is not None for sl in slots):
             w0 = time.perf_counter()
-            active_before = sum(sl is not None for sl in slots)
-            carry, _, nf = bk.seg(carry, jnp.asarray(rem, jnp.int32))
-            nf_host = np.asarray(jax.device_get(nf), np.float64)
-            wall = time.perf_counter() - w0
+            active_mask = [sl is not None for sl in slots]
+            active_before = sum(active_mask)
+            carry, _, nf = bk.seg(carry, bk.put_rem(rem))
+            # The health stream rides a HostFetch: its d2h copy chases
+            # the segment's compute while the host does the boundary
+            # work that does NOT depend on it — completion is pure
+            # arithmetic on `rem`, so the incoming requests' initial
+            # states can be built now, overlapping the device.
+            nf_fetch = HostFetch(nf)
             new_rem = np.maximum(rem - seg, 0)
-            member_steps = int(np.sum(rem - new_rem))
+            n_free_pred = sum(
+                1 for i, sl in enumerate(slots)
+                if sl is not None and new_rem[i] == 0)
+            prepped: List[tuple] = []
+            for _ in range(n_free_pred):
+                r = self._pop(group)
+                if r is None:
+                    break
+                prepped.append((r, self._member_tree(r)))
+            hw0 = time.perf_counter()
+            nf_host = np.asarray(nf_fetch.resolve(),
+                                 np.float64).reshape(-1)
+            host_wait = time.perf_counter() - hw0
+            wall = time.perf_counter() - w0
+            steps_by_slot = rem - new_rem
+            member_steps = int(np.sum(steps_by_slot))
             rem = new_rem
             for i, sl in enumerate(slots):
                 if sl is not None:
@@ -489,13 +786,27 @@ class EnsembleServer:
                 steps = [sl.done if sl is not None else 0 for sl in slots]
                 ts = [d * dt for d in steps]
                 # 'halt' policy raises here (HealthError) — the writer
-                # flush in serve()'s finally still lands prior results.
-                for ev in self.monitor.check_members(steps, ts, counts):
+                # flush in serve()'s finally still lands prior
+                # results, and the speculatively popped refill
+                # requests go back to the queue head (they were
+                # admitted; a guard trip must not lose them).
+                try:
+                    events = self.monitor.check_members(
+                        steps, ts, counts, chips=chips)
+                except BaseException:
+                    if prepped:
+                        self.queue.requeue(r for r, _ in prepped)
+                    raise
+                for ev in events:
                     i = ev["member"]
                     self._finish(slots[i], "evicted", None, ev)
                     rem[i] = 0
                     slots[i] = None
                     evicted += 1
+                    if self._sink is not None:
+                        # The event is already a schema-valid 'guard'
+                        # record; under placement it names the chip.
+                        self._sink.write(ev)
             for i, sl in enumerate(slots):
                 if sl is not None and rem[i] == 0:
                     fetch = HostFetch(bk.extract(carry, jnp.int32(i)))
@@ -506,14 +817,26 @@ class EnsembleServer:
             for i in range(B):
                 if slots[i] is not None:
                     continue
-                r = self.queue.pop_group(group)
-                if r is None:
-                    break
+                if prepped:
+                    r, tree = prepped.pop(0)
+                else:
+                    r = self._pop(group)
+                    if r is None:
+                        break
+                    tree = self._member_tree(r)
                 carry = bk.inject(carry, jnp.int32(i),
-                                  bk.member_carry(self._request_state(r)))
+                                  bk.put_member(tree))
                 rem[i] = r.nsteps
                 slots[i] = _Slot(r)
                 refilled += 1
+            # Prepped requests can never be left over: free slots >=
+            # predicted completions (eviction only adds frees) and the
+            # refill loop scans every slot, consuming prepped first.
+            # A popped request silently dropped would be a lost-
+            # traffic bug, so the invariant fails loudly.
+            assert not prepped, (
+                "serve refill invariant broken: speculatively popped "
+                f"requests left unslotted: {[r.id for r, _ in prepped]}")
             st = self.stats
             st["segments"] += 1
             st["refills"] += refilled
@@ -522,16 +845,33 @@ class EnsembleServer:
             st["utilization_sum"] += member_steps / (B * seg)
             st["completed"] += completed
             st["evicted"] += evicted
+            st["host_wait_s"] += host_wait
             if self._sink is not None:
-                self._sink.write({
+                rec = {
                     "kind": "serve", "bucket": B, "group": group,
                     "occupancy": round(active_before / B, 4),
                     "utilization": round(member_steps / (B * seg), 4),
                     "queue_depth": len(self.queue),
                     "wall_s": round(wall, 6),
+                    "host_wait_s": round(host_wait, 6),
                     "completed": completed, "evicted": evicted,
                     "refilled": refilled, "member_steps": member_steps,
-                })
+                }
+                if plan.sharded:
+                    rec["placement"] = plan.mode
+                    rec["devices"] = plan.num_devices
+                    rec["chip_occupancy"] = [
+                        round(sum(active_mask[j * per_shard:
+                                              (j + 1) * per_shard])
+                              / per_shard, 4)
+                        for j in range(m_shards)]
+                    rec["chip_utilization"] = [
+                        round(float(np.sum(
+                            steps_by_slot[j * per_shard:
+                                          (j + 1) * per_shard]))
+                            / (per_shard * seg), 4)
+                        for j in range(m_shards)]
+                self._sink.write(rec)
 
     def _finish(self, slot: _Slot, status: str,
                 fetch: Optional[HostFetch], event: Optional[dict] = None):

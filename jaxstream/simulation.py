@@ -160,7 +160,14 @@ class Simulation:
             if self.members > 1:
                 self.state = self._build_ensemble_state()
                 if par.num_devices > 1:
-                    self.setup = setup_ensemble_sharding(cfg, self.members)
+                    # ensemble.layout (round 12): 'auto' = the 2-D
+                    # ('panel', 'member') mesh; 'member' = the 1-D
+                    # member-only mesh (any device count dividing the
+                    # ensemble; GSPMD path, zero wire traffic) — the
+                    # same layout the serving tier's member-parallel
+                    # placement runs on.
+                    self.setup = setup_ensemble_sharding(
+                        cfg, self.members, layout=cfg.ensemble.layout)
                     self.state = shard_ensemble_state(self.setup,
                                                       self.state)
                 self._step = make_stepper_for(

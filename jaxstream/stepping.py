@@ -172,7 +172,7 @@ def integrate(step: Callable, y0, t0: float, nsteps: int, dt: float,
 
 
 def integrate_masked(step: Callable, y0, t0: float, rem0, nsteps: int,
-                     dt: float, axes):
+                     dt: float, axes, sharding=None):
     """:func:`integrate` over a member-batched carry with per-member
     run-length masking — the continuous-batching serving loop's inner
     segment (``jaxstream.serve``).
@@ -194,6 +194,13 @@ def integrate_masked(step: Callable, y0, t0: float, rem0, nsteps: int,
     per-member model time is host bookkeeping (``steps_done * dt``).
     Returns ``(y, t, rem)`` with ``rem`` decremented once per iteration
     for each then-active member (floor 0).
+
+    ``sharding`` (round 12, multi-chip serving): a pytree of
+    ``NamedSharding`` matching ``y0`` — each iteration's masked carry
+    is pinned to it with ``with_sharding_constraint`` so GSPMD keeps
+    the member (or panel) layout stable through the loop instead of
+    ever deciding to reshard mid-segment.  Constraints never change
+    values; ``None`` (the default) is the exact single-device path.
     """
 
     def body(_, carry):
@@ -207,6 +214,8 @@ def integrate_masked(step: Callable, y0, t0: float, rem0, nsteps: int,
             return jnp.where(active.reshape(shape), new, old)
 
         y = jtu.tree_map(sel, y2, y, axes)
+        if sharding is not None:
+            y = jax.lax.with_sharding_constraint(y, sharding)
         return y, t + dt, rem - active.astype(rem.dtype)
 
     return jax.lax.fori_loop(

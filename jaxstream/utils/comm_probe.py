@@ -27,7 +27,7 @@ from __future__ import annotations
 from .profiling import median_chain_seconds
 
 __all__ = ["temporal_block_plan", "batched_exchange_plan",
-           "probe_exchange", "probe_step_rates",
+           "serve_placement_plan", "probe_exchange", "probe_step_rates",
            "run_default_probe", "format_report"]
 
 #: ppermutes per SSPRK3 step of the serialized face-tier exchange:
@@ -137,6 +137,26 @@ def batched_exchange_plan(n: int, halo: int, members: int,
         "strip_dtype_bytes": dtype_bytes,
         "wire_bytes_saving_vs_f32": 1.0 - dtype_bytes / 4.0,
     }
+
+
+def serve_placement_plan(buckets, num_devices: int, n: int,
+                         halo: int = 2, dtype_bytes: int = 4) -> dict:
+    """Static serving-placement accounting (round 12) — the
+    ``comm_probe --serve`` report body.
+
+    Pure arithmetic — no devices, no jax — a thin wrap of
+    :func:`jaxstream.serve.placement.placement_report`: for each
+    placement mode (member-parallel / panel-sharded), per batch-size
+    bucket, the resolved device split and the halo-exchange bytes per
+    step it would put on the wire (member mode: ZERO — members never
+    communicate; panel mode: the face tier's 12 ppermutes/step at the
+    batched-exchange payload).  ``dtype_bytes=2`` re-bills a 16-bit
+    strips policy, like the other plans.
+    """
+    from ..serve.placement import placement_report
+
+    return placement_report(buckets, num_devices, n, halo,
+                            dtype_bytes=dtype_bytes)
 
 
 def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
@@ -400,6 +420,23 @@ def format_report(result: dict) -> str:
             + (f" (16-bit strips: -"
                f"{100 * be['wire_bytes_saving_vs_f32']:.0f}% wire)"
                if be.get("wire_bytes_saving_vs_f32") else ""))
+    sp = result.get("serve_placement_plan")
+    if sp:
+        for mode, info in sp["modes"].items():
+            if "skipped" in info:
+                lines.append(
+                    f"comm_probe{tag}: serve placement {mode} on "
+                    f"{sp['num_devices']} devices: skipped "
+                    f"({info['skipped']})")
+                continue
+            for row in info["buckets"]:
+                lines.append(
+                    f"comm_probe{tag}: serve placement {mode} B="
+                    f"{row['bucket']}: {row['mode']} on "
+                    f"{row['devices']} device(s) "
+                    f"({row['panel_shards']}x{row['member_shards']} "
+                    f"mesh, {row['members_per_shard']} members/shard) "
+                    f"exchange/step={row['exchange_bytes_per_step']:.0f} B")
     tb = result.get("temporal_block_plan")
     if tb:
         lines.append(

@@ -52,6 +52,16 @@ every gate run self-checking):
    throughput numbers only exist on offline TPU bench runs; the fast
    gate is what certifies the machinery between them).
 
+7. **Multichip-serving tests ride the in-process fake devices**
+   (round-12 satellite): a module importing the serving placement
+   surface (``jaxstream.serve.placement``) must not launch subprocess
+   workers.  Rule 6 already keeps it non-slow; the remaining way to
+   lose the coverage is a rewrite onto a subprocess device worker —
+   which rule 2 would then force into the slow tier, silently dropping
+   the member-parallel/panel-sharded parities from every fast gate.
+   The conftest's 8 virtual CPU devices exist exactly so these tests
+   run in-process.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -87,6 +97,12 @@ _PRECISION_IMPORT_RE = re.compile(
 _SERVE_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.serve\b|import\s+jaxstream\.serve\b"
     r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*serve\b)",
+    re.MULTILINE)
+_PLACEMENT_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.serve\.placement\b"
+    r"|import\s+jaxstream\.serve\.placement\b"
+    r"|from\s+jaxstream\.serve\s+import\s+[^\n]*"
+    r"\b(placement|plan_placement|placement_report|BucketPlan)\b)",
     re.MULTILINE)
 
 
@@ -147,6 +163,14 @@ def lint_file(path: str, allowed: set):
                f"eviction, backpressure, zero steady-state recompiles) "
                f"must run in every fast gate; move the slow test to a "
                f"module that does not import jaxstream.serve")
+    if _PLACEMENT_IMPORT_RE.search(src) and "subprocess" in src:
+        yield (f"{rel}: imports the serving placement surface "
+               f"(jaxstream.serve.placement) but launches subprocesses "
+               f"— multichip-serving parities must run IN-PROCESS on "
+               f"the conftest's 8 virtual CPU devices (a subprocess "
+               f"device worker would be forced slow by rule 2, "
+               f"silently dropping member-parallel/panel-sharded "
+               f"coverage from the fast gate)")
 
 
 def main(repo_root: str = None) -> int:

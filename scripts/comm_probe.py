@@ -9,7 +9,9 @@ Usage::
 
     python scripts/comm_probe.py [n] [--iters K] [--steps K]
                                  [--temporal-block K] [--members B]
-                                 [--strip-dtype f32|bf16] [--json]
+                                 [--strip-dtype f32|bf16]
+                                 [--serve BUCKETS [--serve-devices D]]
+                                 [--json]
 
 ``--temporal-block K`` adds the deep-halo blocked stepper's rate and
 the static exchanges/step + redundant-compute accounting
@@ -22,6 +24,14 @@ the batched-exchange payload/ppermute accounting
 strips policy banks (``jaxstream.ops.pallas.precision``).  Measured
 latencies still ship f32 strips (the sharded steppers run f32
 numerics); the plans tag the savings explicitly.
+
+``--serve BUCKETS`` (round 12) prints the serving placement-plan
+report instead of the latency probes: for each placement mode
+(member-parallel / panel-sharded), per batch-size bucket, the
+resolved device split and the exchange bytes per step it would put on
+the wire (``jaxstream.utils.comm_probe.serve_placement_plan``).  Pure
+arithmetic — runs in milliseconds with no devices.  ``--serve-devices
+D`` sizes the pool (default 8); ``[n]`` and ``--strip-dtype`` apply.
 
 Device selection: uses the DEFAULT platform's devices when at least 6
 exist (a real slice measures real ICI); otherwise falls back to 6
@@ -48,8 +58,30 @@ def main():
     temporal_block = 0
     members = 0
     strip_dtype = "f32"
+    serve_buckets = None
+    serve_devices = 8
     as_json = "--json" in args
     for i, a in enumerate(args):
+        if a == "--serve":
+            if i + 1 >= len(args) or args[i + 1].startswith("--"):
+                print("usage: comm_probe.py ... --serve BUCKETS "
+                      "(e.g. --serve 1,4,16)", file=sys.stderr)
+                raise SystemExit(2)
+            try:
+                serve_buckets = [int(b) for b in args[i + 1].split(",")
+                                 if b.strip()]
+            except ValueError:
+                print(f"--serve {args[i + 1]!r}: buckets must be a "
+                      f"comma-separated list of ints", file=sys.stderr)
+                raise SystemExit(2)
+            continue
+        if a == "--serve-devices":
+            if i + 1 >= len(args) or not args[i + 1].isdigit():
+                print("usage: comm_probe.py ... --serve-devices D",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            serve_devices = int(args[i + 1])
+            continue
         if a in ("--iters", "--steps", "--temporal-block", "--members"):
             if i + 1 >= len(args) or not args[i + 1].isdigit():
                 print(f"usage: comm_probe.py [n] [--iters K] [--steps K] "
@@ -75,6 +107,21 @@ def main():
 
     from jaxstream.ops.pallas.precision import strip_dtype_bytes
     from jaxstream.utils import comm_probe
+
+    if serve_buckets is not None:
+        # Placement-plan report: pure arithmetic, no devices touched.
+        n = n_arg or 96
+        result = {
+            "n": n,
+            "serve_placement_plan": comm_probe.serve_placement_plan(
+                serve_buckets, serve_devices, n,
+                dtype_bytes=strip_dtype_bytes(strip_dtype)),
+        }
+        if as_json:
+            print(json.dumps(result))
+        else:
+            print(comm_probe.format_report(result))
+        return result
 
     result = comm_probe.run_default_probe(
         iters=iters, steps=steps, n=n_arg,

@@ -17,8 +17,9 @@ blocks at the queue bound while batches drain), served by packing into
 the member axis, and — when ``--output-dir``/``serve.output_dir`` is
 set — written as one zarr store per request through the background
 writer.  Prints exactly ONE JSON summary line on stdout (request
-statuses, occupancy/utilization, latency percentiles, compile counts);
-everything else goes to stderr.  Set ``serve.sink`` for per-segment
+statuses, occupancy/utilization, latency percentiles, compile counts,
+host-wait totals, and — under ``serve.placement`` — the resolved
+per-bucket multi-chip plan); everything else goes to stderr.  Set ``serve.sink`` for per-segment
 occupancy/queue-depth telemetry readable by
 ``scripts/telemetry_report.py``.
 """
@@ -113,10 +114,14 @@ def main(argv=None) -> int:
         "warmup_compiles": server.stats["warmup_compiles"],
         "steady_recompiles": (server.compile_count()
                               - server.stats["warmup_compiles"]),
+        "host_wait_s_total": round(server.stats["host_wait_s"], 4),
         "wall_s": round(wall, 3),
         "requests": {r.id: r.status
                      for r in server.results.values()},
     }
+    placement = server.placement_summary()
+    if placement is not None:
+        summary["placement"] = placement
     print(json.dumps(summary))
     return 0 if server.stats["evicted"] == 0 else 1
 

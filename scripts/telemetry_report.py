@@ -79,6 +79,22 @@ def summarize(records):
         occ = [s["occupancy"] for s in serves]
         util = [s.get("utilization") for s in serves
                 if s.get("utilization") is not None]
+        # Per-chip occupancy/utilization (round 12, multi-chip
+        # placement): 'serve' records carry one value per member shard
+        # ("chip" = member column; 6 devices each under panel
+        # sharding).  Averaged per chip index over the records that
+        # report it (bucket sizes can differ across segments).
+        def _chip_means(key):
+            rows = [s[key] for s in serves if s.get(key)]
+            if not rows:
+                return None
+            width = max(len(r) for r in rows)
+            means = []
+            for j in range(width):
+                vals = [r[j] for r in rows if j < len(r)]
+                means.append(sum(vals) / len(vals))
+            return means
+
         serving = {
             "segments": len(serves),
             "occupancy_mean": sum(occ) / len(occ),
@@ -90,12 +106,22 @@ def summarize(records):
             "refilled": sum(s.get("refilled", 0) for s in serves),
             "member_steps": sum(s.get("member_steps", 0)
                                 for s in serves),
+            "host_wait_total_s": sum(s.get("host_wait_s", 0.0)
+                                     for s in serves),
+            "devices": max((s.get("devices", 1) for s in serves),
+                           default=1),
+            "placement_modes": sorted(
+                {s["placement"] for s in serves if s.get("placement")}),
+            "chip_occupancy_mean": _chip_means("chip_occupancy"),
+            "chip_utilization_mean": _chip_means("chip_utilization"),
             "timeline": [
                 {"bucket": s["bucket"],
                  "occupancy": s["occupancy"],
                  "utilization": s.get("utilization"),
                  "queue_depth": s["queue_depth"],
                  "wall_s": s["wall_s"],
+                 "host_wait_s": s.get("host_wait_s", 0.0),
+                 "devices": s.get("devices", 1),
                  "completed": s.get("completed", 0),
                  "evicted": s.get("evicted", 0),
                  "refilled": s.get("refilled", 0)}
@@ -148,14 +174,17 @@ def print_report(s):
     if s.get("serving"):
         sv = s["serving"]
         print("\nserving (continuous-batching server):")
-        print(f"  {'bucket':>6} {'occupancy':>9} {'util':>6} "
-              f"{'queue':>5} {'wall s':>9} {'done':>5} {'evict':>5} "
+        print(f"  {'bucket':>6} {'chips':>5} {'occupancy':>9} "
+              f"{'util':>6} {'queue':>5} {'wall s':>9} "
+              f"{'host wait':>9} {'done':>5} {'evict':>5} "
               f"{'refill':>6}")
         for seg in sv["timeline"]:
             util = seg["utilization"]
-            print(f"  {seg['bucket']:>6} {seg['occupancy']:>9.3f} "
+            print(f"  {seg['bucket']:>6} {seg['devices']:>5} "
+                  f"{seg['occupancy']:>9.3f} "
                   f"{util if util is None else format(util, '>6.3f')} "
                   f"{seg['queue_depth']:>5} {seg['wall_s']:>9.4f} "
+                  f"{seg['host_wait_s']:>9.4f} "
                   f"{seg['completed']:>5} {seg['evicted']:>5} "
                   f"{seg['refilled']:>6}")
         print(f"  {sv['segments']} segments: occupancy mean "
@@ -163,13 +192,26 @@ def print_report(s):
               f"), max queue depth {sv['queue_depth_max']}, "
               f"{sv['completed']} completed / {sv['evicted']} evicted / "
               f"{sv['refilled']} refilled, {sv['member_steps']} "
-              f"member-steps")
+              f"member-steps, host wait {sv['host_wait_total_s']:.4f}s")
+        if sv.get("chip_occupancy_mean"):
+            modes = ",".join(sv["placement_modes"]) or "?"
+            occ_c = " ".join(f"{v:.3f}"
+                             for v in sv["chip_occupancy_mean"])
+            line = (f"  per-chip (placement {modes}, "
+                    f"{sv['devices']} devices): occupancy [{occ_c}]")
+            if sv.get("chip_utilization_mean"):
+                util_c = " ".join(f"{v:.3f}"
+                                  for v in sv["chip_utilization_mean"])
+                line += f" utilization [{util_c}]"
+            print(line)
 
     if s["guards"]:
         print("\nguard events:")
         for g in s["guards"]:
             who = (f", member {g['member']}" if g.get("member") is not None
                    else "")
+            if g.get("chip") is not None:
+                who += f" on chip {g['chip']}"
             print(f"  step {g['step']}: {g['event']} (value {g['value']:g},"
                   f" policy {g['policy']}{who}, last good step "
                   f"{g['last_good_step']})")

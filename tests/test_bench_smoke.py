@@ -85,6 +85,34 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert srv["packed"]["refills"] > 0
     assert srv["packed"]["member_steps"] == srv["serial_B1"]["member_steps"]
 
+    # The multi-chip serving canary (round 12) drove the member-
+    # parallel placement on a 6-fake-device CPU mesh through the REAL
+    # bench_serving_multichip code path: every request completed in
+    # both runs, packed h results byte-matched between the
+    # single-device and sharded servers, u stayed inside the packed-
+    # vs-packed budget, and steady-state serving compiled NOTHING
+    # under placement.  The 0.8x scaling floor is reported only (all
+    # fake devices share this host's cores; it is enforced on real
+    # accelerators by the full bench run).
+    mc = rec["serving_multichip"]
+    assert "skipped" not in mc, mc
+    assert mc["devices"] >= 2
+    assert mc["mode"] == "member"
+    assert mc["floor_enforced"] is False        # fake CPU mesh
+    assert mc["bitwise_h_ok"] is True
+    assert mc["u_rel_max"] <= 2e-6
+    assert mc["zero_steady_recompiles"] is True
+    for m in ("single", "multichip"):
+        assert mc[m]["completed"] > 0, m
+        assert mc[m]["steady_recompiles"] == 0, m
+        assert mc[m]["member_steps_per_sec"] > 0.0, m
+    # Equal per-chip load: the multichip run served devices x the
+    # single run's member-steps.
+    assert (mc["multichip"]["member_steps"]
+            == mc["devices"] * mc["single"]["member_steps"])
+    assert mc["multichip"]["placement"]["mode"] == "member"
+    assert isinstance(mc["scaling_vs_ideal"], float)
+
     # The precision ladder (round 10) ran all four rows through the
     # real --precision-report code path: reduced-precision stage
     # kernels, carry encoders, and the precision-corrected roofline

@@ -144,6 +144,38 @@ def test_serve_module_with_slow_marker_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 0
 
 
+def test_placement_module_with_subprocess_detected(tmp_path):
+    """Rule 7 (round-12 satellite): multichip-serving tests stay in
+    the fast tier BY CONSTRUCTION — a module importing the serving
+    placement surface may not launch subprocess workers (rule 2 would
+    then force it slow, dropping the member-parallel/panel-sharded
+    parities from every fast gate); it must ride the conftest's
+    in-process fake devices."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_mc.py").write_text(
+        "import subprocess\n"
+        "from jaxstream.serve.placement import plan_placement\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', 'mc_worker.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module without the subprocess launch is clean.
+    (tests / "test_mc.py").write_text(
+        "from jaxstream.serve.placement import plan_placement\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # ...and the `from jaxstream.serve import plan_placement` spelling
+    # is caught too.
+    (tests / "test_mc.py").write_text(
+        "import subprocess\n"
+        "from jaxstream.serve import plan_placement\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', 'mc_worker.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+
+
 def test_precision_module_with_slow_marker_detected(tmp_path):
     """Rule 5 (round-10 satellite): precision-parity tests stay tier-1
     — a module importing jaxstream.ops.pallas.precision must carry no

@@ -263,6 +263,28 @@ def test_eviction_under_injected_nan_keeps_batch_alive():
     srv.close()
 
 
+def test_halt_guard_requeues_prepped_requests():
+    """serve.guards: halt fires AFTER the boundary's refill prep has
+    speculatively popped queued requests — they must go back to the
+    queue head (admitted traffic is never lost to a guard trip)."""
+    from jaxstream.obs.monitor import HealthError
+
+    cfg = _cfg(serve={"guards": "halt", "fault_member": 0},
+               observability={"fault_step": 2})
+    srv = EnsembleServer(cfg)
+    # r0 faults at its step 2; r1 completes at that same boundary, so
+    # the prep path pops r2 before the health check raises.
+    srv.submit(ScenarioRequest(id="r0", ic="tc2", nsteps=6, seed=0))
+    srv.submit(ScenarioRequest(id="r1", ic="tc2", nsteps=2, seed=1))
+    srv.submit(ScenarioRequest(id="r2", ic="tc2", nsteps=2, seed=2))
+    with pytest.raises(HealthError):
+        srv.serve()
+    assert "r2" not in srv.results
+    assert len(srv.queue) == 1
+    assert srv.queue.pop().id == "r2"
+    srv.close()
+
+
 def test_monitor_member_attribution_and_breach_callback():
     """HealthMonitor names the offending member (nonfinite_m{i} rows)
     in events, HealthError, and the on_breach callback's event — the
@@ -306,7 +328,9 @@ def test_server_config_validation():
         EnsembleServer(_cfg(serve={"guards": "retry"}))
     with pytest.raises(ValueError, match="dense"):
         EnsembleServer(_cfg(model={"numerics": "tt"}))
-    with pytest.raises(ValueError, match="single-chip"):
+    # Multi-chip serving is the serve.placement block's job, not the
+    # parallelization flags (those configure Simulation runs).
+    with pytest.raises(ValueError, match="serve.placement"):
         EnsembleServer(_cfg(parallelization={"use_shard_map": True,
                                              "num_devices": 6}))
     # Knobs the serving tier does not thread must be REJECTED, never
@@ -318,6 +342,80 @@ def test_server_config_validation():
         EnsembleServer(_cfg(precision={"stage": "bf16"}))
     with pytest.raises(ValueError, match="temporal_block"):
         EnsembleServer(_cfg(parallelization={"temporal_block": 4}))
+
+
+def test_mixed_orography_batch_packs_all_families():
+    """The round-12 default: tc2/tc5/tc6 requests pack into ONE batch
+    (orography a traced per-member field), every result matches the
+    family's own baked-static solo run — h bitwise, u at the
+    established B>1 member budget — and strict queue FIFO replaces the
+    group-local restriction."""
+    srv = EnsembleServer(_cfg(serve={"buckets": "4"}))
+    reqs = [("m0", "tc2", 3), ("m1", "tc5", 4), ("m2", "tc6", 2),
+            ("m3", "tc5", 3)]
+    for rid, ic, ns in reqs:
+        srv.submit(ScenarioRequest(id=rid, ic=ic, nsteps=ns, seed=-1,
+                                   outputs=("h", "u")))
+    srv.serve()
+    srv.close()
+    assert srv.stats["batches"] == 1          # one mixed batch
+    from jaxstream.models.shallow_water_cov import CovariantShallowWater
+    from jaxstream.physics import initial_conditions as ics
+
+    phys = srv.config.physics
+    for rid, ic, ns in reqs:
+        res = srv.results[rid]
+        assert res.status == "ok", rid
+        b = None
+        if ic == "tc5":
+            h, v, b = ics.williamson_tc5(srv.grid, phys.gravity,
+                                         phys.omega)
+        elif ic == "tc2":
+            h, v = ics.williamson_tc2(srv.grid, phys.gravity, phys.omega)
+        else:
+            h, v = ics.williamson_tc6(srv.grid, phys.gravity, phys.omega)
+        model = CovariantShallowWater(
+            srv.grid, gravity=phys.gravity, omega=phys.omega, b_ext=b)
+        y = model.initial_state(h, v)
+        step = jax.jit(model.make_step(DT, "ssprk3"))
+        t = 0.0
+        for _ in range(ns):
+            y = step(y, t)
+            t += DT
+        np.testing.assert_array_equal(np.asarray(res.fields["h"]),
+                                      np.asarray(y["h"]), err_msg=rid)
+        got = np.asarray(res.fields["u"], np.float64)
+        want = np.asarray(y["u"], np.float64)
+        rel = np.abs(got - want).max() / np.abs(want).max()
+        assert rel <= 1e-6, (rid, rel)
+
+
+def test_group_by_orography_parity_mode():
+    """serve.group_by_orography: true restores the round-11 grouping:
+    tc5 and tc2 never share a batch (two batches for a 2-slot bucket
+    fed one of each), and the tc2 result is bitwise the mixed-mode
+    server's (traced zeros orography == baked static, the round-12
+    equivalence claim)."""
+    def run(grouped):
+        srv = EnsembleServer(_cfg(serve={"group_by_orography": grouped}))
+        srv.submit(ScenarioRequest(id="a", ic="tc2", nsteps=3, seed=0,
+                                   outputs=("h", "u")))
+        srv.submit(ScenarioRequest(id="b", ic="tc5", nsteps=3, seed=1,
+                                   outputs=("h", "u")))
+        srv.serve()
+        srv.close()
+        return srv
+
+    grouped = run(True)
+    mixed = run(False)
+    assert grouped.stats["batches"] == 2      # group-local packing
+    assert mixed.stats["batches"] == 1        # one mixed batch
+    for rid in ("a", "b"):
+        assert grouped.results[rid].status == "ok"
+        assert mixed.results[rid].status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(grouped.results[rid].fields["h"]),
+            np.asarray(mixed.results[rid].fields["h"]))
 
 
 def test_serve_cli_summary(tmp_path):

@@ -1,0 +1,348 @@
+"""Multi-chip serving (serve.placement, round 12).
+
+Acceptance criteria of the placement tier, all tier-1 on the
+conftest's 8 in-process virtual CPU devices (check_tiers rules 6 and 7
+keep this module fast and in-process):
+
+  * the placement planner maps buckets onto device pools correctly
+    (counts 1 / 6 / 8 / 12, both modes, pure arithmetic);
+  * member-parallel packed results match the single-device packed run
+    — h BYTE-identical, u at the repo's established <= 1e-6
+    member-batching budget (shape-dependent XLA FMA contraction,
+    DESIGN.md "Batched ensemble execution") — and placement off is the
+    round-11 code path;
+  * slot refill under sharding is deterministic (two identical
+    member-placement servers produce byte-identical results) and
+    sharding-preserving (zero steady-state recompiles through refills);
+  * per-member eviction works on the sharded nonfinite stream, and the
+    guard event names the failing member's chip;
+  * the panel-sharded mode serves through the shard_map
+    batched-exchange ensemble stepper (6-device mesh) at the
+    established cross-tier <= 1e-6 budget, zero steady recompiles.
+
+Configs are tiny (C8, jnp backend) — the real throughput floors
+(>= 0.8x N-chip scaling) are asserted by bench.py's
+``serving_multichip`` section on real accelerators; this module
+certifies the machinery.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from jaxstream.serve import EnsembleServer, ScenarioRequest
+from jaxstream.serve.placement import (plan_bucket, plan_placement,
+                                       plan_exchange_bytes_per_step,
+                                       placement_report)
+
+N, DT = 8, 600.0
+
+
+def _cfg(**over):
+    cfg = {
+        "grid": {"n": N},
+        "time": {"dt": DT},
+        "model": {"name": "shallow_water_cov", "backend": "jnp"},
+        "parallelization": {"num_devices": 1},
+        "serve": {"buckets": "4", "segment_steps": 2,
+                  "queue_capacity": 16},
+    }
+    for k, v in over.items():
+        if k == "placement":
+            cfg["serve"]["placement"] = v
+        else:
+            cfg.setdefault(k, {}).update(v)
+    return cfg
+
+
+def _needs(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count)")
+
+
+# ------------------------------------------------------------- planner
+def test_placement_plans_across_device_counts():
+    """The planner's device-count policies at pools 1/6/8/12."""
+    # 1 device: everything degrades to the single-chip executable.
+    for mode in ("off", "member"):
+        pl = plan_bucket(16, 1, mode)
+        assert pl.mode == "single" and pl.num_devices == 1
+
+    # 6 devices, member mode: largest bucket divisor <= 6.
+    plans = plan_placement((1, 4, 16), 6, "member")
+    assert plans[1].mode == "single"
+    assert plans[4].member_shards == 4 and plans[4].num_devices == 4
+    assert plans[16].member_shards == 4    # 16 % 6 != 0 -> 4 shards
+    # 6 devices, panel mode: every bucket spreads its faces.
+    plans = plan_placement((1, 4, 16), 6, "panel")
+    for b, pl in plans.items():
+        assert pl.mode == "panel" and pl.panel_shards == 6
+        assert pl.num_devices == 6 and pl.members_per_shard == b
+
+    # 8 devices: B=16 runs 2 members/chip (the ISSUE headline case);
+    # panel mode needs a multiple of 6 and says so.
+    plans = plan_placement((1, 4, 16), 8, "member")
+    assert plans[16].member_shards == 8
+    assert plans[16].members_per_shard == 2
+    assert plans[4].member_shards == 4
+    with pytest.raises(ValueError, match="multiple of 6"):
+        plan_placement((1, 4, 16), 8, "panel")
+
+    # 12 devices, panel mode: (panel=6, member=2) where the bucket
+    # divides, 6 devices otherwise (B=1).
+    plans = plan_placement((1, 4, 16), 12, "panel")
+    assert plans[16].member_shards == 2 and plans[16].num_devices == 12
+    assert plans[16].members_per_shard == 8
+    assert plans[1].member_shards == 1 and plans[1].num_devices == 6
+
+    # Exchange accounting: member-parallel is wire-free; panel ships
+    # the face tier's 12 ppermutes/step at the batched payload.
+    assert plan_exchange_bytes_per_step(plans[16], N, 2) == \
+        16 * 12 * 3 * 2 * N * 4
+    assert plan_exchange_bytes_per_step(
+        plan_bucket(16, 8, "member"), N, 2) == 0.0
+
+    rep = placement_report((1, 4, 16), 8, N, 2)
+    assert "skipped" in rep["modes"]["panel"]
+    rows = {r["bucket"]: r for r in rep["modes"]["member"]["buckets"]}
+    assert rows[16]["members_per_shard"] == 2
+    assert rows[16]["exchange_bytes_per_step"] == 0.0
+
+    with pytest.raises(ValueError, match="mode"):
+        plan_bucket(4, 4, "tile")
+
+
+# --------------------------------------------- member-parallel serving
+LENGTHS = (3, 5, 2, 4, 7, 1)     # ragged: none a segment multiple
+
+
+def _serve_trace(placement=None, **over):
+    serve_over = {}
+    if placement is not None:
+        serve_over["placement"] = placement
+    cfg = _cfg(serve=serve_over) if not over else _cfg(
+        serve=serve_over, **over)
+    srv = EnsembleServer(cfg)
+    for i, ns in enumerate(LENGTHS):
+        srv.submit(ScenarioRequest(id=f"r{i}", ic="tc2", nsteps=ns,
+                                   seed=i, amplitude=1e-3,
+                                   outputs=("h", "u")))
+    srv.serve()
+    srv.close()
+    return srv
+
+
+@pytest.fixture(scope="module")
+def member_parallel_pair():
+    _needs(4)
+    single = _serve_trace()
+    sharded = _serve_trace(placement={"mode": "member",
+                                      "num_devices": 4})
+    return single, sharded
+
+
+def test_member_parallel_matches_single_device(member_parallel_pair):
+    """The B=4 bucket sharded over 4 chips (1 member/chip) serves the
+    same ragged trace as the single-device packed server: h is
+    byte-identical, u carries the established member-batching budget,
+    and refills happened under sharding."""
+    single, sharded = member_parallel_pair
+    plan = sharded._plans[4]
+    assert plan.mode == "member" and plan.num_devices == 4
+    assert set(sharded.results) == set(single.results)
+    for rid, rs in single.results.items():
+        rm = sharded.results[rid]
+        assert rs.status == rm.status == "ok"
+        assert rs.steps_run == rm.steps_run
+        np.testing.assert_array_equal(
+            np.asarray(rm.fields["h"]), np.asarray(rs.fields["h"]),
+            err_msg=rid)
+        a = np.asarray(rm.fields["u"], np.float64)
+        b = np.asarray(rs.fields["u"], np.float64)
+        rel = np.abs(a - b).max() / np.abs(b).max()
+        assert rel <= 1e-6, (rid, rel)
+    # The trace is bigger than the bucket, so slots were refilled
+    # under sharding; behavioral counters agree across placements.
+    assert sharded.stats["refills"] >= 2
+    assert sharded.stats["refills"] == single.stats["refills"]
+    assert sharded.stats["member_steps"] == single.stats["member_steps"]
+    assert sharded.stats["segments"] == single.stats["segments"]
+
+
+def test_member_parallel_zero_steady_recompiles(member_parallel_pair):
+    """Sharding-preserving refill: injections (device_put member IC +
+    traced-index dynamic_update_slice under out_shardings) never
+    change the executable population after warmup."""
+    _, sharded = member_parallel_pair
+    warm = sharded.stats["warmup_compiles"]
+    assert warm > 0
+    assert sharded.compile_count() == warm
+
+
+def test_refill_under_sharding_is_deterministic():
+    """Two identical member-placement servers produce byte-identical
+    packed results (the round-11 determinism claim, now under
+    sharding)."""
+    _needs(4)
+    a = _serve_trace(placement={"mode": "member", "num_devices": 4})
+    b = _serve_trace(placement={"mode": "member", "num_devices": 4})
+    for rid, ra in a.results.items():
+        rb = b.results[rid]
+        assert ra.status == rb.status == "ok"
+        for k in ("h", "u"):
+            np.testing.assert_array_equal(np.asarray(ra.fields[k]),
+                                          np.asarray(rb.fields[k]),
+                                          err_msg=(rid, k))
+
+
+def test_sharded_eviction_names_member_and_chip(tmp_path):
+    """The per-member nonfinite stream is a GSPMD reduction over the
+    sharded carry; eviction under placement evicts only the failing
+    member, and its guard event carries the owning chip (member-shard
+    index) — the per-chip attribution satellite."""
+    _needs(4)
+    sink = str(tmp_path / "mc.jsonl")
+    cfg = _cfg(serve={"placement": {"mode": "member", "num_devices": 4},
+                      "fault_member": 2, "max_guard_events": 1,
+                      "sink": sink},
+               observability={"fault_step": 2})
+    srv = EnsembleServer(cfg)
+    for i, ns in enumerate((6, 6, 6, 4)):
+        srv.submit(ScenarioRequest(id=f"r{i}", ic="tc2", nsteps=ns,
+                                   seed=i))
+    srv.serve()
+    srv.close()
+    ev = srv.results["r2"].guard_event
+    assert srv.results["r2"].status == "evicted"
+    assert ev["member"] == 2
+    # 4 slots over 4 shards: slot 2 lives on chip 2.
+    assert ev["chip"] == 2
+    for rid in ("r0", "r1", "r3"):
+        assert srv.results[rid].status == "ok"
+        assert np.all(np.isfinite(np.asarray(
+            srv.results[rid].fields["h"])))
+    assert srv.stats["evicted"] == 1 and srv.stats["completed"] == 3
+
+    # The sink's serve records carry the per-chip columns and the
+    # guard record carries the chip; telemetry_report aggregates both.
+    from jaxstream.obs.sink import read_records
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import telemetry_report
+
+    recs = read_records(sink)              # schema-validates every line
+    serves = [r for r in recs if r["kind"] == "serve"]
+    assert serves and all(r["placement"] == "member" for r in serves)
+    assert all(len(r["chip_occupancy"]) == 4 for r in serves)
+    assert all("host_wait_s" in r for r in serves)
+    guards = [r for r in recs if r["kind"] == "guard"]
+    assert guards and guards[0]["chip"] == 2
+    s = telemetry_report.summarize(recs)
+    sv = s["serving"]
+    assert sv["devices"] == 4
+    assert sv["placement_modes"] == ["member"]
+    assert len(sv["chip_occupancy_mean"]) == 4
+    assert all(0.0 <= v <= 1.0 for v in sv["chip_occupancy_mean"])
+    assert sv["host_wait_total_s"] >= 0.0
+
+
+# ------------------------------------------------- panel-sharded serving
+def test_panel_sharded_serving_matches_single_device():
+    """A 6-device ('panel', 'member') mesh serves through the
+    shard_map batched-exchange ensemble stepper: results match the
+    single-device packed server at the established cross-tier <= 1e-6
+    budget (different RHS implementation — per-face Pallas kernel +
+    strip exchange vs the classic jnp oracle), with zero steady-state
+    recompiles.  Panel placement requires the grouped (baked-
+    orography) mode."""
+    _needs(6)
+    base = {"serve": {"group_by_orography": True, "buckets": "2",
+                      "segment_steps": 2, "queue_capacity": 8}}
+
+    def run(placement):
+        cfg = _cfg()
+        cfg["serve"].update(base["serve"])
+        if placement:
+            cfg["serve"]["placement"] = placement
+        srv = EnsembleServer(cfg)
+        for i, ns in enumerate((3, 2, 4)):
+            srv.submit(ScenarioRequest(id=f"p{i}", ic="tc2", nsteps=ns,
+                                       seed=i, outputs=("h", "u")))
+        srv.serve()
+        srv.close()
+        return srv
+
+    ref = run(None)
+    panel = run({"mode": "panel", "num_devices": 6})
+    plan = panel._plans[2]
+    assert plan.mode == "panel" and plan.num_devices == 6
+    warm = panel.stats["warmup_compiles"]
+    assert warm > 0 and panel.compile_count() == warm
+    assert panel.stats["refills"] >= 1
+    for rid, rr in ref.results.items():
+        rp = panel.results[rid]
+        assert rr.status == rp.status == "ok"
+        for k in ("h", "u"):
+            a = np.asarray(rp.fields[k], np.float64)
+            b = np.asarray(rr.fields[k], np.float64)
+            rel = np.abs(a - b).max() / (np.abs(b).max() + 1e-300)
+            assert rel <= 1e-6, (rid, k, rel)
+
+
+# ------------------------------------------------------------ validation
+def test_placement_config_validation():
+    with pytest.raises(ValueError, match="placement.mode"):
+        EnsembleServer(_cfg(placement={"mode": "tiles"}))
+    # member placement partitions the classic stepper — the fused
+    # member-fold is one custom call GSPMD cannot split.
+    with pytest.raises(ValueError, match="backend"):
+        EnsembleServer(_cfg(placement={"mode": "member",
+                                       "num_devices": 2},
+                            model={"backend": "pallas",
+                                   "name": "shallow_water_cov"}))
+    # panel placement bakes orography per device: grouped mode only.
+    with pytest.raises(ValueError, match="group_by_orography"):
+        EnsembleServer(_cfg(placement={"mode": "panel",
+                                       "num_devices": 6}))
+    # More devices than exist: the XLA_FLAGS hint, not a crash later.
+    with pytest.raises(ValueError, match="devices exist"):
+        EnsembleServer(_cfg(placement={"mode": "member",
+                                       "num_devices": 4096}))
+
+
+def test_simulation_member_layout_mesh():
+    """ensemble.layout: member — the 1-D member-only mesh behind the
+    same helper the serving tier uses: any device count dividing the
+    ensemble works (no multiple-of-6 constraint), and the spec shards
+    only the member axis."""
+    _needs(4)
+    from jaxstream.parallel.mesh import setup_ensemble_sharding
+
+    setup = setup_ensemble_sharding(
+        {"parallelization": {"num_devices": 4, "device_type": "cpu"}},
+        members=8, layout="member")
+    assert setup.mesh.axis_names == ("member",)
+    assert setup.member == 4 and setup.panel == 1
+    assert setup.ensemble_spec_for(4) == jax.sharding.PartitionSpec(
+        "member", None, None, None)
+    assert setup.ensemble_spec_for(5) == jax.sharding.PartitionSpec(
+        None, "member", None, None, None)
+    with pytest.raises(ValueError, match="divide"):
+        setup_ensemble_sharding(
+            {"parallelization": {"num_devices": 4}}, members=6,
+            layout="member")
+    with pytest.raises(ValueError, match="use_shard_map"):
+        setup_ensemble_sharding(
+            {"parallelization": {"num_devices": 4,
+                                 "use_shard_map": True}},
+            members=8, layout="member")
+    with pytest.raises(ValueError, match="layout"):
+        setup_ensemble_sharding(
+            {"parallelization": {"num_devices": 4}}, members=8,
+            layout="tiles")
