@@ -1,0 +1,55 @@
+"""Trace-time contract checker (round 13).
+
+Static verification of the two invariant families the paper's headline
+claims rest on, at build/trace time instead of one runtime parity test
+per pair:
+
+* :mod:`.schedule` — the **exchange-schedule verifier**: reconstructs
+  the cubed-sphere seam graph from :mod:`jaxstream.geometry.
+  connectivity` and proves every exchange factory's ``ppermute``
+  schedule is a total permutation (JAX silently drops missing
+  ``(src, dst)`` pairs — a schedule bug leaves stale ghosts, not a
+  crash), that the stage union covers all 12 cube edges exactly once
+  with the 8 corners' seam triples landing in 3 distinct stages, and
+  that strip depths match the declared halo width (including the
+  deep-halo ``3*k*halo`` arithmetic of temporal blocking).
+* :mod:`.jaxpr_audit` — the **jaxpr auditor**: walks the closed jaxprs
+  of built steppers to check the *traced* schedules against the seam
+  graph, prove the overlap phase split issues every send before the
+  interior kernel consumes a ghost (dependence analysis), assert
+  precision-policy conformance (no f64 / stray bf16 leaks), donation
+  that actually aliases, no host callbacks inside the segment loop, and
+  collective counts that match ``comm_probe``'s analytic plans exactly.
+
+:mod:`.contracts` drives both passes over the current composition
+matrix (overlap x temporal_block x ensemble x precision x serve
+placement); :mod:`.fixtures` holds the deliberately broken schedules
+that prove the passes fail loudly.  ``scripts/analyze.py`` is the
+CLI/CI front end; ``bench.py`` embeds the result as every run's
+``contract_check`` stamp.
+"""
+
+from .report import ContractReport, Violation
+from .schedule import (
+    face_seam_graph,
+    verify_block_program,
+    verify_cov_program,
+    verify_deep_program,
+    verify_shard_halo_program,
+    verify_stage_perms,
+)
+from .contracts import check_schedules, check_steppers, run_all
+
+__all__ = [
+    "ContractReport",
+    "Violation",
+    "face_seam_graph",
+    "verify_stage_perms",
+    "verify_cov_program",
+    "verify_block_program",
+    "verify_deep_program",
+    "verify_shard_halo_program",
+    "check_schedules",
+    "check_steppers",
+    "run_all",
+]

@@ -1,0 +1,541 @@
+"""Contract-check driver: run both passes over the composition matrix.
+
+:func:`check_schedules` verifies every exchange *program* the
+factories build (pure — no devices); :func:`check_steppers` traces the
+*steppers* of the current composition matrix (overlap x temporal_block
+x ensemble x precision x serve placement) on the virtual-CPU device
+pool and audits the jaxprs; :func:`run_all` is both, returning
+``(ContractReport, facts)`` where ``facts`` is the per-variant JSON
+the CLI emits and tests assert on (collective counts, analytic-plan
+cross-checks, schedule fingerprints).
+
+Everything runs on CPU devices (``jax.devices('cpu')``) so the checker
+works identically under pytest's conftest, the bench smoke, and the
+standalone CLI; >= 6 CPU devices are required for the sharded tiers
+(``scripts/analyze.py`` sets the virtual-device flag itself when run
+as ``__main__``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..geometry.connectivity import schedule_fingerprint
+from .jaxpr_audit import (
+    audit_callbacks,
+    audit_donation,
+    audit_dtypes,
+    audit_overlap_windows,
+    audit_rounds,
+    collect_ppermutes,
+    count_primitive,
+    trace,
+    verify_round_structure,
+)
+from .report import ContractReport
+from .schedule import (
+    verify_block_program,
+    verify_cov_program,
+    verify_deep_program,
+    verify_shard_halo_program,
+)
+
+__all__ = ["check_schedules", "check_steppers", "run_all",
+           "required_devices"]
+
+_DT = 300.0
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "bfloat16": 2,
+                "float16": 2}
+
+#: CPU devices the stepper matrix needs (panel meshes).
+required_devices = 6
+
+
+def _plan_fp() -> str:
+    return schedule_fingerprint()
+
+
+def _note_no_window_check(report, facts, name):
+    """Record — loudly, not silently — that the overlap-window audit
+    cannot run on this overlap variant: its RHS is plain jnp (no
+    ``pallas_call`` to identify as the compute window), so the overlap
+    claim here rests on the issue-before-consume round proof (every
+    send at a level preceding any consumer) plus the runtime parities,
+    not on a per-round window witness.
+    """
+    report.ok(
+        "jaxpr.overlap_windows_not_applicable", name,
+        "no pallas kernel in this tier's trace to witness the window; "
+        "issue-before-consume is proven by the round levels")
+    facts["variants"][name]["overlap_window_check"] = "not_applicable"
+
+
+def _unique_perms(perms):
+    seen, out = set(), []
+    for p in perms:
+        key = tuple(sorted(p))
+        if key not in seen:
+            seen.add(key)
+            out.append(list(p))
+    return out
+
+
+# ---------------------------------------------------------------------
+# Pass 1: exchange-schedule programs (pure, no devices)
+# ---------------------------------------------------------------------
+
+def check_schedules(report: ContractReport = None, n: int = 12,
+                    halo: int = 2, temporal_blocks=(2,),
+                    block_tiles=(2,)) -> ContractReport:
+    """Verify every exchange factory's schedule program.
+
+    Covers the face-tier :class:`...parallel.shard_cov.CovShardProgram`
+    (used by ``make_cov_shard_exchange``/``_phases``/``_batched`` — one
+    program, three consumption schedules), its deep-halo form (the
+    ``3*k*halo`` arithmetic of ``make_sharded_cov_deep_stepper``), the
+    block-mesh :class:`...parallel.shard_cov_block.CovBlockProgram`
+    (``make_cov_block_exchange*``), and the scalar/TT
+    :class:`...parallel.shard_halo.ShardHaloProgram`
+    (``make_tt_strip_exchange``/``_many`` build their stage perms from
+    it).  The block program is verified here precisely because its
+    24-device mesh cannot be traced in-process — the schedule itself
+    needs no devices at all.
+    """
+    import jax.numpy as jnp
+
+    from ..geometry.cubed_sphere import build_grid
+    from ..parallel.shard_cov import CovShardProgram
+    from ..parallel.shard_cov_block import CovBlockProgram
+    from ..parallel.shard_halo import ShardHaloProgram
+
+    report = report or ContractReport()
+    grid = build_grid(n, halo=halo, radius=6.371e6, dtype=jnp.float32)
+
+    prog = CovShardProgram(grid)
+    verify_cov_program(prog, report, n, halo)
+    report.check(
+        schedule_fingerprint(prog.perms) == _plan_fp(),
+        "schedule.fingerprint", "CovShardProgram",
+        "program stage perms do not match the canonical schedule "
+        "fingerprint comm_probe's plans carry")
+
+    verify_shard_halo_program(ShardHaloProgram(), report)
+
+    for k in temporal_blocks:
+        D = 3 * k * halo
+        gdeep = build_grid(n, halo=D, radius=6.371e6,
+                           dtype=jnp.float32)
+        verify_deep_program(CovShardProgram(gdeep), report, n, halo, k)
+
+    for s in block_tiles:
+        if n % s or n // s < halo:
+            report.fail(
+                "schedule.block_config", f"CovBlockProgram s={s}",
+                f"n={n} not tileable by s={s} at halo {halo}")
+            continue
+        verify_block_program(CovBlockProgram(grid, s), report,
+                             subject=f"CovBlockProgram s={s}")
+    return report
+
+
+# ---------------------------------------------------------------------
+# Pass 2: stepper jaxprs (tracing on the CPU device pool)
+# ---------------------------------------------------------------------
+
+def _audit_exchange_variant(report, facts, name, jaxpr, *,
+                            steps_per_call: int = 1,
+                            stages_per_round: int = None,
+                            expect_overlap=None,
+                            plan_ppermutes_per_step=None,
+                            plan_payload_bytes_per_step=None,
+                            expect_payload_shape=None,
+                            check_fingerprint: bool = True,
+                            expect_bf16: bool = False,
+                            allow_f64: bool = False):
+    """All jaxpr audits for one stepper variant, recorded + fact'd."""
+    try:
+        rounds = audit_rounds(jaxpr)
+    except ValueError as e:
+        report.fail("jaxpr.rounds", name, str(e))
+        rounds = []
+    verify_round_structure(rounds, report, name, stages_per_round)
+    if expect_overlap is not None:
+        audit_overlap_windows(jaxpr, report, name,
+                              expect_overlap=expect_overlap)
+    audit_dtypes(jaxpr, report, name, expect_bf16=expect_bf16,
+                 allow_f64=allow_f64)
+    audit_callbacks(jaxpr, report, name)
+
+    pps = collect_ppermutes(jaxpr)
+    per_step = len(pps) / steps_per_call
+    entry = {
+        "ppermutes_per_call": len(pps),
+        "steps_per_call": steps_per_call,
+        "ppermutes_per_step": per_step,
+        "rounds": [r.size for r in rounds],
+        # Lists, not tuples: the facts dict is consumed both in-process
+        # and JSON-round-tripped; keep the two forms identical.
+        "payload_shapes": [list(t) for t in
+                           sorted({tuple(s) for _, s, _ in pps})],
+    }
+    if plan_ppermutes_per_step is not None:
+        entry["plan_ppermutes_per_step"] = plan_ppermutes_per_step
+        report.check(
+            per_step == plan_ppermutes_per_step,
+            "jaxpr.collective_count_vs_plan", name,
+            f"traced {per_step} ppermutes/step but comm_probe's "
+            f"analytic plan says {plan_ppermutes_per_step}")
+    payload_bytes = sum(
+        int(np.prod(s)) * _DTYPE_BYTES.get(d, 4) for _, s, d in pps)
+    entry["payload_bytes_per_step"] = payload_bytes / steps_per_call
+    if plan_payload_bytes_per_step is not None:
+        entry["plan_payload_bytes_per_step"] = \
+            plan_payload_bytes_per_step
+        report.check(
+            payload_bytes / steps_per_call
+            == plan_payload_bytes_per_step,
+            "jaxpr.payload_bytes_vs_plan", name,
+            f"traced {payload_bytes / steps_per_call} payload "
+            f"bytes/step but the analytic plan bills "
+            f"{plan_payload_bytes_per_step}")
+    if expect_payload_shape is not None:
+        shapes = {tuple(s) for _, s, _ in pps}
+        report.check(
+            shapes == {tuple(expect_payload_shape)},
+            "jaxpr.strip_depth", name,
+            f"ppermute payloads {sorted(shapes)} != declared strip "
+            f"shape {tuple(expect_payload_shape)}")
+    if check_fingerprint and rounds:
+        # Fingerprint EVERY round's perms (deduplicated): a miswired
+        # stage in any later exchange round — same pair count, same
+        # payload — adds a non-canonical stage to the set and changes
+        # the digest; hashing only round 0 would miss it.
+        fp = schedule_fingerprint(_unique_perms(
+            [p for r in rounds for p in r.perms]))
+        entry["schedule_fingerprint"] = fp
+        report.check(
+            fp == _plan_fp(), "jaxpr.schedule_fingerprint", name,
+            f"traced schedule fingerprint {fp} != the canonical "
+            f"{_plan_fp()} comm_probe's plans carry — the compiled "
+            f"schedule diverged from the analytic one")
+    facts["variants"][name] = entry
+    return entry
+
+
+def check_steppers(report: ContractReport = None, n: int = 12,
+                   halo: int = 2, include_compile: bool = True):
+    """Trace + audit the composition matrix's steppers.
+
+    Returns ``(report, facts)``.  Needs >= 6 CPU devices (the conftest
+    / ``scripts/analyze.py`` virtual-device pool).
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from .. import stepping
+    from ..config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
+    from ..geometry.cubed_sphere import build_grid
+    from ..models.shallow_water_cov import (ENSEMBLE_STATE_AXES,
+                                            CovariantShallowWater)
+    from ..ops.pallas.precision import encode_strips
+    from ..parallel.mesh import setup_ensemble_sharding, setup_sharding
+    from ..parallel.sharded_model import make_stepper_for
+    from ..physics.initial_conditions import williamson_tc2
+    from ..serve.placement import (plan_bucket,
+                                   plan_exchange_bytes_per_step)
+    from ..utils.comm_probe import (batched_exchange_plan,
+                                    temporal_block_plan)
+
+    report = report or ContractReport()
+    ncpu = len(jax.devices("cpu"))
+    if ncpu < required_devices:
+        raise RuntimeError(
+            f"the stepper contract matrix needs >= {required_devices} "
+            f"CPU devices, found {ncpu}; start Python with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 (scripts/"
+            f"analyze.py run as __main__ sets it itself)")
+
+    facts = {"n": n, "halo": halo, "cpu_devices": ncpu,
+             "schedule_fingerprint": _plan_fp(), "variants": {}}
+
+    grid = build_grid(n, halo=halo, radius=EARTH_RADIUS,
+                      dtype=jnp.float32)
+    h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+    model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                  omega=EARTH_OMEGA)
+    # Pin the audited state to f32 regardless of the host's x64 mode
+    # (the test conftest enables it): the precision contract under
+    # audit is the steppers', not the IC builders'.
+    state = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float32),
+        model.initial_state(h_ext, v_ext))
+    t0 = jnp.float32(0.0)
+    par = {"num_devices": 6, "device_type": "cpu",
+           "use_shard_map": True}
+    setup = setup_sharding({"parallelization": par})
+    setup_ov = _dc.replace(setup, overlap_exchange=True)
+
+    plan1 = batched_exchange_plan(n, halo, 1)
+    plan2 = batched_exchange_plan(n, halo, 2)
+    tbplan = temporal_block_plan(n, halo, 2)
+
+    # -- face tier: serialized / overlap -----------------------------
+    for name, su, expect_ov in (("face_serialized", setup, False),
+                                ("face_overlap", setup_ov, True)):
+        step = make_stepper_for(model, su, state, _DT)
+        jx = trace(lambda s, _step=step: _step(s, t0), state)
+        _audit_exchange_variant(
+            report, facts, name, jx, stages_per_round=4,
+            expect_overlap=expect_ov,
+            plan_ppermutes_per_step=plan1["ppermutes_per_step"],
+            plan_payload_bytes_per_step=plan1[
+                "wire_bytes_per_member_step"],
+            expect_payload_shape=(3, halo, n))
+
+    # -- face tier: deep-halo temporal blocking (k=2) ----------------
+    D = tbplan["deep_halo_width"]
+    for name, su in (("face_deep_k2", setup),
+                     ("face_deep_k2_overlap", setup_ov)):
+        step = make_stepper_for(model, su, state, _DT,
+                                temporal_block=2)
+        k = step.steps_per_call
+        jx = trace(lambda s, _step=step: _step(s, t0), state)
+        _audit_exchange_variant(
+            report, facts, name, jx, steps_per_call=k,
+            stages_per_round=4,
+            plan_ppermutes_per_step=tbplan["ppermutes_per_step"],
+            plan_payload_bytes_per_step=tbplan[
+                "payload_bytes_per_step"],
+            expect_payload_shape=(3, D, n))
+        if su is setup_ov:
+            _note_no_window_check(report, facts, name)
+
+    # -- ensemble (batched exchange), x overlap, x temporal fusion ---
+    B = 2
+    sb = {"h": jnp.stack([state["h"]] * B),
+          "u": jnp.stack([state["u"]] * B, axis=1)}
+    for name, su, kw, expect_ov in (
+            ("ensemble_B2", setup, {}, False),
+            ("ensemble_B2_overlap", setup_ov, {}, True),
+            ("ensemble_B2_tb2", setup, {"temporal_block": 2}, False)):
+        step = make_stepper_for(model, su, state, _DT, ensemble=B,
+                                **kw)
+        k = getattr(step, "steps_per_call", 1)
+        jx = trace(lambda s, _step=step: _step(s, t0), sb)
+        _audit_exchange_variant(
+            report, facts, name, jx, steps_per_call=k,
+            stages_per_round=4, expect_overlap=expect_ov,
+            plan_ppermutes_per_step=plan2["ppermutes_per_step"],
+            plan_payload_bytes_per_step=plan2[
+                "payload_bytes_per_ppermute"]
+            * plan2["ppermutes_per_step"],
+            expect_payload_shape=(B, 3, halo, n))
+
+    # -- TT factored tier --------------------------------------------
+    from ..tt.shard import make_tt_sphere_swe_sharded, panel_mesh
+    from ..tt.sphere import factor_panels
+    from ..ops.fv import covariant_components
+
+    ua, ub = covariant_components(grid, v_ext)
+    rank = 4
+    pfac = tuple(
+        factor_panels(np.asarray(grid.interior(x), np.float32), rank)
+        for x in (h_ext, ua, ub))
+    tmesh = panel_mesh(jax.devices("cpu")[:6])
+    for name, ov in (("tt_serialized", False), ("tt_overlap", True)):
+        tstep = make_tt_sphere_swe_sharded(grid, _DT, rank, tmesh,
+                                           overlap_exchange=ov)
+        jx = trace(tstep, pfac)
+        # allow_f64: the TT tier deliberately follows the ambient x64
+        # mode (the f64-on-CPU oracle convention); the f32 contract is
+        # the dense/fused tiers'.
+        entry = _audit_exchange_variant(
+            report, facts, name, jx, stages_per_round=None,
+            check_fingerprint=True, allow_f64=True)
+        depths = {s[-2] for s in entry["payload_shapes"]}
+        report.check(
+            depths == {1}, "jaxpr.strip_depth", name,
+            f"TT strips are depth-1 reconstructed lines; traced "
+            f"depths {sorted(depths)}")
+        if ov:
+            _note_no_window_check(report, facts, name)
+
+    # -- GSPMD path (collectives compiler-inferred) ------------------
+    setup_g = setup_sharding({"parallelization": {
+        "num_devices": 6, "device_type": "cpu",
+        "use_shard_map": False}})
+    gstep = make_stepper_for(model, setup_g, state, _DT)
+    jxg = trace(lambda s: gstep(s, t0), state)
+    report.check(
+        count_primitive(jxg, "ppermute") == 0,
+        "jaxpr.gspmd_no_explicit_collectives", "gspmd_6dev",
+        "the GSPMD path traced explicit ppermutes — its collectives "
+        "must be XLA-inferred from shardings")
+    audit_dtypes(jxg, report, "gspmd_6dev")
+    audit_callbacks(jxg, report, "gspmd_6dev")
+    facts["variants"]["gspmd_6dev"] = {
+        "ppermutes_per_call": 0,
+        "note": "collectives inferred by GSPMD at compile time"}
+
+    # -- fused single-device precision ladder ------------------------
+    fmodel = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                   omega=EARTH_OMEGA,
+                                   backend="pallas_interpret")
+    for name, pol, kw in (("fused_f32", None, {}),
+                          ("fused_bf16", "bf16", {}),
+                          ("fused_bf16_tb2", "bf16",
+                           {"temporal_block": 2})):
+        fstep = fmodel.make_fused_step(_DT, precision=pol, **kw)
+        y0 = encode_strips(fmodel.compact_state(state), pol)
+        jxf = trace(lambda y, _s=fstep: _s(y, t0), y0)
+        census = audit_dtypes(jxf, report, name,
+                              expect_bf16=pol is not None)
+        audit_callbacks(jxf, report, name)
+        # Prognostic carry leaves stay f32 under any policy: the bf16
+        # quantization may ride stage operands and strips, never the
+        # accumulated state.
+        out = jax.eval_shape(lambda y, _s=fstep: _s(y, t0), y0)
+        bad = [k for k in ("h", "u")
+               if str(out[k].dtype) != "float32"]
+        report.check(
+            not bad, "jaxpr.carry_dtype_stable", name,
+            f"prognostic carry leaves {bad} are not float32 under "
+            f"policy {pol!r} — quantization leaked into the "
+            f"accumulated state")
+        facts["variants"][name] = {
+            "bf16_ops": census.get("bfloat16", 0),
+            "f32_ops": census.get("float32", 0)}
+
+    # -- segment loop: no host callbacks, schedule rides the body ----
+    # unroll=1 so the while body traces the stepper exactly once (the
+    # default unroll=4 is numerically identical but traces the body
+    # unroll+1 times, which would multiply the static count).
+    face_step = make_stepper_for(model, setup, state, _DT)
+    jxl = trace(
+        lambda y, t: stepping.integrate(face_step, y, t, 8, _DT,
+                                        unroll=1),
+        state, 0.0)
+    audit_callbacks(jxl, report, "segment_loop_face")
+    report.check(
+        count_primitive(jxl, "ppermute") == plan1[
+            "ppermutes_per_step"],
+        "jaxpr.collective_count_vs_plan", "segment_loop_face",
+        f"the fori_loop body must trace the stepper's "
+        f"{plan1['ppermutes_per_step']} ppermutes exactly once; got "
+        f"{count_primitive(jxl, 'ppermute')}")
+    facts["variants"]["segment_loop_face"] = {
+        "ppermutes_in_loop_body": count_primitive(jxl, "ppermute")}
+
+    # -- serve placement: panel-sharded masked segment ---------------
+    seg = 2
+    esetup = setup_ensemble_sharding(
+        {"parallelization": {"num_devices": 6,
+                             "device_type": "cpu"}},
+        members=B, layout="panel_member")
+    from ..parallel.shard_cov import make_sharded_cov_ensemble_stepper
+
+    pstep = make_sharded_cov_ensemble_stepper(model, esetup, _DT, B,
+                                              wrap_jit=False)
+    rem0 = jnp.asarray([seg, seg], jnp.int32)
+
+    def seg_panel(y, rem):
+        return stepping.integrate_masked(pstep, y, 0.0, rem, seg, _DT,
+                                         ENSEMBLE_STATE_AXES)
+
+    jxp = trace(seg_panel, sb, rem0)
+    pplan = plan_bucket(B, 6, "panel")
+    plan_bytes = plan_exchange_bytes_per_step(pplan, n, halo)
+    loop_pp = collect_ppermutes(jxp)
+    loop_bytes = sum(int(np.prod(s)) * _DTYPE_BYTES.get(d, 4)
+                     for _, s, d in loop_pp)
+    report.check(
+        len(loop_pp) == 12, "jaxpr.collective_count_vs_plan",
+        "serve_panel",
+        f"panel-sharded masked segment must trace the face tier's 12 "
+        f"ppermutes per step; got {len(loop_pp)}")
+    report.check(
+        float(loop_bytes) == plan_bytes,
+        "jaxpr.payload_bytes_vs_plan", "serve_panel",
+        f"traced {loop_bytes} exchange bytes/step; the placement plan "
+        f"bills {plan_bytes}")
+    audit_callbacks(jxp, report, "serve_panel")
+    facts["variants"]["serve_panel"] = {
+        "ppermutes_per_step": len(loop_pp),
+        "payload_bytes_per_step": float(loop_bytes),
+        "plan_payload_bytes_per_step": plan_bytes}
+
+    # -- serve placement: member-parallel (GSPMD, compiled) ----------
+    mdevs = 2
+    msetup = setup_ensemble_sharding(
+        {"parallelization": {"num_devices": mdevs,
+                             "device_type": "cpu"}},
+        members=B, layout="member")
+    mplan = plan_bucket(B, mdevs, "member")
+    entry = {"plan_exchange_bytes_per_step":
+             plan_exchange_bytes_per_step(mplan, n, halo)}
+    vstep = stepping.vmap_ensemble(model.make_step(_DT),
+                                   ENSEMBLE_STATE_AXES)
+
+    def seg_member(y, rem):
+        return stepping.integrate_masked(vstep, y, 0.0, rem, seg, _DT,
+                                         ENSEMBLE_STATE_AXES)
+
+    jxm = trace(seg_member, sb, rem0)
+    audit_callbacks(jxm, report, "serve_member")
+    report.check(
+        count_primitive(jxm, "ppermute") == 0,
+        "jaxpr.collective_count_vs_plan", "serve_member",
+        "member-parallel placement traced explicit collectives — "
+        "members must never communicate")
+    if include_compile:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        carry_sh = {k: msetup.ensemble_sharding_for(ax + 4)
+                    for k, ax in ENSEMBLE_STATE_AXES.items()}
+        rep_sh = NamedSharding(msetup.mesh, P())
+        seg_j = jax.jit(seg_member, in_shardings=(carry_sh, rep_sh),
+                        out_shardings=(carry_sh, rep_sh, rep_sh))
+        hlo = seg_j.lower(sb, rem0).compile().as_text()
+        n_cp = hlo.count("collective-permute")
+        n_a2a = hlo.count("all-to-all")
+        entry["compiled_collective_permutes"] = n_cp
+        entry["compiled_all_to_alls"] = n_a2a
+        report.check(
+            n_cp == 0 and n_a2a == 0,
+            "jaxpr.member_parallel_zero_wire", "serve_member",
+            f"member-parallel compiled executable moves member data "
+            f"across chips (collective-permute={n_cp}, "
+            f"all-to-all={n_a2a}) but the placement plan bills zero "
+            f"exchange bytes")
+    facts["variants"]["serve_member"] = entry
+
+    # -- donation: declared AND aliased in the segment executable ----
+    if include_compile:
+        jrun = stepping.jit_integrate(model.make_step(_DT), _DT,
+                                      donate=True)
+        audit_donation(jrun, (state, 0.0, 4), report,
+                       "jit_integrate(donate=True)",
+                       expect_donated=True)
+        # The negative side needs no compile: aliasing can only come
+        # from a donor annotation, checked at the lowering.
+        jrun_off = stepping.jit_integrate(model.make_step(_DT), _DT,
+                                          donate=False)
+        audit_donation(jrun_off, (state, 0.0, 4), report,
+                       "jit_integrate(donate=False)",
+                       expect_donated=False)
+    facts["compile_checks"] = bool(include_compile)
+    return report, facts
+
+
+def run_all(n: int = 12, halo: int = 2,
+            include_compile: bool = True):
+    """Both passes; returns ``(ContractReport, facts_dict)``."""
+    report = ContractReport()
+    check_schedules(report, n=n, halo=halo)
+    report, facts = check_steppers(report, n=n, halo=halo,
+                                   include_compile=include_compile)
+    facts["ok"] = report.passed
+    facts["checks_run"] = report.checks_run
+    return report, facts

@@ -1,0 +1,68 @@
+"""Seeded-broken schedules: the verifier's regression corpus.
+
+A static pass that only ever sees correct schedules proves nothing
+about its own teeth.  These two fixtures reproduce the exact failure
+classes the checker exists for, and ``tests/test_analysis.py`` +
+``scripts/analyze.py --fixture`` assert the pass fails LOUDLY on both:
+
+* ``dropped_pair`` — one directed ``(src, dst)`` deleted from a stage
+  perm.  ``lax.ppermute`` would run this schedule without complaint
+  and zero-fill the unpaired receiver's ghost strip — stale-ghost
+  physics, no crash (the failure mode the issue motivates).
+* ``deep_depth`` — a deep-halo program built one ghost row short of
+  the ``3*k*halo`` temporal-blocking requirement.  The block would
+  integrate, with the deepest ring never refilled — pure truncation
+  drift, again no crash.
+"""
+
+from __future__ import annotations
+
+from ..geometry.connectivity import schedule_perms
+from .report import ContractReport
+from .schedule import verify_deep_program, verify_stage_perms
+
+__all__ = ["FIXTURES", "broken_dropped_pair_perms",
+           "broken_deep_program", "run_fixture"]
+
+FIXTURES = ("dropped_pair", "deep_depth")
+
+
+def broken_dropped_pair_perms(stage: int = 2):
+    """The canonical schedule with one directed pair silently dropped."""
+    perms = [list(p) for p in schedule_perms()]
+    dropped = perms[stage].pop()
+    return perms, dropped
+
+
+def broken_deep_program(n: int = 12, halo: int = 2,
+                        temporal_block: int = 2):
+    """A deep-halo CovShardProgram built at depth ``3*k*halo - 1``."""
+    import jax.numpy as jnp
+
+    from ..geometry.cubed_sphere import build_grid
+    from ..parallel.shard_cov import CovShardProgram
+
+    k = temporal_block
+    gdeep = build_grid(n, halo=3 * k * halo - 1, radius=6.371e6,
+                       dtype=jnp.float32)
+    return CovShardProgram(gdeep)
+
+
+def run_fixture(name: str, n: int = 12, halo: int = 2) -> ContractReport:
+    """Verify one deliberately broken fixture; the report MUST come
+    back with violations (asserted by tests and the CLI's
+    ``--fixture`` mode, which exits nonzero when it does)."""
+    report = ContractReport()
+    if name == "dropped_pair":
+        perms, dropped = broken_dropped_pair_perms()
+        verify_stage_perms(
+            perms, report,
+            f"fixture:dropped_pair (removed {dropped})")
+    elif name == "deep_depth":
+        prog = broken_deep_program(n=n, halo=halo, temporal_block=2)
+        verify_deep_program(prog, report, n, halo, temporal_block=2,
+                            subject="fixture:deep_depth")
+    else:
+        raise ValueError(
+            f"unknown fixture {name!r}; valid: {FIXTURES}")
+    return report

@@ -1,0 +1,394 @@
+"""Jaxpr auditor: static checks on the steppers' closed jaxprs.
+
+Everything here works on ``jax.make_jaxpr`` output — tracing only, no
+compilation (the one exception, :func:`audit_donation`, compiles a
+small executable because aliasing is a compile-time decision).  The
+traced jaxpr is the ground truth the verifier wants: every
+``ppermute`` equation carries its actual ``perm`` pair list and
+payload aval, so the schedule that *runs* is checked, not the schedule
+a factory intended.
+
+Core analyses:
+
+* :func:`collect_ppermutes` / :func:`audit_rounds` — find every
+  collective, group them into *exchange rounds* by ppermute-ancestor
+  count (a dependence closure over the innermost jaxpr that issues
+  them).  Two ppermutes with equal ancestor counts are provably
+  mutually independent (if A preceded B, B's ancestor set would be
+  strictly larger), so a well-formed round structure — equal-size
+  groups at cumulative levels — is a machine proof that every send of
+  a round is issued before anything consumes a received strip.
+* :func:`audit_overlap_windows` — the overlap contract: for each
+  round, some RHS kernel (``pallas_call``) neither depends on that
+  round's ppermutes nor feeds their payloads, i.e. XLA is free to run
+  it while the collectives fly.  Serialized steppers fail this by
+  construction (their kernels consume the round's ghosts), which is
+  how the check distinguishes the two schedules.
+* :func:`audit_dtypes` — precision-policy conformance: no float64
+  field arrays anywhere (rank >= 2; scalars are exempt — the x64 time
+  carry is policy), and bfloat16 present *iff* a reduced-precision
+  policy is active (a bf16 op in an f32-tier stepper is a leak out of
+  ``ops/pallas/precision.py``'s policy regions; zero bf16 under an
+  active policy means the policy silently didn't apply).
+* :func:`audit_callbacks` — no host callbacks anywhere in a segment
+  loop's jaxpr (a ``pure_callback``/``io_callback``/``debug_callback``
+  inside the ``fori_loop`` body would sync the host every step).
+* :func:`audit_donation` — donation that actually aliases: the
+  lowered module must carry the donation annotation and the compiled
+  executable an ``input_output_alias`` entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import List
+
+import jax
+
+try:  # jax >= 0.4.x keeps these on jax.core
+    from jax.core import ClosedJaxpr, Jaxpr, Literal
+except ImportError:  # pragma: no cover - future jax moves
+    from jax.extend.core import ClosedJaxpr, Jaxpr, Literal  # type: ignore
+
+__all__ = [
+    "trace", "iter_eqns", "count_primitive", "collect_ppermutes",
+    "RoundInfo", "audit_rounds", "verify_round_structure",
+    "audit_overlap_windows", "audit_dtypes", "audit_callbacks",
+    "audit_donation",
+]
+
+#: Primitive names that put the host on a traced program's critical
+#: path.  Matched exactly plus any name containing 'callback'.
+HOST_CALLBACK_PRIMS = frozenset(
+    {"outside_call", "infeed", "outfeed", "host_local_array_to_global",
+     "device_to_host"})
+
+#: RHS-kernel primitives (the compute the overlap schedule hides
+#: collectives under).
+KERNEL_PRIMS = frozenset({"pallas_call"})
+
+
+def trace(fn, *args, **kwargs) -> ClosedJaxpr:
+    """``jax.make_jaxpr`` with kwargs threaded."""
+    return jax.make_jaxpr(fn)(*args, **kwargs)
+
+
+def _sub_jaxprs(v):
+    out = []
+    if isinstance(v, ClosedJaxpr):
+        out.append(v.jaxpr)
+    elif isinstance(v, Jaxpr):
+        out.append(v)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            out.extend(_sub_jaxprs(x))
+    return out
+
+
+def iter_eqns(jaxpr):
+    """Every equation, recursing into call/loop/branch sub-jaxprs."""
+    if isinstance(jaxpr, ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def count_primitive(jaxpr, name: str) -> int:
+    return sum(1 for e in iter_eqns(jaxpr) if e.primitive.name == name)
+
+
+def _ppermute_bodies(jaxpr, acc=None):
+    """Innermost jaxprs that directly issue ``ppermute`` equations."""
+    if isinstance(jaxpr, ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    if acc is None:
+        acc = []
+    if any(e.primitive.name == "ppermute" for e in jaxpr.eqns):
+        acc.append(jaxpr)
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                _ppermute_bodies(sub, acc)
+    return acc
+
+
+def collect_ppermutes(jaxpr):
+    """All ppermute eqns (recursively) with their perms and payloads.
+
+    Returns ``[(perm_pairs, payload_shape, payload_dtype), ...]``.
+    """
+    out = []
+    for e in iter_eqns(jaxpr):
+        if e.primitive.name == "ppermute":
+            aval = e.invars[0].aval
+            out.append((tuple((int(a), int(b)) for a, b in
+                        e.params["perm"]),
+                        tuple(aval.shape), str(aval.dtype)))
+    return out
+
+
+@dataclasses.dataclass
+class RoundInfo:
+    """One exchange round: mutually-independent sends at one level."""
+
+    level: int              #: ppermute-ancestor count of every send
+    perms: List[tuple]      #: each send's (src, dst) pair tuple
+    payload_shapes: List[tuple]
+    payload_dtypes: List[str]
+
+    @property
+    def size(self) -> int:
+        return len(self.perms)
+
+
+def _dependence_info(body):
+    """Per-eqn ppermute/kernel ancestor sets within one jaxpr body.
+
+    Sub-calls are treated as opaque: an output inherits the union of
+    its equation's input sets — exact at this granularity because the
+    collectives and kernels of interest are direct equations of the
+    body (the innermost-body selection guarantees it for ppermutes).
+    Returns ``(pp_eqns, kernel_eqns)`` where each entry is
+    ``(eqn_index, eqn, pp_ancestors, kernel_ancestors)``.
+    """
+    dep_pp = {}
+    dep_k = {}
+
+    def get(v, table):
+        if isinstance(v, Literal):
+            return frozenset()
+        return table.get(v, frozenset())
+
+    pp_eqns, kernel_eqns = [], []
+    for i, eqn in enumerate(body.eqns):
+        anc_pp = frozenset().union(
+            *[get(v, dep_pp) for v in eqn.invars]) \
+            if eqn.invars else frozenset()
+        anc_k = frozenset().union(
+            *[get(v, dep_k) for v in eqn.invars]) \
+            if eqn.invars else frozenset()
+        if eqn.primitive.name == "ppermute":
+            pp_eqns.append((i, eqn, anc_pp, anc_k))
+            anc_pp = anc_pp | {i}
+        elif eqn.primitive.name in KERNEL_PRIMS:
+            kernel_eqns.append((i, eqn, anc_pp, anc_k))
+            anc_k = anc_k | {i}
+        for ov in eqn.outvars:
+            dep_pp[ov] = anc_pp
+            dep_k[ov] = anc_k
+    return pp_eqns, kernel_eqns
+
+
+def audit_rounds(jaxpr) -> List[RoundInfo]:
+    """Group a traced program's ppermutes into exchange rounds.
+
+    Sends with equal ppermute-ancestor counts cannot depend on each
+    other (a dependence strictly grows the set), so each group is a
+    set of provably concurrent collectives.  Returns rounds sorted by
+    level.  Raises ``ValueError`` if ppermutes are split across more
+    than one innermost body (no current stepper does this; the level
+    analysis would be unsound across bodies).
+    """
+    bodies = _ppermute_bodies(jaxpr)
+    if not bodies:
+        return []
+    if len(bodies) > 1:
+        raise ValueError(
+            f"ppermutes issued from {len(bodies)} separate jaxpr "
+            f"bodies; round analysis expects one exchange scope")
+    pp_eqns, _ = _dependence_info(bodies[0])
+    by_level = {}
+    for i, eqn, anc_pp, _ in pp_eqns:
+        aval = eqn.invars[0].aval
+        by_level.setdefault(len(anc_pp), []).append(
+            (tuple((int(a), int(b)) for a, b in eqn.params["perm"]),
+             tuple(aval.shape), str(aval.dtype)))
+    rounds = []
+    for level in sorted(by_level):
+        sends = by_level[level]
+        rounds.append(RoundInfo(
+            level=level,
+            perms=[p for p, _, _ in sends],
+            payload_shapes=[s for _, s, _ in sends],
+            payload_dtypes=[d for _, _, d in sends]))
+    return rounds
+
+
+def verify_round_structure(rounds, report, subject,
+                           stages_per_round: int = None):
+    """Well-formedness: equal-size rounds at cumulative levels.
+
+    This is the traced form of the phase-split contract: round r's
+    level equals the total send count of rounds < r, i.e. every send
+    of a round is issued off pre-round state only — none waits on a
+    sibling's received strip (the deadlock/race condition the 4-stage
+    coloring exists to prevent).
+    """
+    if not rounds:
+        report.fail("jaxpr.rounds", subject, "no ppermutes found")
+        return
+    sizes = {r.size for r in rounds}
+    report.check(
+        len(sizes) == 1, "jaxpr.uniform_rounds", subject,
+        f"exchange rounds have mixed send counts "
+        f"{[r.size for r in rounds]}")
+    if stages_per_round is not None:
+        report.check(
+            rounds[0].size == stages_per_round, "jaxpr.round_size",
+            subject,
+            f"expected {stages_per_round} concurrent sends per round, "
+            f"got {rounds[0].size}")
+    cum = 0
+    for r in rounds:
+        report.check(
+            r.level == cum, "jaxpr.sends_before_consumers", subject,
+            f"round at ancestor level {r.level} expected {cum}: some "
+            f"send depends on a sibling round's received strip")
+        cum += r.size
+
+
+def audit_overlap_windows(jaxpr, report, subject,
+                          expect_overlap: bool):
+    """The overlap contract on the traced program.
+
+    For each exchange round at level L, look for a kernel
+    (``pallas_call``) whose ppermute-ancestor count is exactly L (it
+    consumes nothing the round delivers) and that none of the round's
+    sends depends on (it doesn't gate their issue) — a compute window
+    XLA can schedule under the in-flight collectives.  Overlapped
+    steppers must provide one per round; serialized steppers provide
+    none (their kernels read the round's ghosts), and the check is
+    inverted to prove the *serialized* claim too.
+    """
+    bodies = _ppermute_bodies(jaxpr)
+    if len(bodies) != 1:
+        report.fail("jaxpr.overlap_windows", subject,
+                    f"expected one exchange body, got {len(bodies)}")
+        return
+    pp_eqns, kernel_eqns = _dependence_info(bodies[0])
+    if not kernel_eqns:
+        report.fail("jaxpr.overlap_windows", subject,
+                    "no RHS kernels (pallas_call) in the traced body")
+        return
+    by_level = {}
+    for i, eqn, anc_pp, anc_k in pp_eqns:
+        by_level.setdefault(len(anc_pp), []).append((i, anc_k))
+    windows = 0
+    for level, sends in sorted(by_level.items()):
+        send_ids = frozenset(i for i, _ in sends)
+        send_kernel_deps = frozenset().union(
+            *[anc_k for _, anc_k in sends])
+        # A window kernel must be disjoint from THIS round's sends on
+        # both sides: it consumes none of the round's received strips
+        # (set disjointness, not a mere ancestor-count match — a
+        # kernel mixing earlier-round and current-round ghosts has the
+        # right count but the wrong set) and it gates none of the
+        # round's payloads.
+        found = any(
+            anc_pp.isdisjoint(send_ids)
+            and ki not in send_kernel_deps
+            for ki, _, anc_pp, _ in kernel_eqns)
+        windows += bool(found)
+    if expect_overlap:
+        report.check(
+            windows == len(by_level), "jaxpr.overlap_windows", subject,
+            f"only {windows}/{len(by_level)} exchange rounds have an "
+            f"independent interior kernel to fly under — some round "
+            f"serializes against its own collectives")
+    else:
+        report.check(
+            windows == 0, "jaxpr.serialized_schedule", subject,
+            f"{windows} rounds have collective-independent kernels "
+            f"but the serialized schedule was requested")
+
+
+def audit_dtypes(jaxpr, report, subject, expect_bf16: bool = False,
+                 allow_f64: bool = False):
+    """Precision-policy conformance over every field-shaped aval.
+
+    ``allow_f64`` exempts the dtype-follows-ambient tiers (the TT
+    numerics deliberately run in the host's x64 mode — the f64-on-CPU
+    oracle convention); the dense/fused steppers are dtype-explicit
+    f32 and get the strict check.
+    """
+    census = Counter()
+    for e in iter_eqns(jaxpr):
+        for ov in e.outvars:
+            aval = getattr(ov, "aval", None)
+            if aval is not None and getattr(aval, "ndim", 0) >= 2:
+                census[str(aval.dtype)] += 1
+    if not allow_f64:
+        report.check(
+            census.get("float64", 0) == 0, "jaxpr.no_f64_fields",
+            subject,
+            f"{census.get('float64', 0)} float64 field arrays in the "
+            f"trace — an f32->f64 promotion leaked into the stepper")
+    n_bf16 = census.get("bfloat16", 0)
+    if expect_bf16:
+        report.check(
+            n_bf16 > 0, "jaxpr.policy_applied", subject,
+            "a reduced-precision policy is active but the trace "
+            "contains no bfloat16 ops — the policy silently did not "
+            "apply")
+    else:
+        report.check(
+            n_bf16 == 0, "jaxpr.no_bf16_leak", subject,
+            f"{n_bf16} bfloat16 ops in an f32-policy stepper — a "
+            f"reduced-precision op leaked outside "
+            f"ops/pallas/precision.py policy regions")
+    return dict(census)
+
+
+def audit_callbacks(jaxpr, report, subject):
+    """No host callbacks anywhere in a (segment-loop) jaxpr."""
+    found = sorted({
+        e.primitive.name for e in iter_eqns(jaxpr)
+        if "callback" in e.primitive.name
+        or e.primitive.name in HOST_CALLBACK_PRIMS})
+    report.check(
+        not found, "jaxpr.no_host_callbacks", subject,
+        f"host-callback primitives inside the compiled loop: {found} "
+        f"— each one syncs the device stream to the host every "
+        f"iteration")
+    return found
+
+
+def audit_donation(jit_fn, args, report, subject,
+                   expect_donated: bool = True):
+    """Donation that actually aliases, from lowered + compiled text.
+
+    ``jit_fn`` must be a ``jax.jit`` object.  ``expect_donated=True``
+    checks both levels: the lowered module must carry the donation
+    annotation (``jax.buffer_donor`` / ``tf.aliasing_output``) and the
+    compiled HLO an ``input_output_alias`` entry — declared-but-
+    dropped donation double-buffers every prognostic array silently.
+    ``expect_donated=False`` checks the lowering only (aliasing can
+    only originate from a donor annotation, so absence there proves
+    absence downstream without paying a compile).
+    """
+    lowered = jit_fn.lower(*args)
+    ltxt = lowered.as_text()
+    declared = ("jax.buffer_donor" in ltxt) or ("tf.aliasing_output"
+                                                in ltxt)
+    if not expect_donated:
+        report.check(
+            not declared, "jaxpr.no_donation", subject,
+            "no donation was requested but the lowered module "
+            "declares buffer donors — a caller-held state would be "
+            "clobbered")
+        return {"declared": declared, "aliased": None}
+    ctxt = lowered.compile().as_text()
+    aliased = "input_output_alias" in ctxt
+    report.check(
+        declared, "jaxpr.donation_declared", subject,
+        "donate_argnums was requested but the lowered module "
+        "carries no buffer-donor annotation")
+    report.check(
+        aliased, "jaxpr.donation_aliases", subject,
+        "donation declared but the compiled executable has no "
+        "input_output_alias — XLA double-buffers the carry")
+    return {"declared": declared, "aliased": aliased}

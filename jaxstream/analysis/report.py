@@ -1,0 +1,86 @@
+"""Structured result container for the static contract checker.
+
+Every check records either a pass or a :class:`Violation`; the report
+is the single exchange format between the verifier passes
+(:mod:`.schedule`, :mod:`.jaxpr_audit`), the driver
+(:mod:`.contracts`), the CLI (``scripts/analyze.py`` — human text or
+``--json``), and the bench ``contract_check`` stamp.  A report with
+zero violations is the machine-checked proof artifact; a nonzero CLI
+exit is keyed off :attr:`ContractReport.ok` alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+__all__ = ["Violation", "ContractReport"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One broken contract: which check, on what subject, and how."""
+
+    check: str    #: dotted check id, e.g. ``schedule.total_permutation``
+    subject: str  #: what was checked, e.g. ``CovShardProgram stage 2``
+    detail: str   #: human-readable specifics (the loud part)
+
+    def __str__(self):
+        return f"[{self.check}] {self.subject}: {self.detail}"
+
+
+class ContractReport:
+    """Accumulates (check, subject, ok, detail) tuples across passes."""
+
+    def __init__(self):
+        self._passes: List[tuple] = []
+        self.violations: List[Violation] = []
+
+    # -- recording ----------------------------------------------------
+    def ok(self, check: str, subject: str, detail: str = ""):
+        self._passes.append((check, subject, detail))
+
+    def fail(self, check: str, subject: str, detail: str):
+        self.violations.append(Violation(check, subject, detail))
+
+    def check(self, cond: bool, check: str, subject: str, detail: str):
+        """Record a pass/fail in one call; returns ``cond``."""
+        if cond:
+            self.ok(check, subject)
+        else:
+            self.fail(check, subject, detail)
+        return bool(cond)
+
+    # -- reading ------------------------------------------------------
+    @property
+    def passed(self) -> bool:
+        return not self.violations
+
+    @property
+    def checks_run(self) -> int:
+        return len(self._passes) + len(self.violations)
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.passed,
+            "checks_run": self.checks_run,
+            "violation_count": len(self.violations),
+            "violations": [dataclasses.asdict(v) for v in self.violations],
+            "passes": [
+                {"check": c, "subject": s} for c, s, _ in self._passes
+            ],
+        }
+
+    def format(self) -> str:
+        """Human report: one line per check, violations first."""
+        lines = []
+        for v in self.violations:
+            lines.append(f"FAIL {v}")
+        for check, subject, detail in self._passes:
+            tail = f" ({detail})" if detail else ""
+            lines.append(f"ok   [{check}] {subject}{tail}")
+        lines.append(
+            f"contract check: {self.checks_run} checks, "
+            f"{len(self.violations)} violation(s) — "
+            + ("CLEAN" if self.passed else "BROKEN"))
+        return "\n".join(lines)

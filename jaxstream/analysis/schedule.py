@@ -1,0 +1,369 @@
+"""Exchange-schedule verifier: prove ppermute schedules race-free.
+
+``jax.lax.ppermute`` *silently* ignores destinations that no pair
+names — a dropped ``(src, dst)`` leaves the receiver's ghost strip
+zero-filled, which reads as plausible physics (the exact failure class
+Putman & Lin 2007 edge handling makes easy to introduce).  These checks
+turn the deck's race-free-schedule claim into machine-checked
+propositions over the schedules the factories actually build:
+
+* **total permutation** — every stage's pair list is injective on both
+  sides with no self-sends; on the face tier each stage is a bijection
+  on all 6 faces (a perfect matching of the octahedron face-adjacency
+  graph), so no device is left silently unserved;
+* **seam-graph membership** — every pair connects faces that share a
+  physical cube edge (antipodal faces never exchange), and pairs come
+  in symmetric ``(a, b)``/``(b, a)`` couples (both directions of one
+  seam ride the same stage);
+* **coverage** — the stage union carries each of the 24 directed seams
+  (12 undirected cube edges) exactly once, and each of the 8 cube
+  corners' three incident seams lands in 3 *distinct* stages (two seams
+  of one corner share a face — same-stage would be a double-send);
+* **strip depth** — the program's rotation/ghost tables are as deep as
+  the declared halo, including the deep-halo ``D = 3*k*halo`` of
+  temporal blocking (an off-by-one here under-fills the deepest ghost
+  ring and only shows up as slow truncation drift).
+
+All verifiers record into a :class:`..report.ContractReport` and are
+pure — no devices, no tracing (the traced-side twin lives in
+:mod:`.jaxpr_audit`).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from ..geometry.connectivity import build_connectivity
+
+__all__ = [
+    "face_seam_graph",
+    "verify_stage_perms",
+    "verify_cov_program",
+    "verify_shard_halo_program",
+    "verify_deep_program",
+    "verify_block_program",
+]
+
+
+def face_seam_graph():
+    """The cubed-sphere seam graph, reconstructed from connectivity.
+
+    Returns a dict with:
+      ``adj``       — the EdgeLink table (``adj[face][edge]``);
+      ``directed``  — set of 24 directed ``(face, nbr_face)`` seams;
+      ``undirected``— set of 12 frozensets ``{f, g}``;
+      ``edge_of``   — ``{(f, g): edge of f abutting g}``;
+      ``corners``   — the 8 cube corners as frozensets of 3 pairwise-
+                      adjacent faces (triangles of the octahedron
+                      face-adjacency graph);
+      ``antipodal`` — set of 3 frozensets of never-adjacent face pairs.
+    """
+    adj = build_connectivity()
+    directed = set()
+    edge_of = {}
+    for f in range(6):
+        for e in range(4):
+            link = adj[f][e]
+            directed.add((f, link.nbr_face))
+            edge_of[(f, link.nbr_face)] = e
+    undirected = {frozenset(p) for p in directed}
+    assert len(directed) == 24 and len(undirected) == 12
+    corners = [
+        frozenset(trio) for trio in itertools.combinations(range(6), 3)
+        if all(frozenset(p) in undirected
+               for p in itertools.combinations(trio, 2))
+    ]
+    assert len(corners) == 8
+    antipodal = {
+        frozenset((f, g)) for f in range(6) for g in range(6)
+        if f < g and frozenset((f, g)) not in undirected
+    }
+    return {"adj": adj, "directed": directed, "undirected": undirected,
+            "edge_of": edge_of, "corners": corners,
+            "antipodal": antipodal}
+
+
+def verify_stage_perms(perms, report, subject, devices: int = 6,
+                       expect_stages: int = 4, graph=None):
+    """Verify face-tier stage perms against the seam graph.
+
+    ``perms`` is what the factories pass to ``lax.ppermute``: one list
+    of ``(src, dst)`` device pairs per stage (devices == faces on this
+    tier).  Records every proposition into ``report`` under
+    ``schedule.*`` check ids.  Returns the graph for reuse.
+    """
+    g = graph or face_seam_graph()
+    report.check(
+        len(perms) == expect_stages, "schedule.stage_count", subject,
+        f"expected {expect_stages} race-free stages, got {len(perms)}")
+
+    seen_directed = {}
+    for s, perm in enumerate(perms):
+        sub = f"{subject} stage {s}"
+        pairs = [(int(a), int(b)) for a, b in perm]
+        srcs = [a for a, _ in pairs]
+        dsts = [b for _, b in pairs]
+        report.check(
+            len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts),
+            "schedule.no_duplicate_pairs", sub,
+            f"duplicate src or dst in {sorted(pairs)}")
+        report.check(
+            all(a != b for a, b in pairs), "schedule.no_self_send", sub,
+            f"self-send pair present in {sorted(pairs)}")
+        # Total permutation: ppermute zero-fills any device that no
+        # pair targets, so a non-bijective stage silently drops data.
+        report.check(
+            sorted(srcs) == list(range(devices))
+            and sorted(dsts) == list(range(devices)),
+            "schedule.total_permutation", sub,
+            f"stage is not a bijection on {devices} devices: "
+            f"srcs={sorted(srcs)} dsts={sorted(dsts)} — ppermute "
+            f"silently zero-fills unpaired receivers (stale ghosts)")
+        report.check(
+            all((b, a) in pairs for a, b in pairs),
+            "schedule.symmetric_pairs", sub,
+            f"seam exchanged one-way only in {sorted(pairs)}")
+        bad = [p for p in pairs if p not in g["directed"]]
+        report.check(
+            not bad, "schedule.seam_graph_membership", sub,
+            f"pairs {sorted(bad)} connect faces that share no cube "
+            f"edge (antipodal faces never exchange)")
+        for p in pairs:
+            seen_directed.setdefault(p, []).append(s)
+
+    multi = {p: st for p, st in seen_directed.items() if len(st) > 1}
+    missing = g["directed"] - set(seen_directed)
+    report.check(
+        not multi and not missing, "schedule.edge_coverage", subject,
+        f"stage union must carry each of the 24 directed seams exactly "
+        f"once: missing={sorted(missing)} "
+        f"multiply-scheduled={sorted(multi)}")
+
+    # Corner invariant: a corner's three seams pairwise share a face,
+    # so any two in one stage would double-send that face — they must
+    # land in 3 distinct stages for the corner fill to be race-free.
+    stage_of_seam = {frozenset(p): st[0]
+                     for p, st in seen_directed.items() if len(st) == 1}
+    for corner in g["corners"]:
+        seams = [frozenset(p) for p in itertools.combinations(corner, 2)]
+        stages = [stage_of_seam.get(s1) for s1 in seams]
+        report.check(
+            None not in stages and len(set(stages)) == 3,
+            "schedule.corner_stages",
+            f"{subject} corner {sorted(corner)}",
+            f"the 3 seams at this corner must be scheduled once each "
+            f"in 3 distinct stages; got stages {stages}")
+    return g
+
+
+def _expected_link(graph, f, partner):
+    """(edge, reversed_) of face ``f``'s seam with ``partner``."""
+    e = graph["edge_of"][(f, partner)]
+    return e, graph["adj"][f][e].reversed_
+
+
+def _verify_seam_tables(perms, edge_sel, rev_sel, report, subject,
+                        graph):
+    """Per-device table consistency with the seam graph — which edge
+    each face exchanges per stage and whether the pair reverses.  The
+    ONE copy of this proposition, shared by every face-tier program
+    verifier (float 0/1 and bool rev tables both normalize through
+    ``> 0.5``)."""
+    edge_sel = np.asarray(edge_sel)
+    rev_sel = np.asarray(rev_sel, dtype=np.float64)
+    for s, perm in enumerate(perms):
+        for f, partner in perm:
+            e, rev = _expected_link(graph, f, partner)
+            report.check(
+                int(edge_sel[f, s]) == e,
+                "schedule.edge_sel_consistency",
+                f"{subject} face {f} stage {s}",
+                f"edge_sel={int(edge_sel[f, s])} but the seam with "
+                f"face {partner} is edge {e}")
+            report.check(
+                bool(rev_sel[f, s] > 0.5) == bool(rev),
+                "schedule.reversal_consistency",
+                f"{subject} face {f} stage {s}",
+                f"rev_sel={float(rev_sel[f, s])} but connectivity says "
+                f"reversed={rev}")
+
+
+def verify_cov_program(program, report, n: int, halo: int,
+                       subject: str = "CovShardProgram"):
+    """Schedule + table checks for the face-tier covariant program."""
+    g = verify_stage_perms(program.perms, report, subject)
+    _verify_seam_tables(program.perms, program.tables["edge_sel"],
+                        program.tables["rev_sel"], report, subject, g)
+
+    # Strip depth: the rotation tables are per-ghost-slot — their depth
+    # IS the ghost depth the exchange fills.
+    t_depth = int(np.asarray(program.tables["T_mine"]).shape[3])
+    report.check(
+        program.halo == halo and t_depth == halo,
+        "schedule.strip_depth", subject,
+        f"declared halo {halo} but program.halo={program.halo}, "
+        f"rotation-table depth={t_depth}")
+    report.check(
+        program.n == n, "schedule.face_extent", subject,
+        f"declared n {n} but program.n={program.n}")
+    return g
+
+
+def verify_shard_halo_program(program, report,
+                              subject: str = "ShardHaloProgram"):
+    """Schedule + parameter checks for the scalar/TT strip program."""
+    g = verify_stage_perms(program.perms, report, subject)
+    _verify_seam_tables(program.perms, program.edge_sel,
+                        program.rev_sel, report, subject, g)
+    return g
+
+
+def verify_deep_program(program, report, n: int, halo: int,
+                        temporal_block: int, rk_stages: int = 3,
+                        subject: str = "deep-halo CovShardProgram"):
+    """Deep-halo (temporal blocking) depth arithmetic + schedule.
+
+    The blocked face tier ships ONE ``(3, D, n)`` exchange per k-step
+    block with ``D = rk_stages * k * halo`` — every RK stage consumes
+    ``halo`` of ghost validity, so a program built at any other depth
+    under-fills (or over-ships) the deepest ring.  The schedule itself
+    must still be the 4-stage race-free coloring.
+    """
+    k = int(temporal_block)
+    D = rk_stages * k * halo
+    subject = f"{subject} (k={k})"
+    report.check(
+        program.halo == D, "schedule.deep_halo_depth", subject,
+        f"temporal_block={k} at halo={halo} needs strip depth "
+        f"3*k*halo = {D}; program ships depth {program.halo} "
+        f"({'under' if program.halo < D else 'over'}-filled by "
+        f"{abs(D - program.halo)} rows — stale deepest ghosts)")
+    report.check(
+        n >= D, "schedule.deep_halo_fits", subject,
+        f"deep strips are read from the interior: n={n} < D={D}")
+    t_depth = int(np.asarray(program.tables["T_mine"]).shape[3])
+    report.check(
+        t_depth == program.halo, "schedule.strip_depth", subject,
+        f"rotation-table depth {t_depth} != program halo "
+        f"{program.halo}")
+    verify_stage_perms(program.perms, report, subject)
+
+
+def _decode_block(idx, s):
+    """Inverse of CovBlockProgram's ``lin``: device -> (face, iy, ix)."""
+    face, rem = divmod(int(idx), s * s)
+    iy, ix = divmod(rem, s)
+    return face, iy, ix
+
+
+def _block_on_edge(edge, iy, ix, s):
+    """Whether block (iy, ix) borders face edge ``edge``; along-edge k."""
+    from ..geometry.connectivity import EDGE_E, EDGE_N, EDGE_S, EDGE_W
+
+    if edge == EDGE_S:
+        return iy == 0, ix
+    if edge == EDGE_N:
+        return iy == s - 1, ix
+    if edge == EDGE_W:
+        return ix == 0, iy
+    if edge == EDGE_E:
+        return ix == s - 1, iy
+    raise ValueError(edge)
+
+
+def verify_block_program(program, report,
+                         subject: str = "CovBlockProgram"):
+    """Schedule checks for the (6, s, s) block-mesh program.
+
+    Cube-edge stages here are *partial* permutations over the
+    ``6*s*s`` device product (only face-boundary blocks participate),
+    so totality becomes: injective both ways, every pair decodes to
+    boundary blocks of seam-adjacent faces with the along-edge block
+    index mirrored exactly when the seam reverses, and the stage union
+    covers each of the ``24*s`` directed seam segments exactly once.
+    The corner-ghost routing masks must be one-hot (each corner filled
+    from exactly one source).
+    """
+    s = program.s
+    g = face_seam_graph()
+    ndev = 6 * s * s
+
+    seen_segments = {}
+    for t, perm in enumerate(program.cube_perms):
+        sub = f"{subject} stage {t}"
+        pairs = [(int(a), int(b)) for a, b in perm]
+        srcs = [a for a, _ in pairs]
+        dsts = [b for _, b in pairs]
+        report.check(
+            len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts)
+            and all(0 <= x < ndev for x in srcs + dsts),
+            "schedule.block_injective", sub,
+            f"stage pairs are not an injective partial permutation on "
+            f"{ndev} devices")
+        report.check(
+            len(pairs) == 6 * s, "schedule.block_stage_size", sub,
+            f"a stage exchanges 3 seams x 2 directions x {s} blocks = "
+            f"{6 * s} pairs; got {len(pairs)} — ppermute zero-fills "
+            f"any dropped receiver")
+        for src, dst in pairs:
+            f, iy, ix = _decode_block(src, s)
+            gface, jy, jx = _decode_block(dst, s)
+            ok_adj = (f, gface) in g["directed"]
+            report.check(
+                ok_adj, "schedule.block_seam_membership", sub,
+                f"pair {src}->{dst} decodes to faces {f}->{gface} "
+                f"which share no cube edge")
+            if not ok_adj:
+                continue
+            e, rev = _expected_link(g, f, gface)
+            e2, _ = _expected_link(g, gface, f)
+            on_e, k = _block_on_edge(e, iy, ix, s)
+            on_e2, kk = _block_on_edge(e2, jy, jx, s)
+            report.check(
+                on_e and on_e2, "schedule.block_boundary", sub,
+                f"pair {src}->{dst}: block ({f},{iy},{ix}) or "
+                f"({gface},{jy},{jx}) is not on the shared seam")
+            expect_kk = s - 1 - k if rev else k
+            report.check(
+                kk == expect_kk, "schedule.block_orientation", sub,
+                f"seam {f}->{gface} (reversed={rev}): block {k} must "
+                f"land at {expect_kk}, landed at {kk} — misrouted "
+                f"along-edge segment")
+            seen_segments.setdefault((f, gface, k), []).append(t)
+
+    want = {(f, gface, k) for (f, gface) in g["directed"]
+            for k in range(s)}
+    missing = want - set(seen_segments)
+    multi = {k: v for k, v in seen_segments.items() if len(v) > 1}
+    report.check(
+        not missing and not multi, "schedule.block_segment_coverage",
+        subject,
+        f"each of the {24 * s} directed seam segments must ride "
+        f"exactly one stage: missing={sorted(missing)} "
+        f"multiply-scheduled={sorted(multi)}")
+
+    # Intra-panel shifts: each axis direction is the full (s-1)-chain.
+    for axname, perm, e_send, e_recv in program.intra_perms:
+        pairs = sorted((int(a), int(b)) for a, b in perm)
+        fwd = sorted((i, i + 1) for i in range(s - 1))
+        bwd = sorted((i + 1, i) for i in range(s - 1))
+        report.check(
+            pairs in (fwd, bwd),
+            "schedule.intra_shift", f"{subject} axis {axname} "
+            f"edge {e_send}->{e_recv}",
+            f"intra-panel shift is not the full neighbor chain: "
+            f"{pairs}")
+
+    # Corner routing one-hot: every ghost corner of every block is
+    # filled from exactly one source (x-neighbor, y-neighbor, or the
+    # face-local average) — "corners exactly once".
+    hot = (np.asarray(program.tables["corner_use_x"])
+           + np.asarray(program.tables["corner_use_y"])
+           + np.asarray(program.tables["corner_use_avg"]))
+    report.check(
+        bool(np.all(hot == 1.0)), "schedule.corner_one_hot", subject,
+        f"corner-source masks must be one-hot per corner; "
+        f"sum range [{hot.min()}, {hot.max()}]")
+    return g

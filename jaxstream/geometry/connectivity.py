@@ -20,6 +20,7 @@ reference's verified properties, SURVEY.md §2.5):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import List, Tuple
 
 import numpy as np
@@ -35,6 +36,8 @@ __all__ = [
     "build_connectivity",
     "edge_pairs",
     "build_schedule",
+    "schedule_perms",
+    "schedule_fingerprint",
 ]
 
 # Edge ids: S = beta min, E = alpha max, N = beta max, W = alpha min.
@@ -168,3 +171,44 @@ def build_schedule(adj=None, num_stages: int = 4) -> List[List[Tuple[EdgeLink, E
     if not place(0):
         raise RuntimeError(f"edge coloring with {num_stages} stages failed")
     return stages
+
+
+def schedule_perms(adj=None, num_stages: int = 4):
+    """The canonical per-stage ``lax.ppermute`` pair lists.
+
+    ``[[(src_face, dst_face), ...], ...]`` — exactly the ``perm``
+    argument every face-tier exchange factory passes to ``ppermute``
+    (``CovShardProgram`` and ``ShardHaloProgram`` both derive theirs
+    from :func:`build_schedule` the same way).  The single source the
+    static contract checker and the ``comm_probe`` analytic plans
+    fingerprint against.
+    """
+    perms = []
+    for stage in build_schedule(adj, num_stages):
+        perm = []
+        for link, back in stage:
+            perm.append((link.face, link.nbr_face))
+            perm.append((back.face, back.nbr_face))
+        perms.append(perm)
+    return perms
+
+
+def schedule_fingerprint(perms=None) -> str:
+    """Canonical 16-hex digest of a stage schedule's ppermute pairs.
+
+    ``perms`` is a list of stages, each a list of ``(src, dst)`` pairs
+    (defaults to :func:`schedule_perms`).  Canonicalization sorts the
+    pairs within each stage and the stages among themselves, so the
+    digest identifies the *schedule* — which seams exchange together —
+    independent of pair issue order; any dropped, duplicated, or
+    re-staged pair changes it.  ``comm_probe``'s analytic plans carry
+    this value and ``jaxstream.analysis`` recomputes it from the traced
+    jaxprs' actual ``ppermute`` params, so the analytic accounting and
+    the compiled schedules can never silently diverge.
+    """
+    if perms is None:
+        perms = schedule_perms()
+    canon = tuple(sorted(
+        tuple(sorted((int(a), int(b)) for a, b in stage))
+        for stage in perms))
+    return hashlib.sha256(repr(canon).encode()).hexdigest()[:16]

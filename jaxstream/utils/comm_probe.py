@@ -67,7 +67,17 @@ def temporal_block_plan(n: int, halo: int, temporal_block: int,
     Sets ``payload_bytes_per_step`` and the reported
     ``wire_bytes_saving_vs_f32`` fraction; element counts are
     dtype-independent.
+
+    ``schedule_fingerprint`` (round 13): the canonical digest of the
+    4-stage race-free schedule this accounting assumes
+    (:func:`jaxstream.geometry.connectivity.schedule_fingerprint`).
+    ``jaxstream.analysis`` recomputes the fingerprint from the traced
+    steppers' actual ``ppermute`` perms and cross-checks it against
+    this field, so the analytic plan and the compiled schedule can
+    never silently diverge.
     """
+    from ..geometry.connectivity import schedule_fingerprint
+
     if temporal_block < 1:
         raise ValueError(
             f"temporal_block must be >= 1, got {temporal_block}")
@@ -78,6 +88,7 @@ def temporal_block_plan(n: int, halo: int, temporal_block: int,
     redundant = [(w * w - n * n) / float(n * n) for w in windows]
     return {
         "temporal_block": k,
+        "schedule_fingerprint": schedule_fingerprint(),
         "deep_halo_width": D,
         "fits": n >= D,
         "ppermutes_per_step": 4.0 / k,
@@ -116,7 +127,12 @@ def batched_exchange_plan(n: int, halo: int, members: int,
     in B).  ``dtype_bytes=2`` is the 16-bit-strips policy
     (round 10) — payload and wire bytes halve; the saving fraction is
     reported as ``wire_bytes_saving_vs_f32``.
+    ``schedule_fingerprint`` (round 13): the canonical schedule digest
+    the analyzer cross-checks against traced ppermute perms (see
+    :func:`temporal_block_plan`).
     """
+    from ..geometry.connectivity import schedule_fingerprint
+
     if members < 1:
         raise ValueError(f"members must be >= 1, got {members}")
     if halo < 1 or n < 1:
@@ -127,6 +143,7 @@ def batched_exchange_plan(n: int, halo: int, members: int,
     payload = B * 3 * halo * n * dtype_bytes
     return {
         "members": B,
+        "schedule_fingerprint": schedule_fingerprint(),
         "ppermutes_per_step": float(per_step),
         "ppermutes_per_member_step": per_step / B,
         "serialized_ppermutes_per_member_step": float(per_step),
@@ -152,11 +169,17 @@ def serve_placement_plan(buckets, num_devices: int, n: int,
     communicate; panel mode: the face tier's 12 ppermutes/step at the
     batched-exchange payload).  ``dtype_bytes=2`` re-bills a 16-bit
     strips policy, like the other plans.
+    The panel accounting assumes the canonical race-free schedule; its
+    ``schedule_fingerprint`` is the analyzer's cross-check hook
+    (round 13, see :func:`temporal_block_plan`).
     """
+    from ..geometry.connectivity import schedule_fingerprint
     from ..serve.placement import placement_report
 
-    return placement_report(buckets, num_devices, n, halo,
-                            dtype_bytes=dtype_bytes)
+    out = placement_report(buckets, num_devices, n, halo,
+                           dtype_bytes=dtype_bytes)
+    out["schedule_fingerprint"] = schedule_fingerprint()
+    return out
 
 
 def run_default_probe(iters: int = 100, steps: int = 30, n: int = 0,
@@ -419,9 +442,16 @@ def format_report(result: dict) -> str:
             f"wire/member-step={be['wire_bytes_per_member_step']} B"
             + (f" (16-bit strips: -"
                f"{100 * be['wire_bytes_saving_vs_f32']:.0f}% wire)"
-               if be.get("wire_bytes_saving_vs_f32") else ""))
+               if be.get("wire_bytes_saving_vs_f32") else "")
+            + (f" sched={be['schedule_fingerprint']}"
+               if be.get("schedule_fingerprint") else ""))
     sp = result.get("serve_placement_plan")
     if sp:
+        if sp.get("schedule_fingerprint"):
+            lines.append(
+                f"comm_probe{tag}: serve placement panel exchange "
+                f"assumes the canonical race-free schedule "
+                f"sched={sp['schedule_fingerprint']}")
         for mode, info in sp["modes"].items():
             if "skipped" in info:
                 lines.append(
@@ -451,5 +481,7 @@ def format_report(result: dict) -> str:
             + (f" payload/step={tb['payload_bytes_per_step']:.0f} B "
                f"(16-bit strips: -"
                f"{100 * tb['wire_bytes_saving_vs_f32']:.0f}% wire)"
-               if tb.get("wire_bytes_saving_vs_f32") else ""))
+               if tb.get("wire_bytes_saving_vs_f32") else "")
+            + (f" sched={tb['schedule_fingerprint']}"
+               if tb.get("schedule_fingerprint") else ""))
     return "\n".join(lines)

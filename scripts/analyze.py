@@ -1,0 +1,125 @@
+"""Trace-time contract checker (CLI front end for jaxstream.analysis).
+
+Statically verifies the paper's race-free halo-exchange claim and the
+compiled-stepper invariants across the current composition matrix —
+see :mod:`jaxstream.analysis` for what each pass proves.  Exit status
+0 = every contract holds; 1 = violations (listed on stdout, or in the
+``violations`` array under ``--json``).
+
+Usage::
+
+    python scripts/analyze.py [n] [--json] [--schedules-only]
+                              [--no-compile]
+                              [--fixture dropped_pair|deep_depth]
+
+``[n]`` is the face size of the check grid (default 12 — the matrix
+is resolution-independent; a bigger n only costs trace time).
+``--schedules-only`` runs just the pure schedule pass (milliseconds,
+no devices — the pre-commit mode).  ``--no-compile`` skips the two
+checks that need XLA compiles (donation aliasing, member-parallel
+zero-wire HLO), keeping the run trace-only.  ``--fixture`` verifies
+one of the seeded-broken regression schedules instead
+(:mod:`jaxstream.analysis.fixtures`): the checker must FAIL it, so the
+command exits nonzero — CI asserts both fixtures trip and every real
+schedule passes, proving the pass has teeth in the same gate that
+trusts it.
+
+``--json`` prints exactly ONE JSON line: ``ok``, ``checks_run``,
+``violations`` and — for the full mode — per-variant ``facts``
+(collective counts vs the comm_probe analytic plans, payload bytes,
+schedule fingerprints).  ``bench.py`` embeds the same record as every
+run's ``contract_check`` stamp, and the tier-1 gate runs this file's
+checks through tests/test_analysis.py.
+
+The stepper matrix needs >= 6 CPU devices; running this file as
+``__main__`` sets the virtual-host-device flag before JAX's backends
+initialize (in-process callers rely on their own pool, e.g. the test
+conftest's 8 virtual devices).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def run(argv):
+    """Parse ``argv`` and run the requested pass.
+
+    Returns ``(exit_code, result_dict, report)`` — importable so
+    ``bench.py`` and the tests reuse the CLI semantics in-process
+    without a subprocess.
+    """
+    args = list(argv)
+    as_json = "--json" in args
+    schedules_only = "--schedules-only" in args
+    no_compile = "--no-compile" in args
+    fixture = None
+    n = 12
+    consumed = set()
+    for i, a in enumerate(args):
+        if i in consumed or a in ("--json", "--schedules-only",
+                                  "--no-compile"):
+            continue
+        if a == "--fixture":
+            if i + 1 >= len(args) or args[i + 1].startswith("--"):
+                print("usage: analyze.py --fixture "
+                      "dropped_pair|deep_depth", file=sys.stderr)
+                raise SystemExit(2)
+            fixture = args[i + 1]
+            consumed.add(i + 1)
+        elif a.isdigit():
+            n = int(a)
+        else:
+            # A typo'd flag must not silently run a different (more
+            # expensive, or weaker) mode with exit 0.
+            print(f"analyze.py: unknown argument {a!r}; usage: "
+                  f"analyze.py [n] [--json] [--schedules-only] "
+                  f"[--no-compile] [--fixture dropped_pair|deep_depth]",
+                  file=sys.stderr)
+            raise SystemExit(2)
+
+    from jaxstream.analysis import contracts
+    from jaxstream.analysis import fixtures as fx
+
+    if fixture is not None:
+        if fixture not in fx.FIXTURES:
+            print(f"unknown fixture {fixture!r}; valid: "
+                  f"{list(fx.FIXTURES)}", file=sys.stderr)
+            raise SystemExit(2)
+        report = fx.run_fixture(fixture, n=n)
+        result = {"mode": f"fixture:{fixture}", **report.to_json()}
+        # A fixture is a seeded break: violations are the EXPECTED
+        # outcome, and the nonzero exit is what CI asserts.  Exit 0
+        # here would mean the checker failed to catch the break.
+        return (1 if not report.passed else 0), result, report
+    if schedules_only:
+        report = contracts.check_schedules(n=n)
+        result = {"mode": "schedules", **report.to_json()}
+    else:
+        report, facts = contracts.run_all(
+            n=n, include_compile=not no_compile)
+        result = {"mode": "full", **report.to_json(), "facts": facts}
+    return (0 if report.passed else 1), result, report
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    code, result, report = run(argv)
+    if "--json" in argv:
+        print(json.dumps(result))
+    else:
+        print(report.format())
+    return code
+
+
+if __name__ == "__main__":
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.exit(main())

@@ -62,6 +62,16 @@ every gate run self-checking):
    The conftest's 8 virtual CPU devices exist exactly so these tests
    run in-process.
 
+8. **Contract-checker tests stay non-slow and in-process** (round-13
+   static-analysis satellite): a module importing
+   ``jaxstream.analysis`` must carry NO ``slow`` markers and must not
+   launch subprocesses.  The contract checks (schedule totality, the
+   traced-vs-plan collective counts, the seeded-broken fixtures
+   failing loudly) are the machine-checked proof of the race-free
+   claim — they must run in every fast gate, on the conftest's
+   in-process virtual devices; a slow-marked or subprocess rewrite
+   would silently drop the proof from the gate that cites it.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -103,6 +113,10 @@ _PLACEMENT_IMPORT_RE = re.compile(
     r"|import\s+jaxstream\.serve\.placement\b"
     r"|from\s+jaxstream\.serve\s+import\s+[^\n]*"
     r"\b(placement|plan_placement|placement_report|BucketPlan)\b)",
+    re.MULTILINE)
+_ANALYSIS_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.analysis\b|import\s+jaxstream\.analysis\b"
+    r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*analysis\b)",
     re.MULTILINE)
 
 
@@ -171,6 +185,24 @@ def lint_file(path: str, allowed: set):
                f"device worker would be forced slow by rule 2, "
                f"silently dropping member-parallel/panel-sharded "
                f"coverage from the fast gate)")
+    if _ANALYSIS_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports jaxstream.analysis but marks tests "
+                   f"slow — the static contract checks (schedule "
+                   f"totality, traced-vs-plan collective counts, the "
+                   f"broken-fixture regressions) are the machine-"
+                   f"checked proof of the race-free exchange claim and "
+                   f"must run in every fast gate; move the slow test "
+                   f"to a module that does not import "
+                   f"jaxstream.analysis")
+        if "subprocess" in src:
+            yield (f"{rel}: imports jaxstream.analysis but launches "
+                   f"subprocesses — contract checks must run "
+                   f"IN-PROCESS on the conftest's virtual devices "
+                   f"(a subprocess rewrite would be forced slow by "
+                   f"rule 2, silently dropping the contract proof "
+                   f"from the fast gate); drive scripts/analyze.py "
+                   f"through its importable run()/main() instead")
 
 
 def main(repo_root: str = None) -> int:

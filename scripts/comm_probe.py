@@ -25,6 +25,15 @@ strips policy banks (``jaxstream.ops.pallas.precision``).  Measured
 latencies still ship f32 strips (the sharded steppers run f32
 numerics); the plans tag the savings explicitly.
 
+Every analytic plan (temporal-block, batched-exchange, serve
+placement) now carries a ``schedule_fingerprint`` (round 13): the
+canonical digest of the 4-stage race-free schedule the accounting
+assumes, printed as a ``sched=...`` tag on the report lines and
+emitted in ``--json``.  ``scripts/analyze.py`` recomputes the same
+digest from the traced steppers' actual ``ppermute`` perms and fails
+if they ever diverge — the plans are an enforced contract, not
+parallel bookkeeping.
+
 ``--serve BUCKETS`` (round 12) prints the serving placement-plan
 report instead of the latency probes: for each placement mode
 (member-parallel / panel-sharded), per batch-size bucket, the

@@ -142,6 +142,28 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
         assert rl["mixed_roof_tflops"] > 0.0, name
         assert "pct_of_mixed_roof" in rl, name
 
+    # The contract-check stamp (round 13): every bench run carries the
+    # static analyzer's verdict over the full composition matrix —
+    # schedule totality/coverage/depth, traced collective counts vs
+    # the comm_probe analytic plans, overlap windows, precision/
+    # donation/callback invariants.  The smoke asserts it ran AND came
+    # back clean, so a broken schedule fails this tier-1 gate even if
+    # every runtime parity window happens to look plausible.
+    cc = rec["contract_check"]
+    assert "skipped" not in cc, cc
+    assert cc["exit_code"] == 0
+    assert cc["ok"] is True
+    assert cc["violations"] == []
+    assert cc["checks_run"] > 400
+    facts = cc["facts"]
+    assert facts["ok"] is True
+    # The analytic plans and the traced schedules pin the same digest.
+    from jaxstream.geometry.connectivity import schedule_fingerprint
+
+    assert facts["schedule_fingerprint"] == schedule_fingerprint()
+    assert facts["variants"]["face_serialized"][
+        "ppermutes_per_step"] == 12.0
+
     # --telemetry writes a schema-valid obs-sink file alongside the
     # stdout JSON (round-8 satellite: bench rides the structured sink).
     from jaxstream.obs.sink import read_records

@@ -176,6 +176,44 @@ def test_placement_module_with_subprocess_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 1
 
 
+def test_analysis_module_rules_detected(tmp_path):
+    """Rule 8 (round-13 satellite): contract-checker tests stay
+    non-slow AND in-process — a module importing jaxstream.analysis
+    may neither carry slow markers nor launch subprocesses (the
+    static proof of the race-free schedule must ride every fast
+    gate)."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Slow-marked analysis module trips the lint.
+    (tests / "test_an.py").write_text(
+        "import pytest\n"
+        "from jaxstream.analysis import run_all\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Subprocess-launching analysis module trips it too.
+    (tests / "test_an.py").write_text(
+        "import subprocess\n"
+        "import jaxstream.analysis\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', 'scripts/analyze.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module, unmarked and in-process, is clean.
+    (tests / "test_an.py").write_text(
+        "from jaxstream.analysis import contracts\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # The `from jaxstream import analysis` spelling is caught too.
+    (tests / "test_an.py").write_text(
+        "import pytest\n"
+        "from jaxstream import analysis\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+
+
 def test_precision_module_with_slow_marker_detected(tmp_path):
     """Rule 5 (round-10 satellite): precision-parity tests stay tier-1
     — a module importing jaxstream.ops.pallas.precision must carry no

@@ -142,3 +142,36 @@ def test_strip_dtype_wire_byte_accounting():
                          "temporal_block_plan": p16,
                          "batched_exchange_plan": b16})
     assert rep.count("16-bit strips: -50% wire") == 2
+
+
+def test_plans_carry_schedule_fingerprint():
+    """Round-13 satellite: every analytic plan pins the canonical
+    race-free schedule it assumes, so the static analyzer can
+    cross-check the traced ppermute perms against the accounting —
+    the plans become an enforced contract instead of parallel
+    bookkeeping."""
+    from jaxstream.geometry.connectivity import (schedule_fingerprint,
+                                                 schedule_perms)
+    from jaxstream.utils.comm_probe import (batched_exchange_plan,
+                                            serve_placement_plan)
+
+    fp = schedule_fingerprint()
+    assert len(fp) == 16 and int(fp, 16) >= 0   # 16-hex digest
+    # Deterministic and derived from the real schedule's pairs.
+    assert fp == schedule_fingerprint(schedule_perms())
+    # Any dropped pair changes it (the silent-ppermute failure class).
+    perms = [list(p) for p in schedule_perms()]
+    perms[1] = perms[1][:-1]
+    assert schedule_fingerprint(perms) != fp
+
+    assert temporal_block_plan(96, 2, 4)["schedule_fingerprint"] == fp
+    assert batched_exchange_plan(96, 2, 4)["schedule_fingerprint"] == fp
+    assert serve_placement_plan([4], 6, 96)[
+        "schedule_fingerprint"] == fp
+
+    rep = format_report({"platform": "cpu",
+                         "temporal_block_plan":
+                             temporal_block_plan(96, 2, 4),
+                         "batched_exchange_plan":
+                             batched_exchange_plan(96, 2, 4)})
+    assert rep.count(f"sched={fp}") == 2
