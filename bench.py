@@ -41,7 +41,13 @@ member-steps/s at equal per-chip batch vs the single-device packed
 rate, with the >= 0.8x-of-ideal N-chip scaling floor enforced on real
 accelerators (reported-only on fake CPU meshes), the
 single-vs-multichip packed-h byte-parity check, and zero steady-state
-recompiles per placement mode.  ``python bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
+recompiles per placement mode.  The ``serving_slo`` field (round 14,
+``bench_serving_slo``) replays a deterministic heavy-tailed mixed-IC
+trace through the asyncio HTTP gateway over loopback with live
+autoscaling and enforces the SLO floors: request p50/p99 latency,
+goodput >= 0.5x the packed serving rate, completed + typed-shed ==
+submitted, >= 1 autoscale resize, zero steady-state recompiles after
+the resize.  ``python bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
 wired into tier-1 via tests/test_bench_smoke.py); ``python bench.py
 --compile-report`` prints cold-vs-warm compile seconds for the
 ``JAXSTREAM_COMPILE_CACHE`` persistent-cache opt-in; ``python bench.py
@@ -1341,6 +1347,136 @@ def bench_serving_multichip(n=96, dt=300.0, per_chip=4, seg=8,
         return {"skipped": f"{type(e).__name__}: {e}"}
 
 
+def bench_serving_slo(n=96, dt=300.0, n_requests=64, seed=1404,
+                      buckets="1,4,16", seg=8, backend="jnp",
+                      queue_capacity=24, lengths=None,
+                      mean_gap_s=0.02, tail_alpha=1.4,
+                      max_workers=8, p99_floor_s=None,
+                      goodput_floor_frac=0.5, packed_msps=None,
+                      gates=True):
+    """Serving-SLO section (round 14): the network front door under a
+    closed-loop heavy-tailed load, with enforced floors.
+
+    An in-process :class:`jaxstream.gateway.Gateway` binds loopback; a
+    deterministic mixed-IC trace (tc2/tc5/tc6/galewsky, ragged
+    lengths, heavy-tailed Pareto arrivals — ``jaxstream.loadgen``) is
+    replayed against it over real HTTP by a bounded worker pool while
+    the autoscale policy resizes the active bucket cap live from queue
+    depth + occupancy.  This measures what the throughput sections
+    cannot: REQUEST latency percentiles (submit-to-result wall time
+    through admission, queueing, packing, streaming), goodput
+    (member-steps of completed work per second), and the overload
+    contract (every request completes or sheds as a typed 429/503).
+
+    Floors (``gates=True``; breaches surface as ``skipped`` with the
+    reason, like the sibling serving sections):
+
+      * accounting exactness — completed + typed-shed == submitted,
+        zero untyped errors;
+      * >= 1 live autoscale resize (the burst must trip the policy);
+      * ZERO steady-state recompiles after warmup, resizes included
+        (every level maps to a warm bucket by construction — this
+        asserts it);
+      * goodput >= ``goodput_floor_frac`` x the packed serving rate
+        (``packed_msps``, member-steps/s from ``bench_serving`` —
+        main() threads it through; the HTTP+streaming front door may
+        cost at most half the engine's rate at this scale);
+      * request p99 <= ``p99_floor_s`` when given (absolute SLO for
+        the calibrated TPU config; None = reported only).
+
+    Never raises (returns ``{"skipped": ...}``).
+    """
+    try:
+        from jaxstream.gateway import Gateway
+        from jaxstream.loadgen import (AutoscaleController,
+                                       AutoscalePolicy, generate_trace,
+                                       run_load)
+
+        levels = tuple(sorted({int(b) for b in buckets.split(",")
+                               if b.strip()}))
+        if lengths is None:
+            lengths = (seg * 2, seg * 3 + 1, seg, seg * 5 + 3)
+        out = {"n": n, "dt": dt, "n_requests": n_requests,
+               "buckets": buckets, "segment_steps": seg, "seed": seed,
+               "lengths": list(lengths),
+               "queue_capacity": queue_capacity}
+        cfg = {"grid": {"n": n, "halo": 2, "dtype": "float32"},
+               "time": {"dt": dt},
+               "model": {"name": "shallow_water_cov",
+                         "backend": backend},
+               "serve": {"buckets": buckets, "segment_steps": seg,
+                         "queue_capacity": queue_capacity}}
+        ctrl = AutoscaleController(AutoscalePolicy(
+            levels=levels, queue_high=3, queue_low=0, occ_low=0.6,
+            patience=2, cooldown=2))
+        trace = generate_trace(n_requests, seed,
+                               mean_gap_s=mean_gap_s,
+                               tail_alpha=tail_alpha, lengths=lengths)
+        out["families"] = sorted({e["ic"] for e in trace})
+        gw = Gateway(cfg, host="127.0.0.1", port=0, autoscale=ctrl)
+        try:
+            gw.start()
+            out["warm_compiles"] = gw.warm_compiles
+            summary = run_load("127.0.0.1", gw.port, trace,
+                               time_scale=1.0, max_workers=max_workers,
+                               dt=dt)
+            out["slo"] = summary
+            out["autoscale"] = ctrl.summary()
+            out["steady_recompiles"] = (gw.server.compile_count()
+                                        - gw.warm_compiles)
+            out["resizes"] = len(ctrl.events)
+        finally:
+            gw.close()
+        msps = summary["goodput_member_steps_per_sec"]
+        if packed_msps:
+            out["goodput_vs_packed"] = round(msps / packed_msps, 4)
+            out["meets_goodput_floor"] = bool(
+                msps >= goodput_floor_frac * packed_msps)
+        if p99_floor_s is not None:
+            out["p99_floor_s"] = p99_floor_s
+            out["meets_p99_floor"] = bool(
+                summary["latency_p99_s"] is not None
+                and summary["latency_p99_s"] <= p99_floor_s)
+        log(f"bench serving_slo C{n} {n_requests} reqs over HTTP "
+            f"loopback (buckets {buckets}): "
+            f"{summary['completed']} completed / {summary['shed']} "
+            f"shed / {summary['errors']} errors; p50/p99 "
+            f"{summary['latency_p50_s']}/{summary['latency_p99_s']}s; "
+            f"goodput {msps} member-steps/s; {out['resizes']} "
+            f"autoscale resize(s); {out['steady_recompiles']} steady "
+            f"recompiles")
+        if gates:
+            if not summary["accounting_exact"]:
+                raise RuntimeError(
+                    f"serving_slo: overload contract broken — "
+                    f"{summary['errors']} untyped outcomes of "
+                    f"{summary['n_requests']} (completed "
+                    f"{summary['completed']}, shed {summary['shed']})")
+            if out["resizes"] < 1:
+                raise RuntimeError(
+                    "serving_slo: the heavy-tailed burst tripped no "
+                    "autoscale resize — the closed loop is not "
+                    "exercising the policy")
+            if out["steady_recompiles"] != 0:
+                raise RuntimeError(
+                    f"serving_slo: {out['steady_recompiles']} steady-"
+                    f"state recompiles after warmup/resizes — the "
+                    "warm-bucket claim is broken")
+            if packed_msps and not out["meets_goodput_floor"]:
+                raise RuntimeError(
+                    f"serving_slo: goodput {msps} member-steps/s is "
+                    f"below {goodput_floor_frac} x the packed serving "
+                    f"rate ({packed_msps})")
+            if p99_floor_s is not None and not out["meets_p99_floor"]:
+                raise RuntimeError(
+                    f"serving_slo: p99 {summary['latency_p99_s']}s "
+                    f"breaches the {p99_floor_s}s floor")
+        return out
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench serving_slo: unavailable ({type(e).__name__}: {e})")
+        return {"skipped": f"{type(e).__name__}: {e}"}
+
+
 def bench_io(n=48, dt=600.0, nsteps=96, stride=12, warm=12, ic="tc2",
              gates=True):
     """IO-overlap section: history+telemetry cost, async vs sync vs off.
@@ -1717,6 +1853,18 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
         n=12, dt=dt, per_chip=1, seg=2, reqs_per_chip=2, mode="member",
         devices=min(6, _device_count()), backend="jnp", ic="tc2",
         lengths=(3, 5), gates=True)
+    # Serving-SLO canary (round 14): the network front door end to
+    # end on loopback at C8 — real HTTP admission + NDJSON streaming,
+    # the closed-loop load harness, live autoscale resizes between the
+    # warm {1,2} buckets, and the typed-overload accounting, all
+    # through the REAL bench_serving_slo code path.  Latencies are
+    # smoke numbers, NOT measurements; the structural floors
+    # (accounting exact, >= 1 resize, zero steady recompiles) ARE
+    # enforced and asserted by tests/test_bench_smoke.py.
+    serving_slo = bench_serving_slo(
+        n=8, dt=dt, n_requests=10, seed=714, buckets="1,2", seg=2,
+        backend="jnp", queue_capacity=16, lengths=(1, 2, 3, 5),
+        mean_gap_s=0.002, tail_alpha=1.4, max_workers=6, gates=True)
     # Precision-ladder canary: all four rows (f32 / bf16_stage /
     # mixed16_carry / stacked) through the REAL report code path in
     # interpret mode — structural coverage of the row builders, carry
@@ -1750,6 +1898,7 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
         "io": io_sec,
         "serving": serving,
         "serving_multichip": serving_mc,
+        "serving_slo": serving_slo,
         "precision_report": prec,
         "contract_check": contract,
         "wall_s": round(time.perf_counter() - t0, 1),
@@ -1928,6 +2077,17 @@ def main():
     # enforced on real accelerators; on a CPU pool the section still
     # proves parity + zero recompiles (floor reported only).
     serving_multichip = bench_serving_multichip()
+    # Serving-SLO section (round 14): the network front door under
+    # closed-loop heavy-tailed load over loopback HTTP — request
+    # latency p50/p99, goodput, typed-shed accounting, live autoscale
+    # resizes.  Floors: accounting exact, >= 1 resize, zero steady
+    # recompiles, goodput >= 0.5x the packed serving rate measured
+    # above, p99 <= 120 s at this calibrated config.
+    serving_slo = bench_serving_slo(
+        packed_msps=(serving.get("packed", {})
+                     .get("member_steps_per_sec")
+                     if isinstance(serving, dict) else None),
+        p99_floor_s=120.0)
     if isinstance(ensemble, dict) and "packed" in serving:
         msps = (ensemble.get("B16") or {}).get("member_steps_per_sec")
         if msps:
@@ -1973,6 +2133,7 @@ def main():
         serving = {"suppressed": "accuracy/stability gate breach"}
         serving_multichip = {"suppressed":
                              "accuracy/stability gate breach"}
+        serving_slo = {"suppressed": "accuracy/stability gate breach"}
     # dt is part of the metric's definition (sim-days/sec = steps/s * dt);
     # emit it top-level, with the dt=60-equivalent rate adjacent, so
     # cross-round comparisons of `value` are self-describing.
@@ -2014,6 +2175,22 @@ def main():
                     serving_multichip.get("scaling_vs_ideal"),
                 "meets_0p8_floor":
                     serving_multichip.get("meets_0p8_floor")})
+        if isinstance(serving_slo, dict) and "slo" in serving_slo:
+            slo = serving_slo["slo"]
+            sink.write({
+                "kind": "bench", "metric": "serving_slo",
+                "value": slo["goodput_member_steps_per_sec"],
+                "unit": "member-steps/sec goodput (HTTP loopback)",
+                "latency_p50_s": slo["latency_p50_s"],
+                "latency_p99_s": slo["latency_p99_s"],
+                "completed": slo["completed"], "shed": slo["shed"],
+                "resizes": serving_slo.get("resizes"),
+                "goodput_vs_packed":
+                    serving_slo.get("goodput_vs_packed"),
+                "meets_goodput_floor":
+                    serving_slo.get("meets_goodput_floor"),
+                "meets_p99_floor":
+                    serving_slo.get("meets_p99_floor")})
         sink.close()
     print(json.dumps({
         "metric": "sim_days_per_sec_per_chip_TC5_C384",
@@ -2028,6 +2205,7 @@ def main():
         "ensemble": ensemble,
         "serving": serving,
         "serving_multichip": serving_multichip,
+        "serving_slo": serving_slo,
         "io": io_section,
         "multichip": multichip,
         "contract_check": contract,

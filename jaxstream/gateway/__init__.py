@@ -1,0 +1,26 @@
+"""Network front door (round 14): asyncio HTTP/WebSocket gateway.
+
+The continuous-batching ensemble server (rounds 11-12) is a complete
+request-serving engine that nothing could reach over a network —
+ROADMAP open item 1.  This package is the front door: submit a
+:class:`jaxstream.serve.ScenarioRequest` as JSON, stream per-segment
+progress events, receive the final summary + byte-preserving output
+arrays on the same connection.  Overload behavior is a typed contract
+(429 ``queue_full``, 503 ``draining``/``admission_refused``), health/
+readiness endpoints ride the server's own :class:`HealthMonitor` and
+occupancy telemetry, and graceful drain lets in-flight members run to
+their final step while new admissions get 503.
+
+The modules split cleanly: :mod:`.protocol` is pure serialization
+(stdlib + numpy — shared by server, client, loadgen and tests),
+:mod:`.gateway` the aiohttp application + thread plumbing,
+:mod:`.client` a blocking stdlib client for worker threads.  See
+docs/USAGE.md "Network serving" and docs/DESIGN.md "Gateway".
+"""
+
+from . import protocol
+from .client import GatewayError, get_json, submit_streaming
+from .gateway import Gateway
+
+__all__ = ["Gateway", "GatewayError", "get_json", "protocol",
+           "submit_streaming"]

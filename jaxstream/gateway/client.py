@@ -1,0 +1,93 @@
+"""Blocking loopback client for the gateway — stdlib ``http.client``.
+
+The loadgen harness and the tests drive the asyncio gateway from plain
+worker threads; this module gives them a dependency-free client that
+understands the NDJSON streaming protocol (:mod:`.protocol`).  One
+call = one connection = one request's full event stream, mirroring the
+server's one-writer-per-connection invariant on the read side.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Callable, List, Optional, Tuple
+
+from . import protocol
+
+__all__ = ["GatewayError", "submit_streaming", "get_json"]
+
+
+class GatewayError(RuntimeError):
+    """A non-200 admission response.  ``status`` is the HTTP status,
+    ``error`` the typed protocol code (``queue_full``/``draining``/...),
+    so callers can tell backpressure (429) from unavailability (503)
+    without string matching."""
+
+    def __init__(self, status: int, body: dict):
+        self.status = int(status)
+        self.error = body.get("error", "internal")
+        self.body = body
+        super().__init__(
+            f"gateway returned {status} ({self.error}): "
+            f"{body.get('message', '')}")
+
+
+def submit_streaming(host: str, port: int, request: dict,
+                     timeout: float = 300.0,
+                     on_event: Optional[Callable] = None,
+                     ) -> Tuple[int, List[dict]]:
+    """POST one request; read its NDJSON stream to the final event.
+
+    Returns ``(http_status, events)`` where ``events`` is the full
+    ordered stream (``accepted`` ... ``segment``* ... ``result``).
+    ``on_event`` is called with each event as it arrives (the drain
+    test uses it to act mid-flight).  Raises :class:`GatewayError` on a
+    typed non-200 admission response.
+    """
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/requests", body=json.dumps(request),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            body = json.loads(resp.read().decode("utf-8"))
+            raise GatewayError(resp.status, body)
+        events: List[dict] = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line.decode("utf-8"))
+            events.append(ev)
+            if on_event is not None:
+                on_event(ev)
+            if ev.get("event") in ("result", "error"):
+                break
+        return resp.status, events
+    finally:
+        conn.close()
+
+
+def get_json(host: str, port: int, path: str,
+             timeout: float = 30.0) -> Tuple[int, dict]:
+    """GET one JSON endpoint (health/ready/stats)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+    finally:
+        conn.close()
+
+
+def final_result(events: List[dict]):
+    """The decoded :class:`RequestResult` of a completed stream (None
+    when the stream ended in an error event)."""
+    for ev in reversed(events):
+        if ev.get("event") == "result":
+            return protocol.decode_result(ev)
+    return None

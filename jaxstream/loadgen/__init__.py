@@ -1,0 +1,24 @@
+"""Closed-loop load harness + autoscaling (round 14).
+
+The other half of the network front door: :mod:`.trace` generates
+deterministic heavy-tailed arrival traces over the mixed Williamson/
+Galewsky scenario population, :mod:`.harness` replays them against a
+gateway over loopback HTTP and measures p50/p99 request latency,
+goodput, and the typed-shed accounting, and :mod:`.autoscale` holds
+the pure (queue depth, occupancy) -> bucket-cap policy (hysteresis,
+cannot flap) plus the controller the serving loop ticks between
+batches.  Together they earn the "heavy traffic" claim with measured
+SLOs instead of asserting it — the ``serving_slo`` bench section and
+``scripts/loadgen.py`` are the entry points.
+"""
+
+from .autoscale import (AutoscaleController, AutoscalePolicy,
+                        AutoscaleState, decide)
+from .harness import masked_records, run_load, summarize_outcomes
+from .trace import generate_trace, read_trace, write_trace
+
+__all__ = [
+    "AutoscaleController", "AutoscalePolicy", "AutoscaleState",
+    "decide", "generate_trace", "masked_records", "read_trace",
+    "run_load", "summarize_outcomes", "write_trace",
+]

@@ -53,6 +53,24 @@ RECORD_KINDS: Dict[str, tuple] = {
     # telemetry_report per-chip columns).  Guard records appended by
     # the server carry "member" and — under placement — "chip".
     "serve": ("bucket", "occupancy", "queue_depth", "wall_s"),
+    # One request's outcome at the network gateway (round 14,
+    # jaxstream.gateway): completions carry "status" ok/evicted plus
+    # "steps_run"/"nsteps"; typed admission sheds carry status
+    # "shed_queue_full"/"shed_draining"/"shed_admission" with the
+    # protocol "error" code.  telemetry_report aggregates latency
+    # percentiles and shed counts from these.
+    "gateway": ("id", "status", "latency_s"),
+    # One request's CLIENT-side outcome from the load harness (round
+    # 14, jaxstream.loadgen): written in trace order by one writer, so
+    # two runs of the same trace are byte-comparable once wall-clock
+    # fields ("latency_s"/"dispatched_at_s") are masked.  Optional:
+    # "http_status", "steps_run", "segments", "error".
+    "loadgen": ("id", "ic", "nsteps", "status", "latency_s"),
+    # One live bucket-cap resize (round 14, EnsembleServer.resize —
+    # the autoscaling policy's applied decisions; "reason" is
+    # 'autoscale'/'autoscale_attach'/'manual').
+    "autoscale": ("from_bucket", "to_bucket", "queue_depth",
+                  "occupancy", "reason"),
 }
 
 SCHEMA_VERSION = 1

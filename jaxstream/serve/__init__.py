@@ -16,10 +16,19 @@ each batch-size bucket onto the available devices — member-parallel
 panel-sharded (each request's six faces spread over the
 ``('panel', 'member')`` mesh through the batched-exchange ensemble
 stepper); :mod:`jaxstream.serve.placement` holds the pure planner.
+
+Round 14 adds the network-serving hooks: ``serve_forever`` (the
+gateway's drain loop with the per-segment autoscale tick),
+``begin_drain`` + :class:`ServerDraining` (graceful shutdown — typed
+refusals while in-flight members finish), ``resize`` (live bucket-cap
+scaling among warm executables), and ``on_segment`` progress events —
+the surface :mod:`jaxstream.gateway` and :mod:`jaxstream.loadgen`
+build on.
 """
 
 from .placement import BucketPlan, plan_placement, placement_report
-from .queue import AdmissionRefused, QueueFull, RequestQueue
+from .queue import (AdmissionRefused, QueueFull, RequestQueue,
+                    ServerDraining)
 from .request import ScenarioRequest, RequestResult
 from .server import EnsembleServer, serve_requests
 
@@ -31,6 +40,7 @@ __all__ = [
     "RequestQueue",
     "RequestResult",
     "ScenarioRequest",
+    "ServerDraining",
     "placement_report",
     "plan_placement",
     "serve_requests",

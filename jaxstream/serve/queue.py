@@ -23,7 +23,8 @@ from typing import Optional
 
 from .request import ScenarioRequest
 
-__all__ = ["AdmissionRefused", "QueueFull", "RequestQueue"]
+__all__ = ["AdmissionRefused", "QueueFull", "RequestQueue",
+           "ServerDraining"]
 
 
 class QueueFull(RuntimeError):
@@ -33,6 +34,14 @@ class QueueFull(RuntimeError):
 class AdmissionRefused(RuntimeError):
     """The server refused the request (health-driven admission
     control: too many guard events — see ``serve.max_guard_events``)."""
+
+
+class ServerDraining(AdmissionRefused):
+    """submit() on a server that began its graceful drain (round 14):
+    admissions are closed while in-flight members run to their final
+    step.  Subclasses :class:`AdmissionRefused` so existing callers
+    treating any refusal uniformly keep working; the gateway maps it
+    to a typed 503 ``draining``."""
 
 
 class RequestQueue:
@@ -99,6 +108,21 @@ class RequestQueue:
     def pop_group(self, group: str) -> Optional[ScenarioRequest]:
         """``pop(group=group)`` — kept as the round-11 spelling."""
         return self.pop(group)
+
+    def remove(self, req: ScenarioRequest) -> bool:
+        """Remove one request by identity; False when it is no longer
+        queued (already popped for serving).  The submit/drain race
+        unwind (round 14): a submitter that enqueued concurrently with
+        ``begin_drain`` takes its request back out — either the removal
+        succeeds and the caller refuses the submission, or the serving
+        loop already owns it and will run it to completion."""
+        with self._not_full:
+            for i, r in enumerate(self._q):
+                if r is req:
+                    del self._q[i]
+                    self._not_full.notify()
+                    return True
+            return False
 
     def requeue(self, reqs) -> None:
         """Push popped-but-unserved requests back to the FRONT, in

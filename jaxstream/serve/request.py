@@ -54,6 +54,23 @@ class ScenarioRequest:
     submitted_wall: Optional[float] = None
 
     def __post_init__(self):
+        # Numeric fields are validated HERE, not where they are first
+        # used: a wrong-typed seed/amplitude that passed admission
+        # would otherwise raise mid-batch on the serving thread and
+        # kill the whole deployment for one bad request (round 14 —
+        # the gateway maps this ValueError to a typed 400).
+        for fname in ("nsteps", "seed"):
+            v = getattr(self, fname)
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise ValueError(
+                    f"request {self.id!r}: {fname} must be an int, "
+                    f"got {type(v).__name__}")
+        if (isinstance(self.amplitude, bool)
+                or not isinstance(self.amplitude, (int, float))):
+            raise ValueError(
+                f"request {self.id!r}: amplitude must be a number, "
+                f"got {type(self.amplitude).__name__}")
+        self.amplitude = float(self.amplitude)
         if self.ic not in SWE_FAMILIES:
             raise ValueError(
                 f"request {self.id!r}: unknown ic {self.ic!r}; valid: "

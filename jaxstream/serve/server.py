@@ -83,7 +83,8 @@ from ..physics import initial_conditions as ics
 from ..stepping import SCHEMES, integrate_masked, vmap_ensemble
 from ..utils.logging import get_logger
 from .placement import PLACEMENT_MODES, BucketPlan, plan_placement
-from .queue import AdmissionRefused, QueueFull, RequestQueue
+from .queue import (AdmissionRefused, QueueFull, RequestQueue,
+                    ServerDraining)
 from .request import RequestResult, ScenarioRequest
 
 __all__ = ["EnsembleServer", "serve_requests"]
@@ -185,12 +186,19 @@ class EnsembleServer:
     ``config`` is the standard :class:`jaxstream.config.Config` surface
     (grid/time/physics/model + the ``serve:`` block); ``on_result`` is
     called with each :class:`RequestResult` from the background writer
-    thread (after its fields are on host).  Use as a context manager,
-    or call :meth:`close` when done.
+    thread (after its fields are on host).  ``on_segment`` (round 14,
+    the gateway's streaming hook) is called from the SERVING thread at
+    every segment boundary with a list of per-slot progress dicts
+    (``id``/``steps_done``/``nsteps``/``t``/``bucket``/``done`` — no
+    wall-clock fields), strictly before any of that boundary's
+    finalizations are queued, so a subscriber can never observe a
+    request's result before its last segment event.  Use as a context
+    manager, or call :meth:`close` when done.
     """
 
     def __init__(self, config=None,
-                 on_result: Optional[Callable] = None):
+                 on_result: Optional[Callable] = None,
+                 on_segment: Optional[Callable] = None):
         self.config: Config = load_config(config)
         cfg = self.config
         s = cfg.serve
@@ -302,14 +310,21 @@ class EnsembleServer:
             (), policy="warn" if s.guards == "evict" else "halt")
             if s.guards != "off" else None)
         self.on_result = on_result
+        self.on_segment = on_segment
         self.results: Dict[str, RequestResult] = {}
         self.stats = {
             "submitted": 0, "refused": 0, "completed": 0, "evicted": 0,
             "batches": 0, "segments": 0, "refills": 0,
             "member_steps": 0, "occupancy_sum": 0.0,
             "utilization_sum": 0.0, "warmup_compiles": 0,
-            "host_wait_s": 0.0,
+            "host_wait_s": 0.0, "resizes": 0, "last_occupancy": 0.0,
         }
+        #: Live-resize state (round 14): the ACTIVE bucket cap.  The
+        #: full configured bucket set stays warm; packing only uses
+        #: buckets <= the cap, so autoscaling swaps among compiled
+        #: executables and can never trigger a recompile.
+        self._active_max = max(self.buckets)
+        self._draining = False
         self._models: Dict[str, object] = {}
         self._ics: Dict[str, tuple] = {}
         self._b_zero = None
@@ -350,6 +365,65 @@ class EnsembleServer:
 
     def __exit__(self, *exc):
         self.close()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Graceful-drain entry (round 14): close admissions NOW —
+        every later :meth:`submit` raises :class:`ServerDraining` —
+        while already-admitted requests keep serving to their own
+        final step (:meth:`serve_forever` exits once the queue is
+        empty).  Nothing is re-queued or dropped."""
+        self._draining = True
+
+    # ------------------------------------------------------- live resize
+    @property
+    def active_buckets(self) -> tuple:
+        """The bucket sizes packing may currently use (resize scales
+        the cap; the smallest bucket always stays available)."""
+        active = tuple(b for b in self.buckets if b <= self._active_max)
+        return active or (min(self.buckets),)
+
+    def resize(self, max_bucket: int, reason: str = "",
+               queue_depth: Optional[int] = None,
+               occupancy: Optional[float] = None) -> int:
+        """Live-resize the active bucket cap (round 14 autoscaling).
+
+        ``max_bucket`` must be a CONFIGURED bucket — every legal cap
+        maps to a warm executable, so a resize never compiles (the
+        zero-steady-state-recompiles-after-resize criterion is by
+        construction).  Takes effect at the next batch.  Under
+        ``serve.placement`` this is also the placement lever: each
+        bucket's plan spans a fixed device count, so raising the cap
+        engages more chips.  Returns the previous cap; records an
+        ``autoscale`` event in the serve sink.  Thread-safe in the
+        only way that matters: the cap is a single attribute read once
+        per batch by the serving thread.
+        """
+        if max_bucket not in self.buckets:
+            raise ValueError(
+                f"resize target {max_bucket} is not a configured "
+                f"bucket {list(self.buckets)} — resizes must land on "
+                "warm executables (add the size to serve.buckets)")
+        old, self._active_max = self._active_max, int(max_bucket)
+        if old != max_bucket:
+            self.stats["resizes"] += 1
+            log.info("serve: resized active bucket cap %d -> %d%s",
+                     old, max_bucket, f" ({reason})" if reason else "")
+        if self._sink is not None:
+            self._sink.write({
+                "kind": "autoscale", "from_bucket": old,
+                "to_bucket": int(max_bucket),
+                "queue_depth": (len(self.queue) if queue_depth is None
+                                else int(queue_depth)),
+                "occupancy": round(
+                    self.stats["last_occupancy"] if occupancy is None
+                    else float(occupancy), 4),
+                "reason": reason or "manual",
+            })
+        return old
 
     # ------------------------------------------------------------- building
     def _group(self, req: ScenarioRequest) -> str:
@@ -668,24 +742,60 @@ class EnsembleServer:
         }
 
     # ------------------------------------------------------------ admission
+    def refusal_reasons(self) -> List[str]:
+        """Why a :meth:`submit` would be refused right now ([] =
+        admissible).  The ONE definition both admission and readiness
+        probes consume (the gateway's ``/v1/ready``), so a new refusal
+        condition can never update one without the other.  Note
+        ``queue_full`` is advisory for blocking submits — ``submit(
+        block=True)`` waits a full queue out instead of refusing."""
+        reasons = []
+        if self._draining:
+            reasons.append("draining")
+        mx = self.config.serve.max_guard_events
+        if (mx > 0 and self.monitor is not None
+                and len(self.monitor.events) >= mx):
+            reasons.append("admission_refused")
+        if len(self.queue) >= self.queue.capacity:
+            reasons.append("queue_full")
+        return reasons
+
     def submit(self, req: ScenarioRequest, block: bool = False,
                timeout: Optional[float] = None) -> None:
         """Admit one request (raises :class:`QueueFull` at capacity,
         :class:`AdmissionRefused` when the health monitor has recorded
-        ``serve.max_guard_events`` guard trips)."""
+        ``serve.max_guard_events`` guard trips, :class:`ServerDraining`
+        after :meth:`begin_drain`)."""
         if self._closed:
             raise RuntimeError("EnsembleServer is closed")
-        mx = self.config.serve.max_guard_events
-        if (mx > 0 and self.monitor is not None
-                and len(self.monitor.events) >= mx):
+        reasons = self.refusal_reasons()
+        if "draining" in reasons:
+            self.stats["refused"] += 1
+            raise ServerDraining(
+                f"server refused {req.id!r}: draining — admissions are "
+                "closed while in-flight requests run to completion")
+        if "admission_refused" in reasons:
             self.stats["refused"] += 1
             raise AdmissionRefused(
                 f"server refused {req.id!r}: {len(self.monitor.events)} "
-                f"guard events >= serve.max_guard_events={mx} — the "
+                f"guard events >= serve.max_guard_events="
+                f"{self.config.serve.max_guard_events} — the "
                 "deployment is unhealthy; investigate before admitting "
                 "more traffic")
+        # queue_full is the queue's own call: a blocking submit waits
+        # it out, a non-blocking one gets QueueFull from queue.submit.
         req.submitted_wall = time.perf_counter()
         self.queue.submit(req, block=block, timeout=timeout)
+        if self._draining and self.queue.remove(req):
+            # begin_drain raced the enqueue: serve_forever may already
+            # have observed (empty queue, draining) and exited, which
+            # would strand this request admitted-but-never-served.
+            # Either we take it back out here and refuse it, or the
+            # serving loop already popped it and will finish it.
+            self.stats["refused"] += 1
+            raise ServerDraining(
+                f"server refused {req.id!r}: draining began during "
+                "admission — the request was withdrawn, not stranded")
         self.stats["submitted"] += 1
 
     # -------------------------------------------------------------- serving
@@ -703,25 +813,105 @@ class EnsembleServer:
                 self._writer.flush()
         return self.results
 
+    def serve_forever(self, stop=None, idle_wait: float = 0.01,
+                      tick: Optional[Callable] = None,
+                      idle_tick_s: float = 0.25):
+        """Network-serving loop (round 14): drain batches until ``stop``
+        (a ``threading.Event``) is set, parking ``idle_wait`` seconds
+        between empty polls.  After :meth:`begin_drain`, exits once the
+        queue is empty and every admitted request reached its final
+        state (the writer is flushed on the way out, so results are
+        delivered when this returns).
+
+        ``tick``, when given, is called as ``tick(self)`` at every
+        SEGMENT boundary — the autoscale hook: it observes queue depth
+        + last-segment occupancy and may call :meth:`resize`.  Running
+        it on the serving thread makes scaling decisions deterministic
+        given queue state (no racing sampler thread); when a resize
+        changes the active cap away from the running batch's bucket,
+        that batch stops REFILLING — its in-flight members run to
+        their own final step on the warm old-bucket executable — and
+        packing resumes at the new cap with the very next batch (the
+        live-resize migration path; no member is ever interrupted or
+        re-queued).  While IDLE the hook runs at most once per
+        ``idle_tick_s`` seconds, not once per poll: the policy's
+        patience/cooldown counts are observations, and idle polls at
+        ``idle_wait`` cadence would turn a few milliseconds of
+        inter-burst silence into a full scale-down — exactly the flap
+        the hysteresis exists to prevent.
+        """
+        last_idle_tick = float("-inf")
+        try:
+            while stop is None or not stop.is_set():
+                req = self.queue.pop()
+                if req is None and self._draining:
+                    # A submit may have enqueued between the pop above
+                    # and this flag read (its post-enqueue unwind then
+                    # saw draining=False and kept the request): only
+                    # exit the drain when the queue is confirmed empty
+                    # AFTER the draining flag was observed.
+                    req = self.queue.pop()
+                    if req is None:
+                        break
+                if req is None:
+                    # An idle server occupies zero slots; without this
+                    # a final full segment would pin last_occupancy at
+                    # 1.0 and block scale-down forever.
+                    self.stats["last_occupancy"] = 0.0
+                    now = time.monotonic()
+                    if now - last_idle_tick >= idle_tick_s:
+                        last_idle_tick = now
+                        self._tick(tick)
+                    time.sleep(idle_wait)
+                    continue
+                self._run_batch(req, tick=tick)
+                last_idle_tick = float("-inf")
+                if self._writer is not None:
+                    self._writer.flush()
+        finally:
+            if self._writer is not None:
+                self._writer.flush()
+        return self.results
+
     def _ensure_writer(self) -> BackgroundWriter:
         if self._writer is None or not self._writer.alive:
             self._writer = BackgroundWriter(
                 max_pending=8, name=SERVE_WRITER_THREAD_NAME)
         return self._writer
 
-    def _run_batch(self, first: ScenarioRequest):
+    def _tick(self, tick) -> None:
+        """Run the autoscale hook; a policy bug must not kill serving."""
+        if tick is None:
+            return
+        try:
+            tick(self)
+        except Exception as e:
+            log.warning("serve: autoscale tick failed (%s: %s)",
+                        type(e).__name__, e)
+
+    def _run_batch(self, first: ScenarioRequest, tick=None):
         """One batch's life: pack up to the best bucket, then segment /
-        evict / extract / refill until every slot drains."""
+        evict / extract / refill until every slot drains.  With a
+        ``tick`` hook, a live resize away from this batch's bucket
+        stops the refill so the batch winds down and serve_forever
+        re-packs at the new cap."""
         cfg = self.config
         s, dt = cfg.serve, cfg.time.dt
         group = self._group(first)
+        # The resize cap is read ONCE per batch (cap0): the packing
+        # decision and the later wind-down comparison both derive from
+        # the same read, so a resize from another thread between them
+        # cannot be silently ignored.
+        cap0 = self._active_max
+        active = (tuple(b for b in self.buckets if b <= cap0)
+                  or (min(self.buckets),))
         batch: List[ScenarioRequest] = [first]
-        while len(batch) < max(self.buckets):
+        while len(batch) < max(active):
             r = self._pop(group)
             if r is None:
                 break
             batch.append(r)
-        B = next(b for b in self.buckets if b >= len(batch))
+        B = next(b for b in active if b >= len(batch))
         bk = self._bucket(group, B)
         plan = bk.plan
         self.stats["batches"] += 1
@@ -737,6 +927,12 @@ class EnsembleServer:
         per_shard = B // m_shards
         chips = ([i // per_shard for i in range(B)]
                  if m_shards > 1 else None)
+        # Live-resize wind-down (round 14): when the tick hook resizes
+        # the cap away from this batch's packing decision (cap0,
+        # above), the batch stops refilling — in-flight members finish
+        # on the warm executable, then serve_forever re-packs at the
+        # new cap.
+        allow_refill = True
 
         while any(sl is not None for sl in slots):
             w0 = time.perf_counter()
@@ -754,7 +950,7 @@ class EnsembleServer:
                 1 for i, sl in enumerate(slots)
                 if sl is not None and new_rem[i] == 0)
             prepped: List[tuple] = []
-            for _ in range(n_free_pred):
+            for _ in range(n_free_pred if allow_refill else 0):
                 r = self._pop(group)
                 if r is None:
                     break
@@ -770,6 +966,23 @@ class EnsembleServer:
             for i, sl in enumerate(slots):
                 if sl is not None:
                     sl.done = sl.req.nsteps - int(rem[i])
+            # Per-segment progress stream (round 14, the gateway's
+            # hook): one event per slot active during this segment,
+            # emitted BEFORE any finalization from this boundary is
+            # queued — no wall-clock fields, so the stream is
+            # deterministic for a given packing.
+            if self.on_segment is not None:
+                progress = [
+                    {"id": sl.req.id, "steps_done": sl.done,
+                     "nsteps": sl.req.nsteps, "t": sl.done * dt,
+                     "bucket": B, "done": bool(rem[i] == 0)}
+                    for i, sl in enumerate(slots) if sl is not None]
+                try:
+                    self.on_segment(progress)
+                except Exception as e:   # a subscriber bug must not
+                    log.warning(         # kill the batch
+                        "serve: on_segment hook failed (%s: %s)",
+                        type(e).__name__, e)
             # Testing hook: host-side injection into the health STREAM
             # (never the state), mirroring observability.fault_step.
             fi = s.fault_member
@@ -814,21 +1027,22 @@ class EnsembleServer:
                     slots[i] = None
                     completed += 1
             refilled = 0
-            for i in range(B):
-                if slots[i] is not None:
-                    continue
-                if prepped:
-                    r, tree = prepped.pop(0)
-                else:
-                    r = self._pop(group)
-                    if r is None:
-                        break
-                    tree = self._member_tree(r)
-                carry = bk.inject(carry, jnp.int32(i),
-                                  bk.put_member(tree))
-                rem[i] = r.nsteps
-                slots[i] = _Slot(r)
-                refilled += 1
+            if allow_refill:
+                for i in range(B):
+                    if slots[i] is not None:
+                        continue
+                    if prepped:
+                        r, tree = prepped.pop(0)
+                    else:
+                        r = self._pop(group)
+                        if r is None:
+                            break
+                        tree = self._member_tree(r)
+                    carry = bk.inject(carry, jnp.int32(i),
+                                      bk.put_member(tree))
+                    rem[i] = r.nsteps
+                    slots[i] = _Slot(r)
+                    refilled += 1
             # Prepped requests can never be left over: free slots >=
             # predicted completions (eviction only adds frees) and the
             # refill loop scans every slot, consuming prepped first.
@@ -839,6 +1053,7 @@ class EnsembleServer:
                 f"requests left unslotted: {[r.id for r, _ in prepped]}")
             st = self.stats
             st["segments"] += 1
+            st["last_occupancy"] = active_before / B
             st["refills"] += refilled
             st["member_steps"] += member_steps
             st["occupancy_sum"] += active_before / B
@@ -872,6 +1087,15 @@ class EnsembleServer:
                             / (per_shard * seg), 4)
                         for j in range(m_shards)]
                 self._sink.write(rec)
+            # Autoscale hook, once per segment boundary — queue depth
+            # and last_occupancy are fresh here.  A resize ends this
+            # batch's refill (see cap0 note above).
+            self._tick(tick)
+            if allow_refill and self._active_max != cap0:
+                allow_refill = False
+                log.info("serve: active cap resized %d -> %d mid-"
+                         "batch; batch (B=%d) winds down without "
+                         "refilling", cap0, self._active_max, B)
 
     def _finish(self, slot: _Slot, status: str,
                 fetch: Optional[HostFetch], event: Optional[dict] = None):

@@ -72,6 +72,17 @@ every gate run self-checking):
    in-process virtual devices; a slow-marked or subprocess rewrite
    would silently drop the proof from the gate that cites it.
 
+9. **Gateway/loadgen tests stay non-slow and bind loopback only**
+   (round-14 network-front-door satellite): a module importing
+   ``jaxstream.gateway`` or ``jaxstream.loadgen`` must carry NO
+   ``slow`` markers — the typed-overload contract, the loopback byte
+   parity, graceful drain, trace determinism and the autoscale
+   hysteresis proofs are the acceptance criteria the fast gate
+   certifies between offline runs — and must never reference a
+   wildcard bind address (``0.0.0.0``): gateway tests run REAL
+   listening sockets, and anything but 127.0.0.1 leaks an open port
+   to the network from every CI run.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -118,6 +129,14 @@ _ANALYSIS_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.analysis\b|import\s+jaxstream\.analysis\b"
     r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*analysis\b)",
     re.MULTILINE)
+_NETWORK_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.(gateway|loadgen)\b"
+    r"|import\s+jaxstream\.(gateway|loadgen)\b"
+    r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*(gateway|loadgen)\b)",
+    re.MULTILINE)
+#: Anchored so real addresses merely CONTAINING the substring
+#: (10.0.0.0/8, 240.0.0.0) do not trip the lint.
+_WILDCARD_BIND_RE = re.compile(r"(?<![\d.])0\.0\.0\.0(?![\d.])")
 
 
 def registered_markers(pytest_ini: str) -> set:
@@ -185,6 +204,21 @@ def lint_file(path: str, allowed: set):
                f"device worker would be forced slow by rule 2, "
                f"silently dropping member-parallel/panel-sharded "
                f"coverage from the fast gate)")
+    if _NETWORK_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports jaxstream.gateway/loadgen but "
+                   f"marks tests slow — the network front door's "
+                   f"acceptance criteria (typed 429/503 overload, "
+                   f"loopback byte parity, graceful drain, trace "
+                   f"determinism, autoscale hysteresis) must run in "
+                   f"every fast gate; move the slow test to a module "
+                   f"that does not import jaxstream.gateway/loadgen")
+        if _WILDCARD_BIND_RE.search(src):
+            yield (f"{rel}: imports jaxstream.gateway/loadgen and "
+                   f"references the wildcard bind address 0.0.0.0 — "
+                   f"gateway tests open REAL listening sockets and "
+                   f"must bind loopback (127.0.0.1) only, or every CI "
+                   f"run exposes an open port to the network")
     if _ANALYSIS_IMPORT_RE.search(src):
         if "slow" in used:
             yield (f"{rel}: imports jaxstream.analysis but marks tests "

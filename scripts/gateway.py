@@ -1,0 +1,142 @@
+"""Network serving CLI: the asyncio gateway over EnsembleServer.
+
+Usage::
+
+    python scripts/gateway.py config.yaml [--host 127.0.0.1]
+        [--port 8080] [--sink gateway.jsonl]
+        [--autoscale-levels 1,4,16] [--queue-high 4] [--queue-low 0]
+        [--occ-low 0.5] [--patience 2] [--cooldown 2]
+        [--run-seconds 0]
+
+``config.yaml`` is the standard config surface (grid/time/physics/
+model + the ``serve:`` block).  The process serves until SIGTERM or
+SIGINT (or for ``--run-seconds``, for tests/demos), then drains
+gracefully — admissions get 503 ``draining``, in-flight members run to
+their final step, sinks flush — and prints exactly ONE JSON summary
+line on stdout (everything else goes to stderr).
+
+``--autoscale-levels`` enables live autoscaling: the levels must be a
+subset of ``serve.buckets`` (every level maps to a warm executable, so
+a resize never compiles); the policy watches queue depth + occupancy
+at segment boundaries (jaxstream.loadgen.autoscale).
+
+Endpoints: ``POST /v1/requests`` (NDJSON event stream), ``GET /v1/ws``
+(the same protocol over WebSocket), ``/v1/health``, ``/v1/ready``,
+``/v1/stats`` — schema in docs/USAGE.md "Network serving".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_autoscale(args):
+    if not args.autoscale_levels:
+        return None
+    from jaxstream.loadgen.autoscale import (AutoscaleController,
+                                             AutoscalePolicy)
+
+    levels = tuple(int(b) for b in args.autoscale_levels.split(",")
+                   if b.strip())
+    return AutoscaleController(AutoscalePolicy(
+        levels=levels, queue_high=args.queue_high,
+        queue_low=args.queue_low, occ_low=args.occ_low,
+        patience=args.patience, cooldown=args.cooldown))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Serve scenario requests over HTTP/WebSocket "
+                    "through the continuous-batching ensemble server.")
+    ap.add_argument("config", help="server config YAML")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default loopback)")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="bind port (0 = ephemeral, printed to stderr)")
+    ap.add_argument("--sink", default="",
+                    help="gateway telemetry JSONL (per-request "
+                         "'gateway' records)")
+    ap.add_argument("--autoscale-levels", default="",
+                    help="comma-separated bucket-cap ladder (subset of "
+                         "serve.buckets); empty = autoscaling off")
+    ap.add_argument("--queue-high", type=int, default=4)
+    ap.add_argument("--queue-low", type=int, default=0)
+    ap.add_argument("--occ-low", type=float, default=0.5)
+    ap.add_argument("--patience", type=int, default=2)
+    ap.add_argument("--cooldown", type=int, default=2)
+    ap.add_argument("--run-seconds", type=float, default=0.0,
+                    help="serve for N seconds then drain (0 = until "
+                         "SIGTERM/SIGINT)")
+    args = ap.parse_args(argv)
+
+    from jaxstream.gateway import Gateway
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log(f"gateway: received signal {signum}; draining")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    gw = Gateway(args.config, host=args.host, port=args.port,
+                 autoscale=build_autoscale(args), sink=args.sink)
+    gw.start()
+    log(f"gateway: serving on {gw.url} "
+        f"(buckets {list(gw.server.buckets)}, warm "
+        f"{gw.warm_compiles} executables)")
+    t0 = time.perf_counter()
+    try:
+        while not stop.is_set():
+            if (args.run_seconds > 0
+                    and time.perf_counter() - t0 >= args.run_seconds):
+                log(f"gateway: --run-seconds {args.run_seconds} "
+                    "elapsed; draining")
+                break
+            stop.wait(0.2)
+    finally:
+        snap = None
+        try:
+            gw.close()                     # graceful drain inside
+            snap = gw.snapshot()
+        except Exception as e:
+            log(f"gateway: close failed ({type(e).__name__}: {e})")
+        summary = {
+            "metric": "gateway_summary",
+            "url": gw.url,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+        if snap is not None:
+            summary.update({
+                "gateway": snap["gateway"],
+                "server": {k: snap["server"][k] for k in
+                           ("submitted", "completed", "evicted",
+                            "segments", "refills", "member_steps",
+                            "resizes") if k in snap["server"]},
+                "occupancy_mean": snap["occupancy_mean"],
+                "warm_compiles": snap["warm_compiles"],
+                "steady_recompiles": (snap["compile_count"]
+                                      - snap["warm_compiles"]),
+            })
+            if "autoscale" in snap:
+                summary["autoscale"] = snap["autoscale"]
+        print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

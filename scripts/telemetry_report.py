@@ -44,12 +44,51 @@ def load(path):
     return records
 
 
+def _percentile(sorted_vals, q):
+    """Linear-interpolation percentile over a SORTED list (stdlib-only
+    stand-in for numpy.percentile; this tool must run without numpy)."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (len(sorted_vals) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def _request_outcomes(recs):
+    """Shared aggregation of per-request records ('gateway'/'loadgen'
+    kinds): completion/shed accounting + latency percentiles over the
+    completed requests."""
+    lat = sorted(r["latency_s"] for r in recs if r["status"] == "ok")
+    shed_by = {}
+    for r in recs:
+        if r["status"].startswith("shed_"):
+            shed_by[r["status"]] = shed_by.get(r["status"], 0) + 1
+    return {
+        "n_requests": len(recs),
+        "completed": sum(1 for r in recs if r["status"] == "ok"),
+        "evicted": sum(1 for r in recs if r["status"] == "evicted"),
+        "shed": sum(shed_by.values()),
+        "shed_by": shed_by,
+        "errors": sum(1 for r in recs if r["status"] == "error"),
+        "latency_p50_s": _percentile(lat, 50),
+        "latency_p99_s": _percentile(lat, 99),
+        "latency_max_s": lat[-1] if lat else None,
+    }
+
+
 def summarize(records):
     manifest = next((r for r in records if r.get("kind") == "manifest"), {})
     segments = [r for r in records if r.get("kind") == "segment"]
     guards = [r for r in records if r.get("kind") == "guard"]
     benches = [r for r in records if r.get("kind") == "bench"]
     serves = [r for r in records if r.get("kind") == "serve"]
+    gateways = [r for r in records if r.get("kind") == "gateway"]
+    loadgens = [r for r in records if r.get("kind") == "loadgen"]
+    autoscales = [r for r in records if r.get("kind") == "autoscale"]
 
     drift = {}
     if segments:
@@ -127,9 +166,26 @@ def summarize(records):
                  "refilled": s.get("refilled", 0)}
                 for s in serves],
         }
+    # Network front-door columns (round 14): per-request outcomes seen
+    # by the gateway ('gateway' records) and by the load harness's
+    # clients ('loadgen' records), plus the applied autoscale resizes.
+    gateway = _request_outcomes(gateways) if gateways else None
+    loadgen = _request_outcomes(loadgens) if loadgens else None
+    autoscale = None
+    if autoscales:
+        autoscale = {
+            "resizes": len(autoscales),
+            "events": [{"from_bucket": a["from_bucket"],
+                        "to_bucket": a["to_bucket"],
+                        "queue_depth": a["queue_depth"],
+                        "occupancy": a["occupancy"],
+                        "reason": a["reason"]} for a in autoscales],
+        }
     return {"manifest": manifest, "drift": drift, "timeline": timeline,
             "host_wait_total_s": host_wait_total,
             "guards": guards, "bench": benches, "serving": serving,
+            "gateway": gateway, "loadgen": loadgen,
+            "autoscale": autoscale,
             "n_segments": len(segments)}
 
 
@@ -204,6 +260,29 @@ def print_report(s):
                                   for v in sv["chip_utilization_mean"])
                 line += f" utilization [{util_c}]"
             print(line)
+
+    for name in ("gateway", "loadgen"):
+        sec = s.get(name)
+        if not sec:
+            continue
+        p50, p99 = sec["latency_p50_s"], sec["latency_p99_s"]
+        print(f"\n{name} requests:")
+        print(f"  {sec['n_requests']} requests: {sec['completed']} "
+              f"completed / {sec['evicted']} evicted / {sec['shed']} "
+              f"shed / {sec['errors']} errors")
+        if p50 is not None:
+            print(f"  latency p50 {p50:.4f}s  p99 {p99:.4f}s  "
+                  f"max {sec['latency_max_s']:.4f}s")
+        for kind, count in sorted(sec["shed_by"].items()):
+            print(f"  shed {kind.replace('shed_', '')}: {count}")
+
+    if s.get("autoscale"):
+        az = s["autoscale"]
+        print(f"\nautoscale events ({az['resizes']}):")
+        for ev in az["events"]:
+            print(f"  bucket {ev['from_bucket']} -> {ev['to_bucket']} "
+                  f"(queue {ev['queue_depth']}, occupancy "
+                  f"{ev['occupancy']:.3f}, {ev['reason']})")
 
     if s["guards"]:
         print("\nguard events:")

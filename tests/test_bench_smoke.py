@@ -113,6 +113,34 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert mc["multichip"]["placement"]["mode"] == "member"
     assert isinstance(mc["scaling_vs_ideal"], float)
 
+    # The serving-SLO canary (round 14) drove the network front door
+    # end to end: 10 mixed-IC requests over REAL loopback HTTP through
+    # the asyncio gateway under a heavy-tailed burst, with the
+    # closed-loop harness measuring latency/goodput and the autoscale
+    # policy resizing the active bucket cap live.  The structural
+    # floors are enforced inside bench_serving_slo (gates=True):
+    # accounting exactness (completed + typed-shed == submitted, zero
+    # untyped errors), >= 1 live resize, and zero steady-state
+    # recompiles after warmup INCLUDING the resizes — a breach
+    # surfaces as "skipped" and fails here.  Latencies are smoke
+    # numbers; only structure is asserted.
+    slo = rec["serving_slo"]
+    assert "skipped" not in slo, slo
+    s = slo["slo"]
+    assert s["n_requests"] == 10
+    assert s["accounting_exact"] is True
+    assert s["completed"] + s["shed"] == 10 and s["errors"] == 0
+    assert s["goodput_member_steps_per_sec"] > 0.0
+    assert 0.0 < s["latency_p50_s"] <= s["latency_p99_s"]
+    assert slo["resizes"] >= 1
+    assert slo["steady_recompiles"] == 0
+    assert slo["warm_compiles"] > 0
+    az = slo["autoscale"]
+    assert az["levels"] == [1, 2]
+    assert az["events"][0]["to_bucket"] == 2
+    # The trace mixed IC families (seeded — deterministic).
+    assert len(slo["families"]) >= 2
+
     # The precision ladder (round 10) ran all four rows through the
     # real --precision-report code path: reduced-precision stage
     # kernels, carry encoders, and the precision-corrected roofline

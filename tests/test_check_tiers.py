@@ -214,6 +214,46 @@ def test_analysis_module_rules_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 1
 
 
+def test_gateway_module_rules_detected(tmp_path):
+    """Rule 9 (round-14 satellite): gateway/loadgen tests stay
+    non-slow and bind loopback only — a module importing
+    jaxstream.gateway or jaxstream.loadgen may neither carry slow
+    markers nor reference the wildcard bind address."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Slow-marked gateway module trips the lint.
+    (tests / "test_g.py").write_text(
+        "import pytest\n"
+        "from jaxstream.gateway import Gateway\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # A wildcard bind trips it too (concatenated so THIS module does
+    # not itself contain the literal).
+    (tests / "test_g.py").write_text(
+        "from jaxstream.loadgen import run_load\n"
+        "def test_a():\n"
+        "    run_load('0.0." + "0.0', 80, [])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Loopback-bound, unmarked gateway+loadgen module is clean.
+    (tests / "test_g.py").write_text(
+        "from jaxstream.gateway import Gateway\n"
+        "from jaxstream import loadgen\n"
+        "def test_a():\n"
+        "    Gateway(host='127.0.0.1')\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # Real addresses merely CONTAINING the substring stay clean
+    # (anchored regex): 10.0.0.0/8 is not a wildcard bind.
+    (tests / "test_g.py").write_text(
+        "from jaxstream.gateway import Gateway\n"
+        "PRIVATE_RANGE = '10.0." + "0.0/8'\n"
+        "def test_a():\n"
+        "    Gateway(host='127.0.0.1')\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_precision_module_with_slow_marker_detected(tmp_path):
     """Rule 5 (round-10 satellite): precision-parity tests stay tier-1
     — a module importing jaxstream.ops.pallas.precision must carry no

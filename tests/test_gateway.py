@@ -1,0 +1,397 @@
+"""Network gateway acceptance (jaxstream.gateway, round 14).
+
+All tier-1 (check_tiers rule 9: gateway tests stay non-slow and bind
+LOOPBACK only — the fast gate certifies the front door between
+offline runs, and a test binding a routable interface would leak a
+listening port into CI):
+
+  * loopback byte parity: a request submitted over HTTP produces
+    byte-identical streamed segment events and final summary/fields to
+    the same ScenarioRequest submitted directly to EnsembleServer —
+    the gateway may serialize but never perturb;
+  * the WebSocket endpoint speaks the identical event stream;
+  * overload is a typed contract: QueueFull -> 429, health-refused and
+    draining -> 503, malformed bodies -> 400, duplicate in-flight ids
+    -> 409;
+  * graceful drain: admissions stop instantly (503), in-flight members
+    run to their own final step, sinks flush, nothing is re-queued;
+  * health/readiness/stats endpoints ride the server's monitor,
+    queue and occupancy telemetry;
+  * per-request 'gateway' sink records are schema-valid and aggregated
+    by scripts/telemetry_report.py.
+
+Configs are tiny (C8, jnp backend) like tests/test_serve.py.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from jaxstream.gateway import (Gateway, GatewayError, get_json, protocol,
+                               submit_streaming)
+from jaxstream.gateway.client import final_result
+from jaxstream.serve import EnsembleServer, ScenarioRequest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+N, DT = 8, 600.0
+HOST = "127.0.0.1"
+
+
+def _cfg(**over):
+    cfg = {
+        "grid": {"n": N},
+        "time": {"dt": DT},
+        "model": {"name": "shallow_water_cov", "backend": "jnp"},
+        "parallelization": {"num_devices": 1},
+        "serve": {"buckets": "1,2", "segment_steps": 2,
+                  "queue_capacity": 16},
+    }
+    for k, v in over.items():
+        cfg.setdefault(k, {}).update(v)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def gw(tmp_path_factory):
+    """One warm module gateway: buckets {1, 2}, loopback, ephemeral
+    port, gateway sink enabled."""
+    d = tmp_path_factory.mktemp("gateway")
+    g = Gateway(_cfg(), host=HOST, port=0,
+                sink=str(d / "gateway.jsonl"))
+    g.start()
+    g.sink_path = str(d / "gateway.jsonl")
+    yield g
+    g.close()
+
+
+# ------------------------------------------------------------- protocol
+def test_protocol_array_codec_roundtrip():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4) * np.pi
+    d = protocol.encode_array(a)
+    b = protocol.decode_array(d)
+    assert b.tobytes() == a.tobytes() and b.shape == a.shape
+    assert b.dtype == a.dtype
+
+
+def test_protocol_request_validation():
+    with pytest.raises(ValueError, match="id"):
+        protocol.request_from_json({"ic": "tc2", "nsteps": 1})
+    with pytest.raises(ValueError, match="submitted_wall"):
+        protocol.request_from_json({"id": "x", "ic": "tc2",
+                                    "nsteps": 1, "submitted_wall": 1.0})
+    with pytest.raises(ValueError, match="unknown keys"):
+        protocol.request_from_json({"id": "x", "color": "red"})
+    # Wrong-TYPED fields must also land as ValueError (the codec's
+    # callers map ValueError to the typed 400; a TypeError would
+    # surface as an untyped 500 — or worse, pass admission and crash
+    # the serving thread mid-batch).
+    with pytest.raises(ValueError, match="nsteps must be an int"):
+        protocol.request_from_json({"id": "x", "ic": "tc2",
+                                    "nsteps": "5"})
+    with pytest.raises(ValueError, match="seed must be an int"):
+        protocol.request_from_json({"id": "x", "ic": "tc2",
+                                    "nsteps": 1, "seed": "7"})
+    with pytest.raises(ValueError, match="amplitude must be a number"):
+        protocol.request_from_json({"id": "x", "ic": "tc2",
+                                    "nsteps": 1, "amplitude": "big"})
+    with pytest.raises(ValueError, match="field types"):
+        protocol.request_from_json({"id": "x", "ic": "tc2",
+                                    "nsteps": 1, "outputs": 5})
+    with pytest.raises(ValueError, match="JSON object"):
+        protocol.request_from_json(["not", "a", "dict"])
+    req = protocol.request_from_json(
+        {"id": "x", "ic": "tc5", "nsteps": 3, "outputs": ["h", "u"]})
+    assert req.nsteps == 3 and req.outputs == ("h", "u")
+    with pytest.raises(ValueError, match="unknown gateway error code"):
+        protocol.error_event("not_a_code", "boom")
+
+
+# ------------------------------------------------- endpoints & streaming
+def test_health_ready_stats(gw):
+    code, health = get_json(HOST, gw.port, "/v1/health")
+    assert code == 200 and health["status"] == "ok"
+    assert health["serving_thread_alive"] is True
+    code, ready = get_json(HOST, gw.port, "/v1/ready")
+    assert code == 200 and ready["ready"] is True
+    assert ready["reasons"] == []
+    code, stats = get_json(HOST, gw.port, "/v1/stats")
+    assert code == 200
+    assert stats["buckets"] == [1, 2]
+    assert stats["active_buckets"] == [1, 2]
+    assert stats["warm_compiles"] > 0
+    assert stats["compile_count"] == stats["warm_compiles"]
+    assert stats["draining"] is False
+
+
+def _req_body(rid, ic="tc5", nsteps=5, seed=3):
+    return {"id": rid, "ic": ic, "nsteps": nsteps, "seed": seed,
+            "amplitude": 1e-3, "outputs": ["h", "u"]}
+
+
+def test_http_roundtrip_byte_parity(gw):
+    """The results-path parity guarantee: gateway stream == direct
+    EnsembleServer submission, byte for byte (wall-clock masked)."""
+    status, events = submit_streaming(HOST, gw.port,
+                                      _req_body("parity"))
+    assert status == 200
+    assert events[0] == protocol.accepted_event("parity")
+    segs = [ev for ev in events if ev["event"] == "segment"]
+    assert events[-1]["event"] == "result"
+    # 5 steps through 2-step segments: 2 + 2 + 1.
+    assert len(segs) == 3
+    assert [s["steps_done"] for s in segs] == [2, 4, 5]
+    assert segs[-1]["done"] is True and segs[-1]["nsteps"] == 5
+
+    # The same request straight into an identically-configured server.
+    direct_segs = []
+    srv = EnsembleServer(_cfg(),
+                         on_segment=lambda evs: direct_segs.extend(evs))
+    srv.submit(ScenarioRequest.from_dict(_req_body("parity")))
+    srv.serve()
+    srv.close()
+    direct = srv.results["parity"]
+    assert direct.status == "ok"
+
+    # Segment streams: byte-equal canonical JSON (no timing fields).
+    assert ([protocol.canonical(e) for e in segs]
+            == [protocol.canonical(protocol.segment_event(e))
+                for e in direct_segs])
+    # Final summary + fields: byte-equal with latency masked; the
+    # fields ride as raw array bytes, so this IS the bitwise check.
+    assert (protocol.canonical(events[-1])
+            == protocol.canonical(protocol.result_event(direct)))
+    res = final_result(events)
+    for k in ("h", "u"):
+        assert (np.asarray(res.fields[k]).tobytes()
+                == np.asarray(direct.fields[k]).tobytes()), k
+
+
+def test_ws_roundtrip_matches_http(gw):
+    """The WebSocket endpoint speaks the identical protocol: same
+    scenario (fresh id) -> same segment stream and byte-identical
+    fields as the HTTP submission above."""
+    import aiohttp
+
+    async def ws_submit(body):
+        events = []
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(gw.url + "/v1/ws") as ws:
+                await ws.send_str(json.dumps(body))
+                async for msg in ws:
+                    ev = json.loads(msg.data)
+                    events.append(ev)
+                    if ev["event"] in ("result", "error"):
+                        break
+        return events
+
+    _, http_events = submit_streaming(HOST, gw.port,
+                                      _req_body("via-http"))
+    ws_events = asyncio.run(ws_submit(_req_body("via-ws")))
+    assert ws_events[0] == protocol.accepted_event("via-ws")
+    # Same stream shape modulo the request id...
+    assert len(ws_events) == len(http_events)
+    assert ([e["event"] for e in ws_events]
+            == [e["event"] for e in http_events])
+    # ...and the physics is identical: byte-equal output arrays.
+    a = final_result(ws_events)
+    b = final_result(http_events)
+    for k in ("h", "u"):
+        assert (np.asarray(a.fields[k]).tobytes()
+                == np.asarray(b.fields[k]).tobytes()), k
+    assert a.steps_run == b.steps_run == 5
+    assert gw.stats["ws_connections"] >= 1
+
+
+def test_bad_request_and_duplicate_id(gw):
+    with pytest.raises(GatewayError) as ei:
+        submit_streaming(HOST, gw.port, {"id": "bad", "ic": "tc9",
+                                         "nsteps": 1})
+    assert ei.value.status == 400 and ei.value.error == "bad_request"
+    with pytest.raises(GatewayError) as ei:
+        submit_streaming(HOST, gw.port, {"id": "bad2", "ic": "tc2",
+                                         "nsteps": 1, "color": "red"})
+    assert ei.value.status == 400
+    with pytest.raises(GatewayError) as ei:
+        submit_streaming(HOST, gw.port, {"id": "bad3", "ic": "tc2",
+                                         "nsteps": "5"})
+    assert ei.value.status == 400 and ei.value.error == "bad_request"
+
+    # Duplicate IN-FLIGHT id: hold a long request open, resubmit its id.
+    first_seg = threading.Event()
+    done = {}
+
+    def long_request():
+        done["out"] = submit_streaming(
+            HOST, gw.port, _req_body("dup", ic="tc2", nsteps=40),
+            on_event=lambda ev: (ev["event"] == "segment"
+                                 and first_seg.set()))
+
+    th = threading.Thread(target=long_request, daemon=True)
+    th.start()
+    assert first_seg.wait(60), "no segment event within 60s"
+    with pytest.raises(GatewayError) as ei:
+        submit_streaming(HOST, gw.port,
+                         _req_body("dup", ic="tc2", nsteps=1))
+    assert ei.value.status == 409 and ei.value.error == "duplicate_id"
+    th.join(60)
+    assert done["out"][1][-1]["event"] == "result"
+    assert done["out"][1][-1]["summary"]["steps_run"] == 40
+
+
+def test_typed_backpressure_429_and_503():
+    """Admission overload is a typed contract.  A gateway with the
+    serving loop deliberately NOT started (start(serve=False)) makes
+    the queue fill deterministically: capacity-2 queue -> third submit
+    is 429 queue_full; a tripped health budget -> 503
+    admission_refused; draining -> 503 draining."""
+    g = Gateway(_cfg(serve={"queue_capacity": 2,
+                            "max_guard_events": 1}),
+                host=HOST, port=0, warm=False)
+    g.start(serve=False)
+    try:
+        import http.client
+
+        def post_only(body):
+            """Fire one admission; read just the status + first line
+            (the stream never completes — no serving loop)."""
+            conn = http.client.HTTPConnection(HOST, g.port, timeout=30)
+            try:
+                conn.request("POST", "/v1/requests",
+                             body=json.dumps(body),
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return resp.status, json.loads(resp.read())
+                line = resp.readline()
+                return resp.status, json.loads(line)
+            finally:
+                conn.close()
+
+        s1, ev1 = post_only(_req_body("q0", ic="tc2", nsteps=1))
+        s2, ev2 = post_only(_req_body("q1", ic="tc2", nsteps=1))
+        assert (s1, s2) == (200, 200)
+        assert ev1 == protocol.accepted_event("q0")
+        s3, ev3 = post_only(_req_body("q2", ic="tc2", nsteps=1))
+        assert s3 == 429 and ev3["error"] == "queue_full"
+        code, ready = get_json(HOST, g.port, "/v1/ready")
+        assert code == 503 and "queue_full" in ready["reasons"]
+
+        # Health-driven admission control: one guard event >= the
+        # max_guard_events=1 budget -> 503 admission_refused.
+        g.server.monitor.events.append({"kind": "guard", "event": "nan"})
+        s4, ev4 = post_only(_req_body("q3", ic="tc2", nsteps=1))
+        assert s4 == 503 and ev4["error"] == "admission_refused"
+
+        # Draining beats everything: 503 draining.
+        g.begin_drain()
+        s5, ev5 = post_only(_req_body("q4", ic="tc2", nsteps=1))
+        assert s5 == 503 and ev5["error"] == "draining"
+        code, ready = get_json(HOST, g.port, "/v1/ready")
+        assert code == 503 and "draining" in ready["reasons"]
+        assert g.stats["shed_queue_full"] == 1
+        assert g.stats["shed_admission"] == 1
+        assert g.stats["shed_draining"] == 1
+    finally:
+        g.close(drain=False)
+
+
+def test_dead_serving_loop_refuses_typed_503():
+    """A serving loop that dies must not leave admissions open:
+    submits get a typed 503 (admission_refused), never an accepted
+    stream that hangs."""
+    g = Gateway(_cfg(), host=HOST, port=0, warm=False)
+    g.server.serve_forever = lambda **kw: (_ for _ in ()).throw(
+        RuntimeError("injected serving-loop death"))
+    g.start()
+    try:
+        assert g._serve_thread is not None
+        g._serve_thread.join(30)
+        assert not g._serve_thread.is_alive()
+        code, health = get_json(HOST, g.port, "/v1/health")
+        assert code == 503 and health["serving_thread_alive"] is False
+        code, ready = get_json(HOST, g.port, "/v1/ready")
+        assert code == 503 and "serving_thread_dead" in ready["reasons"]
+        with pytest.raises(GatewayError) as ei:
+            submit_streaming(HOST, g.port,
+                             _req_body("doomed", ic="tc2", nsteps=1))
+        assert ei.value.status == 503
+        assert ei.value.error == "admission_refused"
+    finally:
+        g.close(drain=False)
+
+
+def test_graceful_drain_with_request_mid_flight(tmp_path):
+    """SIGTERM semantics (close() path): admissions stop instantly,
+    the in-flight member runs to ITS OWN final step (all 40 of them),
+    sinks flush, and nothing is re-queued."""
+    sink = str(tmp_path / "gw_drain.jsonl")
+    srv_sink = str(tmp_path / "serve_drain.jsonl")
+    g = Gateway(_cfg(serve={"buckets": "1", "sink": srv_sink}),
+                host=HOST, port=0, sink=sink)
+    g.start()
+    first_seg = threading.Event()
+    done = {}
+
+    def long_request():
+        done["out"] = submit_streaming(
+            HOST, g.port, _req_body("inflight", ic="tc2", nsteps=40),
+            on_event=lambda ev: (ev["event"] == "segment"
+                                 and first_seg.set()))
+
+    th = threading.Thread(target=long_request, daemon=True)
+    th.start()
+    assert first_seg.wait(60), "no segment event within 60s"
+    g.begin_drain()                       # the SIGTERM moment
+    with pytest.raises(GatewayError) as ei:
+        submit_streaming(HOST, g.port,
+                         _req_body("late", ic="tc2", nsteps=1))
+    assert ei.value.status == 503 and ei.value.error == "draining"
+    g.drain()
+    th.join(60)
+    status, events = done["out"]
+    res = final_result(events)
+    assert res.status == "ok"
+    assert res.steps_run == 40            # ran to its own final step
+    assert len(g.server.queue) == 0       # nothing re-queued
+    assert g.server.results["inflight"].status == "ok"
+    g.close()
+    # The flushed sinks survived the shutdown: the completed request
+    # and the typed shed are both on disk, schema-valid.
+    from jaxstream.obs.sink import read_records
+
+    recs = read_records(sink, kind="gateway")
+    by_id = {r["id"]: r for r in recs}
+    assert by_id["inflight"]["status"] == "ok"
+    assert by_id["inflight"]["steps_run"] == 40
+    assert by_id["late"]["status"] == "shed_draining"
+
+
+def test_gateway_sink_records_and_report(gw):
+    """Per-request 'gateway' records are schema-valid and the report
+    CLI aggregates them (latency percentiles + shed counts)."""
+    # One more completed request so this test is self-sufficient.
+    submit_streaming(HOST, gw.port, _req_body("sinkcheck", ic="tc6",
+                                              nsteps=2))
+    from jaxstream.obs.sink import read_records
+
+    recs = read_records(gw.sink_path)     # schema-validates every line
+    gws = [r for r in recs if r["kind"] == "gateway"]
+    assert any(r["id"] == "sinkcheck" and r["status"] == "ok"
+               for r in gws)
+
+    import telemetry_report
+
+    s = telemetry_report.summarize(recs)
+    sec = s["gateway"]
+    assert sec["completed"] >= 1
+    assert sec["latency_p50_s"] is not None
+    assert sec["latency_p99_s"] >= sec["latency_p50_s"]
+    assert sec["shed"] == 0               # this gateway never shed

@@ -1,0 +1,287 @@
+"""Load harness + autoscaling acceptance (jaxstream.loadgen, round 14).
+
+All tier-1 (check_tiers rule 9 — non-slow, loopback only):
+
+  * arrival-trace generation is seed-deterministic (two generations —
+    and two CLI invocations — are byte-equal) and genuinely
+    heavy-tailed;
+  * the autoscaling policy is a PURE function of (queue depth,
+    occupancy) -> bucket cap with hysteresis proofs: disjoint
+    watermarks, patience, cooldown — it cannot flap;
+  * the flagship closed loop: >= 50 mixed-IC requests (all four
+    families) replayed through the HTTP gateway over loopback under a
+    heavy-tailed burst, all completed (or typed-shed), >= 1 live
+    autoscale resize, ZERO steady-state recompiles after the resize,
+    p50/p99 + goodput measured — the round-14 acceptance criterion,
+    in-process on the conftest's fake CPU devices;
+  * two runs of the same trace file are byte-equal in the loadgen sink
+    once wall-clock fields are masked (replayability);
+  * loadgen/autoscale sink records render through
+    scripts/telemetry_report.py.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from jaxstream.gateway import Gateway
+from jaxstream.loadgen import (AutoscaleController, AutoscalePolicy,
+                               AutoscaleState, decide, generate_trace,
+                               masked_records, read_trace, run_load,
+                               write_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+N, DT = 8, 600.0
+HOST = "127.0.0.1"
+
+
+def _cfg():
+    return {
+        "grid": {"n": N},
+        "time": {"dt": DT},
+        "model": {"name": "shallow_water_cov", "backend": "jnp"},
+        "parallelization": {"num_devices": 1},
+        "serve": {"buckets": "1,2", "segment_steps": 2,
+                  "queue_capacity": 64},
+    }
+
+
+# ------------------------------------------------------------- the trace
+def test_trace_generation_is_seed_deterministic(tmp_path):
+    a = generate_trace(40, seed=7, mean_gap_s=0.5, tail_alpha=1.4)
+    b = generate_trace(40, seed=7, mean_gap_s=0.5, tail_alpha=1.4)
+    assert a == b
+    c = generate_trace(40, seed=8, mean_gap_s=0.5, tail_alpha=1.4)
+    assert a != c                          # the seed actually matters
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    write_trace(str(pa), a)
+    write_trace(str(pb), b)
+    assert pa.read_bytes() == pb.read_bytes()
+    assert read_trace(str(pa)) == a        # round trip
+
+
+def test_trace_is_heavy_tailed_and_mixed():
+    trace = generate_trace(300, seed=11, mean_gap_s=0.5,
+                           tail_alpha=1.3)
+    ts = [e["t"] for e in trace]
+    assert ts == sorted(ts) and ts[0] == 0.0
+    gaps = np.diff(ts)
+    # Pareto alpha=1.3: the largest gap dwarfs the median — the
+    # bursts-and-silences shape that exercises the autoscaler.
+    assert gaps.max() > 20 * np.median(gaps)
+    fams = {e["ic"] for e in trace}
+    assert fams == {"tc2", "tc5", "tc6", "galewsky"}
+    assert all(e["nsteps"] >= 1 for e in trace)
+    assert {tuple(e["outputs"]) for e in trace} > {("h",)}
+
+
+def test_trace_validation():
+    with pytest.raises(ValueError, match="n_requests"):
+        generate_trace(0, seed=0)
+    with pytest.raises(ValueError, match="tail_alpha"):
+        generate_trace(1, seed=0, tail_alpha=0.0)
+    with pytest.raises(ValueError, match="lengths"):
+        generate_trace(1, seed=0, lengths=())
+
+
+def test_loadgen_cli_generate_is_byte_deterministic(tmp_path):
+    import loadgen as loadgen_cli
+
+    p1, p2 = str(tmp_path / "t1.jsonl"), str(tmp_path / "t2.jsonl")
+    for p in (p1, p2):
+        assert loadgen_cli.main(["generate", p, "--n", "20",
+                                 "--seed", "3"]) == 0
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+    assert len(read_trace(p1)) == 20
+
+
+# ---------------------------------------------------- the pure policy
+POLICY = AutoscalePolicy(levels=(1, 4, 16), queue_high=4, queue_low=0,
+                         occ_low=0.5, patience=2, cooldown=2)
+
+
+def _drive(policy, obs, state=None):
+    """Feed an observation stream; return (final state, action list)."""
+    st = state or AutoscaleState()
+    actions = []
+    for q, occ in obs:
+        st, target = decide(policy, st, q, occ)
+        actions.append(target)
+    return st, actions
+
+
+def test_autoscale_scales_up_after_patience():
+    st, acts = _drive(POLICY, [(8, 1.0)] * 3)
+    # One high observation arms the streak; the second acts.
+    assert acts == [None, 4, None]         # third lands in cooldown
+    assert st.level == 1
+
+
+def test_autoscale_scales_down_when_idle():
+    st, acts = _drive(POLICY, [(0, 0.1)] * 3,
+                      state=AutoscaleState(level=2))
+    assert acts == [None, 4, None]
+    assert st.level == 1
+
+
+def test_autoscale_cannot_flap_on_alternating_load():
+    """The hysteresis proof: observations alternating between the two
+    watermarks every tick NEVER trigger a resize (each contradiction
+    resets the streaks)."""
+    obs = [(8, 1.0), (0, 0.1)] * 10
+    st, acts = _drive(POLICY, obs)
+    assert acts == [None] * 20
+    assert st.level == 0
+
+
+def test_autoscale_cooldown_blocks_immediate_reversal():
+    """After a scale-up, an instant idle signal cannot yank the level
+    back down: resizes are >= cooldown + patience observations apart."""
+    obs = [(8, 1.0)] * 2 + [(0, 0.1)] * 6
+    st, acts = _drive(POLICY, obs)
+    assert acts[1] == 4                    # the scale-up
+    down = [i for i, a in enumerate(acts) if a == 1]
+    assert down and down[0] >= 1 + POLICY.cooldown + POLICY.patience
+    # Mid-band observations act on neither watermark.
+    st, acts = _drive(POLICY, [(2, 0.8)] * 10)
+    assert acts == [None] * 10
+
+
+def test_autoscale_respects_ladder_bounds():
+    st, acts = _drive(POLICY, [(8, 1.0)] * 20,
+                      state=AutoscaleState(level=2))
+    assert all(a is None for a in acts)    # already at the top
+    st, acts = _drive(POLICY, [(0, 0.0)] * 20)
+    assert all(a is None for a in acts)    # already at the bottom
+
+
+def test_autoscale_policy_validation():
+    with pytest.raises(ValueError, match="ascending"):
+        AutoscalePolicy(levels=(4, 1))
+    with pytest.raises(ValueError, match="queue_high"):
+        AutoscalePolicy(levels=(1, 2), queue_high=2, queue_low=2)
+    with pytest.raises(ValueError, match="patience"):
+        AutoscalePolicy(levels=(1, 2), patience=0)
+
+
+# ------------------------------------------- the closed loop (flagship)
+@pytest.fixture(scope="module")
+def load_gateway(tmp_path_factory):
+    """A gateway with live autoscaling between the warm {1, 2} buckets
+    and a serve-side sink (autoscale events land there)."""
+    d = tmp_path_factory.mktemp("loadgen")
+    cfg = _cfg()
+    cfg["serve"]["sink"] = str(d / "serve.jsonl")
+    ctrl = AutoscaleController(AutoscalePolicy(
+        levels=(1, 2), queue_high=3, queue_low=0, occ_low=0.6,
+        patience=2, cooldown=2))
+    g = Gateway(cfg, host=HOST, port=0, autoscale=ctrl,
+                sink=str(d / "gateway.jsonl"))
+    g.start()
+    g.serve_sink_path = str(d / "serve.jsonl")
+    g.tmp_dir = d
+    yield g, ctrl
+    g.close()
+
+
+def test_closed_loop_50_mixed_requests_with_autoscale(load_gateway):
+    """The round-14 acceptance criterion, end to end over loopback."""
+    gw, ctrl = load_gateway
+    trace = generate_trace(50, seed=14, mean_gap_s=0.004,
+                           tail_alpha=1.4, lengths=(1, 2, 3, 5, 8))
+    assert {e["ic"] for e in trace} == {"tc2", "tc5", "tc6",
+                                        "galewsky"}
+    sink = str(gw.tmp_dir / "load50.jsonl")
+    summary = run_load(HOST, gw.port, trace, time_scale=1.0,
+                       max_workers=8, sink=sink, dt=DT)
+
+    # Every request completed or was shed as a typed 429/503 contract.
+    assert summary["n_requests"] == 50
+    assert summary["accounting_exact"] is True, summary
+    assert summary["errors"] == 0
+    assert summary["completed"] + summary["shed"] == 50
+    # The 8-worker closed loop can never overrun the 64-slot queue, so
+    # in this regime everything completes.
+    assert summary["completed"] == 50
+    assert summary["goodput_member_steps"] == sum(
+        e["nsteps"] for e in trace)
+    assert summary["goodput_member_steps_per_sec"] > 0
+    assert summary["goodput_sim_days_per_sec"] > 0
+    assert 0 < summary["latency_p50_s"] <= summary["latency_p99_s"]
+
+    # The burst piled the queue past the watermark: the policy resized
+    # LIVE (1 -> 2) at least once...
+    assert len(ctrl.events) >= 1, ctrl.summary()
+    assert ctrl.events[0]["from_bucket"] == 1
+    assert ctrl.events[0]["to_bucket"] == 2
+    assert ctrl.events[0]["queue_depth"] >= 3
+    # ...and with every level warm, the resize compiled NOTHING: zero
+    # steady-state recompiles after the resize.
+    assert gw.server.compile_count() == gw.warm_compiles
+    assert gw.server.stats["resizes"] >= 1
+
+    # Per-request streams: a completed request saw exactly
+    # ceil(nsteps / segment_steps) segment events.
+    from jaxstream.obs.sink import read_records
+
+    recs = read_records(sink, kind="loadgen")
+    assert [r["id"] for r in recs] == [e["id"] for e in trace]
+    by_id = {e["id"]: e for e in trace}
+    for r in recs:
+        assert r["status"] == "ok", r
+        want = -(-by_id[r["id"]]["nsteps"] // 2)
+        assert r["segments"] == want, r
+
+
+def test_loadgen_sink_byte_determinism(load_gateway):
+    """Two runs of the same trace file are byte-equal in the loadgen
+    sink once wall-clock fields are masked."""
+    gw, _ = load_gateway
+    trace = generate_trace(6, seed=5, mean_gap_s=0.002,
+                           tail_alpha=1.5, lengths=(1, 2, 3),
+                           id_prefix="det")
+    paths = []
+    for run in ("a", "b"):
+        p = str(gw.tmp_dir / f"det_{run}.jsonl")
+        s = run_load(HOST, gw.port, trace, time_scale=0.0,
+                     max_workers=4, sink=p, dt=DT)
+        assert s["completed"] == 6, s
+        paths.append(p)
+    assert masked_records(paths[0]) == masked_records(paths[1])
+    # Unmasked they differ (latency is real wall time) — the mask is
+    # doing work, not hiding a constant.
+    raw = [open(p).read() for p in paths]
+    assert raw[0] != raw[1]
+
+
+def test_autoscale_and_loadgen_telemetry_report(load_gateway):
+    """The serve-side sink carries the autoscale resize events; the
+    loadgen sink carries per-request outcomes; telemetry_report
+    renders both."""
+    gw, ctrl = load_gateway
+    import telemetry_report
+    from jaxstream.obs.sink import read_records
+
+    # Serve sink: autoscale records are schema-valid and aggregated.
+    recs = read_records(gw.serve_sink_path)
+    autos = [r for r in recs if r["kind"] == "autoscale"]
+    assert len(autos) >= 1 + len(ctrl.events)   # attach + live resizes
+    s = telemetry_report.summarize(recs)
+    assert s["autoscale"]["resizes"] == len(autos)
+    assert s["autoscale"]["events"][0]["reason"] == "autoscale_attach"
+    live = [e for e in s["autoscale"]["events"]
+            if e["reason"] == "autoscale"]
+    assert live and live[0]["to_bucket"] == 2
+
+    # Loadgen sink: the report aggregates latency + shed counts.
+    s2 = telemetry_report.summarize(
+        read_records(str(gw.tmp_dir / "load50.jsonl")))
+    lg = s2["loadgen"]
+    assert lg["n_requests"] == 50
+    assert lg["completed"] == 50 and lg["shed"] == 0
+    assert lg["latency_p99_s"] >= lg["latency_p50_s"] > 0

@@ -91,6 +91,13 @@ def test_request_queue_backpressure_and_group_fifo():
     assert q.pop_group("flat").id == "r2"
     assert q.pop().id == "r1"
     assert q.pop() is None
+    # remove() takes a request back out by IDENTITY (the submit/drain
+    # race unwind): False once it is no longer queued.
+    q.submit(r[0])
+    q.submit(r[1])
+    assert q.remove(r[0]) is True
+    assert q.remove(r[0]) is False        # already withdrawn
+    assert q.pop() is r[1]
 
 
 def test_request_validation():
@@ -416,6 +423,48 @@ def test_group_by_orography_parity_mode():
         np.testing.assert_array_equal(
             np.asarray(grouped.results[rid].fields["h"]),
             np.asarray(mixed.results[rid].fields["h"]))
+
+
+def test_resize_and_drain_surface(tmp_path):
+    """Round-14 serve hooks, compile-free: resize validates against
+    the configured bucket set (every legal cap maps to a warm
+    executable), records an 'autoscale' sink event, and scales the
+    active packing cap; begin_drain closes admissions with the typed
+    ServerDraining (an AdmissionRefused subclass) and serve_forever
+    exits once the queue drains."""
+    from jaxstream.serve import ServerDraining
+
+    sink = str(tmp_path / "resize.jsonl")
+    srv = EnsembleServer(_cfg(serve={"buckets": "1,2", "sink": sink}))
+    assert srv.active_buckets == (1, 2)
+    with pytest.raises(ValueError, match="not a configured bucket"):
+        srv.resize(4)
+    assert srv.resize(1, reason="autoscale",
+                      queue_depth=5, occupancy=0.25) == 2
+    assert srv.active_buckets == (1,)
+    assert srv.stats["resizes"] == 1
+    assert srv.resize(2) == 1              # back up, still warm-only
+    assert srv.active_buckets == (1, 2)
+
+    srv.begin_drain()
+    assert srv.draining
+    with pytest.raises(ServerDraining) as ei:
+        srv.submit(ScenarioRequest(id="late", ic="tc2", nsteps=1))
+    assert isinstance(ei.value, AdmissionRefused)   # typed hierarchy
+    assert srv.stats["refused"] == 1
+    # Draining + empty queue: serve_forever returns without serving.
+    assert srv.serve_forever() == {}
+    srv.close()
+
+    from jaxstream.obs.sink import read_records
+
+    autos = read_records(sink, kind="autoscale")
+    assert [a["to_bucket"] for a in autos] == [1, 2]
+    assert autos[0]["from_bucket"] == 2
+    assert autos[0]["queue_depth"] == 5
+    assert autos[0]["occupancy"] == 0.25
+    assert autos[0]["reason"] == "autoscale"
+    assert autos[1]["reason"] == "manual"
 
 
 def test_serve_cli_summary(tmp_path):
