@@ -1972,8 +1972,11 @@ def bench_contract_check(smoke=False):
         # the stamp keeps counts + violations + facts so the bench
         # JSON line and sink records stay readable.
         result.pop("passes", None)
+        space = (result.get("facts") or {}).get("plan_space") or {}
         log(f"bench contract check: {result['checks_run']} checks, "
             f"{result['violation_count']} violation(s)"
+            + (f"; plan space {space['size']} plans "
+               f"(rules v{space['rules_version']})" if space else "")
             + ("" if result["ok"] else " — CONTRACT BROKEN"))
         for v in result.get("violations", [])[:10]:
             log(f"bench contract check: FAIL [{v['check']}] "

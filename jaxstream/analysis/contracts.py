@@ -222,31 +222,228 @@ def _audit_exchange_variant(report, facts, name, jaxpr, *,
     return entry
 
 
-def check_steppers(report: ContractReport = None, n: int = 12,
-                   halo: int = 2, include_compile: bool = True):
-    """Trace + audit the composition matrix's steppers.
+def _audit_plan_variant(report, facts, ctx, plan, built):
+    """Trace + audit ONE enumerated plan's stepper.
 
-    Returns ``(report, facts)``.  Needs >= 6 CPU devices (the conftest
-    / ``scripts/analyze.py`` virtual-device pool).
+    The expectations are derived from the plan itself (comm_probe
+    analytic plans, placement plans, precision policy) — nothing here
+    is hand-written per variant, so a plan that newly enters the
+    enumerated space is audited with zero new code.
     """
-    import dataclasses as _dc
-
     import jax
-    import jax.numpy as jnp
 
-    from .. import stepping
-    from ..config import EARTH_GRAVITY, EARTH_OMEGA, EARTH_RADIUS
-    from ..geometry.cubed_sphere import build_grid
-    from ..models.shallow_water_cov import (ENSEMBLE_STATE_AXES,
-                                            CovariantShallowWater)
-    from ..ops.pallas.precision import encode_strips
-    from ..parallel.mesh import setup_ensemble_sharding, setup_sharding
-    from ..parallel.sharded_model import make_stepper_for
-    from ..physics.initial_conditions import williamson_tc2
+    from ..plan.proof import verify_stamp
     from ..serve.placement import (plan_bucket,
                                    plan_exchange_bytes_per_step)
     from ..utils.comm_probe import (batched_exchange_plan,
                                     temporal_block_plan)
+
+    n, halo = ctx.n, ctx.halo
+    name = plan.key()
+    B, k = plan.ensemble, plan.temporal_block
+    jx = trace(lambda *a: built.step(*a), *built.example)
+
+    # -- serving placements -------------------------------------------
+    if plan.serving:
+        audit_callbacks(jx, report, name)
+        pps = collect_ppermutes(jx)
+        # The masked-segment fori_loop body traces the stepper once,
+        # so len(pps) IS the per-step count for every placement.
+        entry = {"ppermutes_per_step": len(pps)}
+        if plan.placement == "panel":
+            pplan = plan_bucket(B, 6, "panel")
+            plan_bytes = plan_exchange_bytes_per_step(pplan, n, halo)
+            loop_bytes = sum(int(np.prod(s)) * _DTYPE_BYTES.get(d, 4)
+                             for _, s, d in pps)
+            report.check(
+                len(pps) == 12, "jaxpr.collective_count_vs_plan", name,
+                f"panel-sharded masked segment must trace the face "
+                f"tier's 12 ppermutes per step; got {len(pps)}")
+            report.check(
+                float(loop_bytes) == plan_bytes,
+                "jaxpr.payload_bytes_vs_plan", name,
+                f"traced {loop_bytes} exchange bytes/step; the "
+                f"placement plan bills {plan_bytes}")
+            entry = {"ppermutes_per_step": len(pps),
+                     "payload_bytes_per_step": float(loop_bytes),
+                     "plan_payload_bytes_per_step": plan_bytes}
+            verify_stamp(built.proof, _unique_perms(
+                [p for p, _, _ in pps]), report, name)
+        else:
+            report.check(
+                len(pps) == 0, "jaxpr.collective_count_vs_plan", name,
+                f"{plan.placement or 'single'}-placement serving "
+                f"traced explicit collectives — members must never "
+                f"communicate")
+            entry["plan_exchange_bytes_per_step"] = 0.0
+        _check_stamp(report, name, built, expect_schedule=(
+            plan.placement == "panel"))
+        facts["variants"][name] = entry
+        return
+
+    # -- explicit face tier -------------------------------------------
+    if plan.tier == "face":
+        deep = B == 1 and k > 1
+        if deep:
+            tb = temporal_block_plan(n, halo, k)
+            kwargs = dict(
+                steps_per_call=k, stages_per_round=4,
+                plan_ppermutes_per_step=tb["ppermutes_per_step"],
+                plan_payload_bytes_per_step=tb[
+                    "payload_bytes_per_step"],
+                expect_payload_shape=(3, tb["deep_halo_width"], n))
+        else:
+            bp = batched_exchange_plan(n, halo, B)
+            shape = (B, 3, halo, n) if B > 1 else (3, halo, n)
+            kwargs = dict(
+                steps_per_call=k, stages_per_round=4,
+                plan_ppermutes_per_step=bp["ppermutes_per_step"],
+                plan_payload_bytes_per_step=bp[
+                    "payload_bytes_per_ppermute"]
+                * bp["ppermutes_per_step"] if B > 1
+                else bp["wire_bytes_per_member_step"],
+                expect_payload_shape=shape)
+        # Overlap-window witnesses: provable per round wherever the
+        # traced body is the phase-split program (k=1, and the batched
+        # exact k-fusion, whose blocks each carry the split); the
+        # deep-halo form's windows are structural (issue-before-
+        # consume via round levels), recorded as not-applicable.
+        if not deep:
+            kwargs["expect_overlap"] = plan.overlap
+        _audit_exchange_variant(report, facts, name, jx, **kwargs)
+        if plan.overlap and deep:
+            _note_no_window_check(report, facts, name)
+        rounds = audit_rounds(jx)
+        verify_stamp(built.proof, _unique_perms(
+            [p for r in rounds for p in r.perms]), report, name)
+        _check_stamp(report, name, built, expect_schedule=True)
+        return
+
+    # -- factored TT tier ----------------------------------------------
+    if plan.tier in ("tt", "tt_sharded"):
+        if plan.tier == "tt":
+            audit_dtypes(jx, report, name, allow_f64=True)
+            audit_callbacks(jx, report, name)
+            report.check(
+                count_primitive(jx, "ppermute") == 0,
+                "jaxpr.collective_count_vs_plan", name,
+                "the single-device factored tier traced explicit "
+                "collectives")
+            facts["variants"][name] = {"ppermutes_per_call": 0}
+            _check_stamp(report, name, built, expect_schedule=False)
+            return
+        entry = _audit_exchange_variant(
+            report, facts, name, jx, steps_per_call=k,
+            stages_per_round=None, check_fingerprint=True,
+            allow_f64=True)
+        depths = {s[-2] for s in entry["payload_shapes"]}
+        report.check(
+            depths == {1}, "jaxpr.strip_depth", name,
+            f"TT strips are depth-1 reconstructed lines; traced "
+            f"depths {sorted(depths)}")
+        if plan.overlap:
+            _note_no_window_check(report, facts, name)
+        rounds = audit_rounds(jx)
+        verify_stamp(built.proof, _unique_perms(
+            [p for r in rounds for p in r.perms]), report, name)
+        _check_stamp(report, name, built, expect_schedule=True)
+        return
+
+    # -- fused single-device --------------------------------------------
+    if plan.tier == "fused":
+        census = audit_dtypes(jx, report, name,
+                              expect_bf16=plan.stage == "bf16")
+        audit_callbacks(jx, report, name)
+        report.check(
+            count_primitive(jx, "ppermute") == 0,
+            "jaxpr.collective_count_vs_plan", name,
+            "the single-device fused stepper traced explicit "
+            "collectives")
+        # Prognostic carry leaves stay f32 under any policy: the bf16
+        # quantization may ride stage operands and strips, never the
+        # accumulated state.
+        out = jax.eval_shape(lambda *a: built.step(*a),
+                             *built.example)
+        bad = [kk for kk in ("h", "u")
+               if str(out[kk].dtype) != "float32"]
+        report.check(
+            not bad, "jaxpr.carry_dtype_stable", name,
+            f"prognostic carry leaves {bad} are not float32 under "
+            f"stage policy {plan.stage!r} — quantization leaked into "
+            f"the accumulated state")
+        facts["variants"][name] = {
+            "bf16_ops": census.get("bfloat16", 0),
+            "f32_ops": census.get("float32", 0)}
+        _check_stamp(report, name, built, expect_schedule=False)
+        return
+
+    # -- classic / GSPMD (no explicit collectives) ----------------------
+    audit_dtypes(jx, report, name)
+    audit_callbacks(jx, report, name)
+    report.check(
+        count_primitive(jx, "ppermute") == 0,
+        "jaxpr.collective_count_vs_plan", name,
+        ("the GSPMD path traced explicit ppermutes — its collectives "
+         "must be XLA-inferred from shardings") if plan.tier == "gspmd"
+        else "the single-device classic stepper traced explicit "
+             "collectives")
+    facts["variants"][name] = {
+        "ppermutes_per_call": 0,
+        "note": ("collectives inferred by GSPMD at compile time"
+                 if plan.tier == "gspmd" else "single-device")}
+    _check_stamp(report, name, built, expect_schedule=False)
+
+
+def _check_stamp(report, name, built, expect_schedule: bool):
+    """Every built stepper must carry a verified proof stamp whose
+    declared schedule presence matches the tier's reality."""
+    from ..plan.rules import RULES_VERSION
+
+    stamp = built.proof
+    if not report.check(
+            stamp is not None, "proof.stamp_present", name,
+            "the built stepper carries no proof stamp"):
+        return
+    report.check(
+        stamp.verdict == "verified", "proof.verdict", name,
+        f"stamp verdict {stamp.verdict!r} != 'verified' — the "
+        f"enumerated matrix does not cover this plan's capability "
+        f"class ({stamp.plan_key})")
+    report.check(
+        stamp.rules_version == RULES_VERSION, "proof.rules_version",
+        name, f"stamp minted against rules v{stamp.rules_version}, "
+              f"current table is v{RULES_VERSION}")
+    report.check(
+        (stamp.schedule_fingerprint is not None) == expect_schedule,
+        "proof.schedule_presence", name,
+        f"stamp {'misses the' if expect_schedule else 'declares a'} "
+        f"schedule fingerprint for this tier")
+    if expect_schedule:
+        report.check(
+            stamp.schedule_fingerprint == _plan_fp(),
+            "proof.schedule_fingerprint", name,
+            f"stamp schedule {stamp.schedule_fingerprint} != the "
+            f"canonical {_plan_fp()}")
+
+
+def check_steppers(report: ContractReport = None, n: int = 12,
+                   halo: int = 2, include_compile: bool = True):
+    """Trace + audit the ENUMERATED capability-plan space.
+
+    The variant list is :func:`jaxstream.plan.rules.enumerate_plans`
+    — the complete legal plan space over the declared axes — built
+    through the one shared :func:`jaxstream.plan.build.build_stepper`
+    pipeline; there is no hand-enumerated variant list left.  Returns
+    ``(report, facts)``.  Needs >= 6 CPU devices (the conftest /
+    ``scripts/analyze.py`` virtual-device pool).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .. import stepping
+    from ..plan.build import PlanContext, build_stepper
+    from ..plan.rules import RULES_VERSION, enumerate_plans
+    from ..utils.comm_probe import batched_exchange_plan
 
     report = report or ContractReport()
     ncpu = len(jax.devices("cpu"))
@@ -257,166 +454,43 @@ def check_steppers(report: ContractReport = None, n: int = 12,
             f"--xla_force_host_platform_device_count=8 (scripts/"
             f"analyze.py run as __main__ sets it itself)")
 
+    plans = enumerate_plans(n, halo)
     facts = {"n": n, "halo": halo, "cpu_devices": ncpu,
-             "schedule_fingerprint": _plan_fp(), "variants": {}}
+             "schedule_fingerprint": _plan_fp(),
+             "plan_space": {"size": len(plans),
+                            "rules_version": RULES_VERSION,
+                            "keys": [p.key() for p in plans]},
+             "variants": {}}
+    ctx = PlanContext(n, halo, _DT)
 
-    grid = build_grid(n, halo=halo, radius=EARTH_RADIUS,
-                      dtype=jnp.float32)
-    h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
-    model = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
-                                  omega=EARTH_OMEGA)
-    # Pin the audited state to f32 regardless of the host's x64 mode
-    # (the test conftest enables it): the precision contract under
-    # audit is the steppers', not the IC builders'.
-    state = jax.tree_util.tree_map(
-        lambda a: jnp.asarray(a, jnp.float32),
-        model.initial_state(h_ext, v_ext))
-    t0 = jnp.float32(0.0)
-    par = {"num_devices": 6, "device_type": "cpu",
-           "use_shard_map": True}
-    setup = setup_sharding({"parallelization": par})
-    setup_ov = _dc.replace(setup, overlap_exchange=True)
-
-    plan1 = batched_exchange_plan(n, halo, 1)
-    plan2 = batched_exchange_plan(n, halo, 2)
-    tbplan = temporal_block_plan(n, halo, 2)
-
-    # -- face tier: serialized / overlap -----------------------------
-    for name, su, expect_ov in (("face_serialized", setup, False),
-                                ("face_overlap", setup_ov, True)):
-        step = make_stepper_for(model, su, state, _DT)
-        jx = trace(lambda s, _step=step: _step(s, t0), state)
-        _audit_exchange_variant(
-            report, facts, name, jx, stages_per_round=4,
-            expect_overlap=expect_ov,
-            plan_ppermutes_per_step=plan1["ppermutes_per_step"],
-            plan_payload_bytes_per_step=plan1[
-                "wire_bytes_per_member_step"],
-            expect_payload_shape=(3, halo, n))
-
-    # -- face tier: deep-halo temporal blocking (k=2) ----------------
-    D = tbplan["deep_halo_width"]
-    for name, su in (("face_deep_k2", setup),
-                     ("face_deep_k2_overlap", setup_ov)):
-        step = make_stepper_for(model, su, state, _DT,
-                                temporal_block=2)
-        k = step.steps_per_call
-        jx = trace(lambda s, _step=step: _step(s, t0), state)
-        _audit_exchange_variant(
-            report, facts, name, jx, steps_per_call=k,
-            stages_per_round=4,
-            plan_ppermutes_per_step=tbplan["ppermutes_per_step"],
-            plan_payload_bytes_per_step=tbplan[
-                "payload_bytes_per_step"],
-            expect_payload_shape=(3, D, n))
-        if su is setup_ov:
-            _note_no_window_check(report, facts, name)
-
-    # -- ensemble (batched exchange), x overlap, x temporal fusion ---
-    B = 2
-    sb = {"h": jnp.stack([state["h"]] * B),
-          "u": jnp.stack([state["u"]] * B, axis=1)}
-    for name, su, kw, expect_ov in (
-            ("ensemble_B2", setup, {}, False),
-            ("ensemble_B2_overlap", setup_ov, {}, True),
-            ("ensemble_B2_tb2", setup, {"temporal_block": 2}, False)):
-        step = make_stepper_for(model, su, state, _DT, ensemble=B,
-                                **kw)
-        k = getattr(step, "steps_per_call", 1)
-        jx = trace(lambda s, _step=step: _step(s, t0), sb)
-        _audit_exchange_variant(
-            report, facts, name, jx, steps_per_call=k,
-            stages_per_round=4, expect_overlap=expect_ov,
-            plan_ppermutes_per_step=plan2["ppermutes_per_step"],
-            plan_payload_bytes_per_step=plan2[
-                "payload_bytes_per_ppermute"]
-            * plan2["ppermutes_per_step"],
-            expect_payload_shape=(B, 3, halo, n))
-
-    # -- TT factored tier --------------------------------------------
-    from ..tt.shard import make_tt_sphere_swe_sharded, panel_mesh
-    from ..tt.sphere import factor_panels
-    from ..ops.fv import covariant_components
-
-    ua, ub = covariant_components(grid, v_ext)
-    rank = 4
-    pfac = tuple(
-        factor_panels(np.asarray(grid.interior(x), np.float32), rank)
-        for x in (h_ext, ua, ub))
-    tmesh = panel_mesh(jax.devices("cpu")[:6])
-    for name, ov in (("tt_serialized", False), ("tt_overlap", True)):
-        tstep = make_tt_sphere_swe_sharded(grid, _DT, rank, tmesh,
-                                           overlap_exchange=ov)
-        jx = trace(tstep, pfac)
-        # allow_f64: the TT tier deliberately follows the ambient x64
-        # mode (the f64-on-CPU oracle convention); the f32 contract is
-        # the dense/fused tiers'.
-        entry = _audit_exchange_variant(
-            report, facts, name, jx, stages_per_round=None,
-            check_fingerprint=True, allow_f64=True)
-        depths = {s[-2] for s in entry["payload_shapes"]}
-        report.check(
-            depths == {1}, "jaxpr.strip_depth", name,
-            f"TT strips are depth-1 reconstructed lines; traced "
-            f"depths {sorted(depths)}")
-        if ov:
-            _note_no_window_check(report, facts, name)
-
-    # -- GSPMD path (collectives compiler-inferred) ------------------
-    setup_g = setup_sharding({"parallelization": {
-        "num_devices": 6, "device_type": "cpu",
-        "use_shard_map": False}})
-    gstep = make_stepper_for(model, setup_g, state, _DT)
-    jxg = trace(lambda s: gstep(s, t0), state)
-    report.check(
-        count_primitive(jxg, "ppermute") == 0,
-        "jaxpr.gspmd_no_explicit_collectives", "gspmd_6dev",
-        "the GSPMD path traced explicit ppermutes — its collectives "
-        "must be XLA-inferred from shardings")
-    audit_dtypes(jxg, report, "gspmd_6dev")
-    audit_callbacks(jxg, report, "gspmd_6dev")
-    facts["variants"]["gspmd_6dev"] = {
-        "ppermutes_per_call": 0,
-        "note": "collectives inferred by GSPMD at compile time"}
-
-    # -- fused single-device precision ladder ------------------------
-    fmodel = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
-                                   omega=EARTH_OMEGA,
-                                   backend="pallas_interpret")
-    for name, pol, kw in (("fused_f32", None, {}),
-                          ("fused_bf16", "bf16", {}),
-                          ("fused_bf16_tb2", "bf16",
-                           {"temporal_block": 2})):
-        fstep = fmodel.make_fused_step(_DT, precision=pol, **kw)
-        y0 = encode_strips(fmodel.compact_state(state), pol)
-        jxf = trace(lambda y, _s=fstep: _s(y, t0), y0)
-        census = audit_dtypes(jxf, report, name,
-                              expect_bf16=pol is not None)
-        audit_callbacks(jxf, report, name)
-        # Prognostic carry leaves stay f32 under any policy: the bf16
-        # quantization may ride stage operands and strips, never the
-        # accumulated state.
-        out = jax.eval_shape(lambda y, _s=fstep: _s(y, t0), y0)
-        bad = [k for k in ("h", "u")
-               if str(out[k].dtype) != "float32"]
-        report.check(
-            not bad, "jaxpr.carry_dtype_stable", name,
-            f"prognostic carry leaves {bad} are not float32 under "
-            f"policy {pol!r} — quantization leaked into the "
-            f"accumulated state")
-        facts["variants"][name] = {
-            "bf16_ops": census.get("bfloat16", 0),
-            "f32_ops": census.get("float32", 0)}
+    for plan in plans:
+        name = plan.key()
+        try:
+            built = build_stepper(plan, ctx)
+        except Exception as e:
+            report.fail("plan.build", name,
+                        f"enumerated plan failed to build: "
+                        f"{type(e).__name__}: {e}")
+            continue
+        try:
+            _audit_plan_variant(report, facts, ctx, plan, built)
+        except Exception as e:
+            report.fail("plan.audit", name,
+                        f"audit raised {type(e).__name__}: {e}")
 
     # -- segment loop: no host callbacks, schedule rides the body ----
     # unroll=1 so the while body traces the stepper exactly once (the
     # default unroll=4 is numerically identical but traces the body
     # unroll+1 times, which would multiply the static count).
-    face_step = make_stepper_for(model, setup, state, _DT)
+    plan1 = batched_exchange_plan(n, halo, 1)
+    from ..parallel.sharded_model import make_stepper_for
+
+    face_step = make_stepper_for(ctx.model(), ctx.setup(), ctx.state,
+                                 _DT)
     jxl = trace(
         lambda y, t: stepping.integrate(face_step, y, t, 8, _DT,
                                         unroll=1),
-        state, 0.0)
+        ctx.state, 0.0)
     audit_callbacks(jxl, report, "segment_loop_face")
     report.check(
         count_primitive(jxl, "ppermute") == plan1[
@@ -428,80 +502,36 @@ def check_steppers(report: ContractReport = None, n: int = 12,
     facts["variants"]["segment_loop_face"] = {
         "ppermutes_in_loop_body": count_primitive(jxl, "ppermute")}
 
-    # -- serve placement: panel-sharded masked segment ---------------
-    seg = 2
-    esetup = setup_ensemble_sharding(
-        {"parallelization": {"num_devices": 6,
-                             "device_type": "cpu"}},
-        members=B, layout="panel_member")
-    from ..parallel.shard_cov import make_sharded_cov_ensemble_stepper
-
-    pstep = make_sharded_cov_ensemble_stepper(model, esetup, _DT, B,
-                                              wrap_jit=False)
-    rem0 = jnp.asarray([seg, seg], jnp.int32)
-
-    def seg_panel(y, rem):
-        return stepping.integrate_masked(pstep, y, 0.0, rem, seg, _DT,
-                                         ENSEMBLE_STATE_AXES)
-
-    jxp = trace(seg_panel, sb, rem0)
-    pplan = plan_bucket(B, 6, "panel")
-    plan_bytes = plan_exchange_bytes_per_step(pplan, n, halo)
-    loop_pp = collect_ppermutes(jxp)
-    loop_bytes = sum(int(np.prod(s)) * _DTYPE_BYTES.get(d, 4)
-                     for _, s, d in loop_pp)
-    report.check(
-        len(loop_pp) == 12, "jaxpr.collective_count_vs_plan",
-        "serve_panel",
-        f"panel-sharded masked segment must trace the face tier's 12 "
-        f"ppermutes per step; got {len(loop_pp)}")
-    report.check(
-        float(loop_bytes) == plan_bytes,
-        "jaxpr.payload_bytes_vs_plan", "serve_panel",
-        f"traced {loop_bytes} exchange bytes/step; the placement plan "
-        f"bills {plan_bytes}")
-    audit_callbacks(jxp, report, "serve_panel")
-    facts["variants"]["serve_panel"] = {
-        "ppermutes_per_step": len(loop_pp),
-        "payload_bytes_per_step": float(loop_bytes),
-        "plan_payload_bytes_per_step": plan_bytes}
-
-    # -- serve placement: member-parallel (GSPMD, compiled) ----------
-    mdevs = 2
-    msetup = setup_ensemble_sharding(
-        {"parallelization": {"num_devices": mdevs,
-                             "device_type": "cpu"}},
-        members=B, layout="member")
-    mplan = plan_bucket(B, mdevs, "member")
-    entry = {"plan_exchange_bytes_per_step":
-             plan_exchange_bytes_per_step(mplan, n, halo)}
-    vstep = stepping.vmap_ensemble(model.make_step(_DT),
-                                   ENSEMBLE_STATE_AXES)
-
-    def seg_member(y, rem):
-        return stepping.integrate_masked(vstep, y, 0.0, rem, seg, _DT,
-                                         ENSEMBLE_STATE_AXES)
-
-    jxm = trace(seg_member, sb, rem0)
-    audit_callbacks(jxm, report, "serve_member")
-    report.check(
-        count_primitive(jxm, "ppermute") == 0,
-        "jaxpr.collective_count_vs_plan", "serve_member",
-        "member-parallel placement traced explicit collectives — "
-        "members must never communicate")
+    # -- serve member-parallel: zero wire in the compiled HLO ---------
     if include_compile:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        carry_sh = {k: msetup.ensemble_sharding_for(ax + 4)
-                    for k, ax in ENSEMBLE_STATE_AXES.items()}
+        from ..models.shallow_water_cov import ENSEMBLE_STATE_AXES
+
+        B = 2
+        msetup = ctx.ensemble_setup(B, "member", 2)
+        sb = ctx.batched_state(B)
+        rem0 = jnp.asarray([2, 2], jnp.int32)
+        vstep = stepping.vmap_ensemble(ctx.model().make_step(_DT),
+                                       ENSEMBLE_STATE_AXES)
+
+        def seg_member(y, rem):
+            return stepping.integrate_masked(
+                vstep, y, 0.0, rem, 2, _DT, ENSEMBLE_STATE_AXES)
+
+        carry_sh = {kk: msetup.ensemble_sharding_for(ax + 4)
+                    for kk, ax in ENSEMBLE_STATE_AXES.items()}
         rep_sh = NamedSharding(msetup.mesh, P())
         seg_j = jax.jit(seg_member, in_shardings=(carry_sh, rep_sh),
                         out_shardings=(carry_sh, rep_sh, rep_sh))
         hlo = seg_j.lower(sb, rem0).compile().as_text()
         n_cp = hlo.count("collective-permute")
         n_a2a = hlo.count("all-to-all")
-        entry["compiled_collective_permutes"] = n_cp
-        entry["compiled_all_to_alls"] = n_a2a
+        mkey = "serve_member+gspmd"
+        if mkey in facts["variants"]:
+            facts["variants"][mkey][
+                "compiled_collective_permutes"] = n_cp
+            facts["variants"][mkey]["compiled_all_to_alls"] = n_a2a
         report.check(
             n_cp == 0 and n_a2a == 0,
             "jaxpr.member_parallel_zero_wire", "serve_member",
@@ -509,33 +539,47 @@ def check_steppers(report: ContractReport = None, n: int = 12,
             f"across chips (collective-permute={n_cp}, "
             f"all-to-all={n_a2a}) but the placement plan bills zero "
             f"exchange bytes")
-    facts["variants"]["serve_member"] = entry
 
     # -- donation: declared AND aliased in the segment executable ----
     if include_compile:
-        jrun = stepping.jit_integrate(model.make_step(_DT), _DT,
+        jrun = stepping.jit_integrate(ctx.model().make_step(_DT), _DT,
                                       donate=True)
-        audit_donation(jrun, (state, 0.0, 4), report,
+        audit_donation(jrun, (ctx.state, 0.0, 4), report,
                        "jit_integrate(donate=True)",
                        expect_donated=True)
         # The negative side needs no compile: aliasing can only come
         # from a donor annotation, checked at the lowering.
-        jrun_off = stepping.jit_integrate(model.make_step(_DT), _DT,
-                                          donate=False)
-        audit_donation(jrun_off, (state, 0.0, 4), report,
+        jrun_off = stepping.jit_integrate(ctx.model().make_step(_DT),
+                                          _DT, donate=False)
+        audit_donation(jrun_off, (ctx.state, 0.0, 4), report,
                        "jit_integrate(donate=False)",
                        expect_donated=False)
     facts["compile_checks"] = bool(include_compile)
     return report, facts
 
 
+#: One full (include_compile=True) run's result per (n, halo) — a
+#: trace-only request (the bench --smoke stamp) reuses it instead of
+#: re-tracing the whole matrix in the same process: the full result is
+#: a strict superset, and the gate already paid for it once in
+#: tests/test_analysis.py.  Fresh processes (the offline bench, the
+#: CLI) never hit the memo.
+_FULL_RUN_MEMO = {}
+
+
 def run_all(n: int = 12, halo: int = 2,
             include_compile: bool = True):
     """Both passes; returns ``(ContractReport, facts_dict)``."""
+    if not include_compile and (n, halo) in _FULL_RUN_MEMO:
+        report, facts = _FULL_RUN_MEMO[(n, halo)]
+        facts = dict(facts, reused_full_run=True)
+        return report, facts
     report = ContractReport()
     check_schedules(report, n=n, halo=halo)
     report, facts = check_steppers(report, n=n, halo=halo,
                                    include_compile=include_compile)
     facts["ok"] = report.passed
     facts["checks_run"] = report.checks_run
+    if include_compile:
+        _FULL_RUN_MEMO[(n, halo)] = (report, facts)
     return report, facts

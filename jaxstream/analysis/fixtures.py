@@ -13,6 +13,16 @@ classes the checker exists for, and ``tests/test_analysis.py`` +
   the ``3*k*halo`` temporal-blocking requirement.  The block would
   integrate, with the deepest ring never refilled — pure truncation
   drift, again no crash.
+* ``illegal_plan`` (round 16) — an illegal capability pair (a bf16
+  stage policy on the sharded face tier) presented to the plan-rule
+  check.  The rule table MUST reject it with a pointer; if someone
+  deletes the rule, the fixture comes back clean and the CLI exits 0
+  — which CI asserts against.
+* ``proof_fingerprint`` (round 16) — a proof stamp whose declared
+  schedule fingerprint does not match the schedule it claims to
+  describe.  ``verify_stamp`` must flag the mismatch; a stamp pass
+  that stopped cross-checking would let an analytic plan and a
+  compiled schedule diverge behind a green "verified" badge.
 """
 
 from __future__ import annotations
@@ -22,9 +32,11 @@ from .report import ContractReport
 from .schedule import verify_deep_program, verify_stage_perms
 
 __all__ = ["FIXTURES", "broken_dropped_pair_perms",
-           "broken_deep_program", "run_fixture"]
+           "broken_deep_program", "broken_plan",
+           "broken_proof_stamp", "run_fixture"]
 
-FIXTURES = ("dropped_pair", "deep_depth")
+FIXTURES = ("dropped_pair", "deep_depth", "illegal_plan",
+            "proof_fingerprint")
 
 
 def broken_dropped_pair_perms(stage: int = 2):
@@ -48,6 +60,31 @@ def broken_deep_program(n: int = 12, halo: int = 2,
     return CovShardProgram(gdeep)
 
 
+def broken_plan(n: int = 12, halo: int = 2):
+    """An illegal capability plan: bf16 stage arithmetic on the
+    explicit face tier — the sharded tiers run f32 numerics, so the
+    rule table must reject this pair with its pointer."""
+    from ..plan.plan import CapabilityPlan
+
+    return CapabilityPlan(tier="face", n=n, halo=halo, stage="bf16",
+                          strips="bf16", num_devices=6,
+                          use_shard_map=True)
+
+
+def broken_proof_stamp():
+    """A proof stamp whose declared schedule fingerprint is corrupted
+    — it no longer digests the schedule it rides with."""
+    import dataclasses
+
+    from ..plan.plan import CapabilityPlan
+    from ..plan.proof import build_proof
+
+    stamp = build_proof(CapabilityPlan(tier="face", num_devices=6,
+                                       use_shard_map=True))
+    return dataclasses.replace(
+        stamp, schedule_fingerprint="deadbeefdeadbeef")
+
+
 def run_fixture(name: str, n: int = 12, halo: int = 2) -> ContractReport:
     """Verify one deliberately broken fixture; the report MUST come
     back with violations (asserted by tests and the CLI's
@@ -62,6 +99,28 @@ def run_fixture(name: str, n: int = 12, halo: int = 2) -> ContractReport:
         prog = broken_deep_program(n=n, halo=halo, temporal_block=2)
         verify_deep_program(prog, report, n, halo, temporal_block=2,
                             subject="fixture:deep_depth")
+    elif name == "illegal_plan":
+        from ..plan.rules import check_plan
+
+        plan = broken_plan(n=n, halo=halo)
+        violations = check_plan(plan)
+        for v in violations:
+            report.fail("plan.rules." + v.rule,
+                        f"fixture:illegal_plan [{plan.key()}]",
+                        v.pointer)
+        if not violations:
+            # The rule lost its teeth: a clean report here exits 0,
+            # which the CLI/tier-1 assertions turn into a loud CI
+            # failure.
+            report.ok("plan.rules", "fixture:illegal_plan",
+                      "ACCEPTED an illegal plan — rule table broken")
+    elif name == "proof_fingerprint":
+        from ..geometry.connectivity import schedule_perms as _perms
+        from ..plan.proof import verify_stamp
+
+        stamp = broken_proof_stamp()
+        verify_stamp(stamp, _perms(), report,
+                     subject="fixture:proof_fingerprint")
     else:
         raise ValueError(
             f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -353,12 +353,27 @@ _NESTED_SECTIONS = {
 }
 
 
+def _suggest(unknown, valid) -> str:
+    """Did-you-mean tail for unknown-key errors: the closest valid
+    name per typo (difflib ratio), so a plan-layer config mistake
+    (``overlap_exchang``, ``temporal_blocks``) names its fix."""
+    import difflib
+
+    hints = []
+    for k in sorted(unknown):
+        close = difflib.get_close_matches(k, valid, n=1, cutoff=0.6)
+        if close:
+            hints.append(f"{k!r} -> did you mean {close[0]!r}?")
+    return (" (" + "; ".join(hints) + ")") if hints else ""
+
+
 def _build_section(cls, data: dict):
     fields = {f.name: f for f in dataclasses.fields(cls)}
     unknown = set(data) - set(fields)
     if unknown:
         raise ValueError(
-            f"unknown {cls.__name__} keys {sorted(unknown)}; valid: {sorted(fields)}"
+            f"unknown {cls.__name__} keys {sorted(unknown)}; valid: "
+            f"{sorted(fields)}{_suggest(unknown, fields)}"
         )
     # Coerce to the declared field types: YAML 1.1 parses exponent
     # literals without a sign ("1.0e14") as *strings*, and users write
@@ -422,7 +437,8 @@ def load_config(source: Any = None) -> Config:
     unknown = set(data) - set(_SECTIONS)
     if unknown:
         raise ValueError(
-            f"unknown config sections {sorted(unknown)}; valid: {sorted(_SECTIONS)}"
+            f"unknown config sections {sorted(unknown)}; valid: "
+            f"{sorted(_SECTIONS)}{_suggest(unknown, _SECTIONS)}"
         )
     for name, cls in _SECTIONS.items():
         if name in data:

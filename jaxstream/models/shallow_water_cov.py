@@ -271,6 +271,7 @@ class CovariantShallowWater(SWEBase):
         ``precision``; filter-cycling (``interval``) stays on 'split'.
         """
         from ..ops.pallas.precision import resolve_stage_precision
+        from ..plan import rules as plan_rules
 
         if self._pallas_rhs is None:
             raise ValueError("make_fused_step requires backend='pallas'")
@@ -292,12 +293,41 @@ class CovariantShallowWater(SWEBase):
                     "ensemble > 0 requires the compact carry (the "
                     "extended-state stepper has no batched form)")
             if self.nu4 != 0.0:
-                raise ValueError(
-                    "ensemble > 0 supports nu4 = 0 only (the del^4 "
-                    "filter kernels are not batched yet); run "
-                    "ensemble_impl='vmap' over a nu4 stepper manually "
-                    "if needed")
+                plan_rules.fail("fused-ensemble-nu4")
+            if carry_dtype is not None:
+                # Deliberate round-16 tightening: the batched carry
+                # has no encode/decode plumbing or parity coverage —
+                # reject the pair explicitly (the same rule plan_for
+                # rejects the config with) instead of building an
+                # untested composition.
+                plan_rules.fail("carry-needs-single-member")
         interpret = self.backend == "pallas_interpret"
+
+        def _proofed(step):
+            from ..plan.plan import CapabilityPlan
+            from ..plan.proof import attach_proof
+
+            if carry_dtype is None:
+                carry = "f32"
+            else:
+                dts = (tuple(carry_dtype)
+                       if isinstance(carry_dtype, (tuple, list))
+                       else (carry_dtype,))
+                carry = ("mixed16" if any(
+                    jnp.issubdtype(jnp.dtype(d), jnp.integer)
+                    for d in dts) else "bf16")
+            return attach_proof(step, plan_rules.normalize(
+                CapabilityPlan(
+                    tier="fused", n=self.grid.n, halo=self.grid.halo,
+                    temporal_block=temporal_block,
+                    ensemble=max(1, ensemble),
+                    stage=("bf16" if precision is not None
+                           and precision.compute == "bf16" else "f32"),
+                    strips=("bf16" if precision is not None
+                            and precision.strips == "bf16" else "f32"),
+                    carry=carry,
+                    nu4=self.nu4 != 0.0, nu4_mode=nu4_mode,
+                    backend="pallas", covariant=True)))
 
         def _blocked(step1):
             if temporal_block == 1:
@@ -312,36 +342,31 @@ class CovariantShallowWater(SWEBase):
                 raise ValueError("nu4 > 0 requires the compact carry")
             if (carry_dtype is not None or h_offset or h_scale != 1.0
                     or u_scale != 1.0 or _ablate_seam):
-                raise ValueError("carry_dtype/h_offset/u_scale/"
-                                 "_ablate_seam are not supported on the "
-                                 "nu4 paths")
+                plan_rules.fail("nu4-no-carry-encoding")
             if nu4_mode == "stage" and precision is not None:
-                raise ValueError(
-                    "nu4_mode='stage' is the f32 parity oracle and "
-                    "takes no precision policy; use nu4_mode='split' "
-                    "or 'refused'")
+                plan_rules.fail("nu4-stage-oracle-f32")
             from ..ops.pallas.swe_cov import (
                 make_fused_ssprk3_cov_nu4,
                 make_fused_ssprk3_cov_refused_nu4,
                 make_fused_ssprk3_cov_split_nu4)
 
             if nu4_mode == "refused":
-                return _blocked(make_fused_ssprk3_cov_refused_nu4(
+                return _proofed(_blocked(make_fused_ssprk3_cov_refused_nu4(
                     self.grid, self.gravity, self.omega, dt, self.b_ext,
                     self.nu4, scheme=self.scheme, limiter=self.limiter,
                     interpret=interpret, precision=precision,
-                ))
+                )))
             if nu4_mode == "split":
-                return _blocked(make_fused_ssprk3_cov_split_nu4(
+                return _proofed(_blocked(make_fused_ssprk3_cov_split_nu4(
                     self.grid, self.gravity, self.omega, dt, self.b_ext,
                     self.nu4, scheme=self.scheme, limiter=self.limiter,
                     interpret=interpret, precision=precision,
-                ))
-            return _blocked(make_fused_ssprk3_cov_nu4(
+                )))
+            return _proofed(_blocked(make_fused_ssprk3_cov_nu4(
                 self.grid, self.gravity, self.omega, dt, self.b_ext,
                 self.nu4, scheme=self.scheme, limiter=self.limiter,
                 interpret=interpret,
-            ))
+            )))
         from ..ops.pallas.swe_cov import (
             make_fused_ssprk3_cov_inkernel, make_fused_ssprk3_cov_multistep)
 
@@ -367,16 +392,16 @@ class CovariantShallowWater(SWEBase):
                 step.ensemble = ensemble
             if temporal_block > 1:
                 step.steps_per_call = temporal_block
-            return step
+            return _proofed(step)
         if (carry_dtype is not None or h_offset or h_scale != 1.0
                 or u_scale != 1.0 or _ablate_seam):
             raise ValueError("carry_dtype/h_offset/u_scale/_ablate_seam "
                              "require the compact carry")
-        return _blocked(make_fused_ssprk3_cov_inkernel(
+        return _proofed(_blocked(make_fused_ssprk3_cov_inkernel(
             self.grid, self.gravity, self.omega, dt, self.b_ext,
             scheme=self.scheme, limiter=self.limiter,
             interpret=interpret, precision=precision,
-        ))
+        )))
 
     def initial_state(self, h_ext, v_ext) -> State:
         """From extended Cartesian fields (the IC functions' output)."""

@@ -71,19 +71,29 @@ def make_stepper_for(model, setup, example_state, dt: float,
     bf16`` / ``comm_probe.temporal_block_plan(strip_dtype_bytes=2)``.
     """
     from ..ops.pallas.precision import resolve_stage_precision
+    from ..plan import rules as plan_rules
+    from ..plan.proof import attach_proof
 
     if resolve_stage_precision(precision) is not None:
-        raise ValueError(
-            "the per-stage precision policy rides the single-device "
-            "fused covariant stepper (make_fused_step(precision=...)); "
-            "the sharded/classic tiers built here run f32 numerics — "
-            "drop the precision: block, or run single-device; wire-byte "
-            "accounting for 16-bit strips is available via "
-            "scripts/comm_probe.py --strip-dtype bf16")
+        # One source of truth for the pointer prose: the plan-layer
+        # rule table (the same rule plan_for rejects the config with,
+        # statically, before any trace).
+        plan_rules.fail("stage-policy-needs-fused")
     if temporal_block is None:
         k = 1 if setup is None else getattr(setup, "temporal_block", 1)
     else:
         k = temporal_block
+
+    mesh = getattr(setup, "mesh", None)
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+
+    def _stamped(step, tier):
+        return attach_proof(step, _args_plan(
+            model, tier, overlap=bool(getattr(setup, "overlap_exchange",
+                                              False)),
+            temporal_block=k, ensemble=ensemble, scheme=scheme,
+            num_devices=n_dev))
+
     if setup is not None and setup.use_shard_map:
         if hasattr(model, "exchange_u"):
             # Covariant formulation: its explicit paths carry the
@@ -95,40 +105,33 @@ def make_stepper_for(model, setup, example_state, dt: float,
                                     make_sharded_cov_stepper)
             from .shard_cov_block import make_sharded_cov_block_stepper
 
+            blocked_mesh = (setup.panel == 6 and setup.sy == setup.sx
+                            and setup.sy > 1)
             if scheme != "ssprk3":
-                raise ValueError(
-                    "the explicit covariant shard path implements ssprk3 "
-                    f"only; got scheme={scheme!r}"
-                )
+                plan_rules.fail("explicit-cov-ssprk3", plan=None,
+                                scheme=scheme)
             if ensemble:
                 if setup.sy * setup.sx != 1:
-                    raise ValueError(
-                        "batched ensemble stepping is wired for the "
-                        "face tier (one face per device, optionally x "
-                        "member shards); set tiles_per_edge: 1 — got a "
-                        f"{setup.sy}x{setup.sx} sub-panel split")
-                return make_sharded_cov_ensemble_stepper(
+                    plan_rules.fail("ensemble-face-tier")
+                return _stamped(make_sharded_cov_ensemble_stepper(
                     model, setup, dt, ensemble, temporal_block=k,
-                    donate=donate)
-            if setup.panel == 6 and setup.sy == setup.sx and setup.sy > 1:
-                return make_sharded_cov_block_stepper(
-                    model, setup, dt, temporal_block=k, donate=donate)
-            return make_sharded_cov_stepper(model, setup, dt,
-                                            temporal_block=k,
-                                            donate=donate)
+                    donate=donate), "face")
+            if blocked_mesh:
+                return _stamped(make_sharded_cov_block_stepper(
+                    model, setup, dt, temporal_block=k,
+                    donate=donate), "face_block")
+            return _stamped(make_sharded_cov_stepper(
+                model, setup, dt, temporal_block=k, donate=donate),
+                "face")
         if ensemble:
-            raise ValueError(
-                "batched ensemble stepping is wired for the covariant "
-                "explicit tiers and the GSPMD/single-device paths; set "
-                "model.name: shallow_water_cov or use_shard_map: false")
+            plan_rules.fail("ensemble-needs-cov-or-gspmd")
         if k > 1:
-            raise ValueError(
-                "parallelization.temporal_block > 1 is wired for the "
-                "covariant explicit tiers, the single-device fused "
-                "stepper, the GSPMD path, and the factored TT tier; the "
-                "Cartesian explicit shard_map path steps serially — set "
-                "temporal_block: 1 or model.name: shallow_water_cov")
-        return make_sharded_stepper(model, setup, example_state, dt, scheme)
+            plan_rules.fail("temporal-block-cartesian")
+        return _stamped(
+            make_sharded_stepper(model, setup, example_state, dt,
+                                 scheme), "cartesian_shard")
+    single = setup is None or setup.mesh is None
+    tier = "classic" if single else "gspmd"
     base = model.make_step(dt, scheme)
     if ensemble:
         # GSPMD/single-device ensemble: vmap the model step over the
@@ -152,7 +155,7 @@ def make_stepper_for(model, setup, example_state, dt: float,
         step.ensemble = int(ensemble)
         if k > 1:
             step.steps_per_call = k
-        return step
+        return _stamped(step, tier)
     if k > 1:
         # GSPMD path: exact k-step fusion under one jit — one dispatch
         # per block, collectives unchanged (XLA may still pipeline
@@ -166,8 +169,32 @@ def make_stepper_for(model, setup, example_state, dt: float,
             return jitted(y, t)
 
         step.steps_per_call = k
-        return step
-    return jax.jit(base, donate_argnums=(0,) if donate else ())
+        return _stamped(step, tier)
+    return _stamped(jax.jit(base, donate_argnums=(0,) if donate else ()),
+                    tier)
+
+
+def _args_plan(model, tier: str, overlap: bool, temporal_block: int,
+               ensemble: int, scheme: str, num_devices: int):
+    """A :class:`~jaxstream.plan.plan.CapabilityPlan` reconstructed
+    from direct factory arguments (the proof-stamp source for callers
+    that bypass ``plan_for``'s config resolution)."""
+    from ..plan.plan import CapabilityPlan
+    from ..plan.rules import normalize
+
+    grid = getattr(model, "grid", None)
+    return normalize(CapabilityPlan(
+        tier=tier,
+        n=getattr(grid, "n", 0), halo=getattr(grid, "halo", 2),
+        scheme=scheme, overlap=overlap,
+        temporal_block=max(1, temporal_block or 1),
+        ensemble=max(1, int(ensemble or 1)),
+        nu4=getattr(model, "nu4", 0.0) != 0.0,
+        num_devices=num_devices,
+        use_shard_map=tier in ("face", "face_block",
+                               "cartesian_shard"),
+        backend=getattr(model, "backend", "jnp") or "jnp",
+        covariant=hasattr(model, "exchange_u")))
 
 
 def _grid_arrays(grid: CubedSphereGrid):

@@ -1,0 +1,20 @@
+"""Proof-carrying capability plans (round 16).
+
+``config -> plan_for() -> CapabilityPlan -> build_stepper()`` — the
+single declarative build pipeline over every execution tier.  See
+:mod:`jaxstream.plan.plan` (resolution), :mod:`jaxstream.plan.rules`
+(the composition-rule table + plan-space enumeration) and
+:mod:`jaxstream.plan.proof` (per-stepper proof stamps).
+"""
+
+from .plan import CapabilityPlan, PlanError, plan_for
+from .proof import ProofStamp, attach_proof, build_proof, verify_stamp
+from .rules import (RULES, RULES_VERSION, check_plan, enumerate_plans,
+                    plan_space_keys, reject_illegal)
+
+__all__ = [
+    "CapabilityPlan", "PlanError", "plan_for",
+    "ProofStamp", "attach_proof", "build_proof", "verify_stamp",
+    "RULES", "RULES_VERSION", "check_plan", "enumerate_plans",
+    "plan_space_keys", "reject_illegal",
+]

@@ -1,0 +1,149 @@
+"""Proof stamps: every built stepper carries its verification verdict.
+
+A :class:`ProofStamp` is the machine-checked provenance of one built
+stepper: which capability plan it implements (:attr:`plan_key`), the
+canonical race-free schedule digest its exchanges must realize
+(:attr:`schedule_fingerprint` — None for tiers with no explicit
+collectives), the rule-table version the legality check ran against
+(:attr:`rules_version`), and the verdict:
+
+* ``"verified"`` — the plan passed the rule table AND its capability
+  key is inside the enumerated plan space
+  (:func:`jaxstream.plan.rules.plan_space_keys`), which
+  ``jaxstream.analysis.contracts`` traces and jaxpr-audits wholesale
+  (collective counts vs analytic plans, overlap windows, dtype
+  census, callback/donation invariants) in every tier-1 gate.
+* ``"schedule_verified"`` — tiers the in-process device pool cannot
+  trace (the 24-device block mesh): the pure exchange-schedule pass
+  still proves their programs against the seam graph; the jaxpr-level
+  audit is out of reach by construction.
+* ``"rules_only"`` — legal by the table but outside the enumerated
+  axes (e.g. an exotic axis value): the stamp says so loudly instead
+  of implying coverage that does not exist.
+
+``comm_probe`` plans, the bench ``contract_check`` stamp and the serve
+telemetry manifest all surface these fields;
+:func:`verify_stamp` is the analyzer's cross-check that a stamp's
+declared fingerprint matches an actually-traced schedule (the
+``proof_fingerprint`` seeded-broken fixture keeps that check loud).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from . import rules
+
+__all__ = ["ProofStamp", "build_proof", "attach_proof",
+           "verify_stamp"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProofStamp:
+    plan_key: str
+    schedule_fingerprint: Optional[str]
+    rules_version: int
+    jaxpr_audit: str     # 'matrix' | 'schedule_only' | 'uncovered'
+    verdict: str         # 'verified' | 'schedule_verified' | 'rules_only'
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self):
+        fp = self.schedule_fingerprint or "-"
+        return (f"proof[{self.plan_key}] sched={fp} "
+                f"rules=v{self.rules_version} audit={self.jaxpr_audit} "
+                f"verdict={self.verdict}")
+
+
+def build_proof(plan) -> ProofStamp:
+    """Stamp one (already rule-checked) plan.
+
+    Raises :class:`~jaxstream.plan.rules.PlanError` if the plan is in
+    fact illegal — a stamp can never be minted for a plan the table
+    rejects.
+    """
+    plan = rules.reject_illegal(plan)
+    key = plan.key()
+    if plan.tier in rules.SCHEDULE_ONLY_TIERS:
+        audit, verdict = "schedule_only", "schedule_verified"
+    elif plan.class_key() in rules.plan_space_keys():
+        audit, verdict = "matrix", "verified"
+    else:
+        audit, verdict = "uncovered", "rules_only"
+    return ProofStamp(
+        plan_key=key,
+        schedule_fingerprint=plan.schedule_fingerprint(),
+        rules_version=rules.RULES_VERSION,
+        jaxpr_audit=audit, verdict=verdict)
+
+
+#: Stepper attributes the attach wrapper must preserve — integrators
+#: and servers read these with getattr.
+_CARRIED_ATTRS = ("steps_per_call", "ensemble")
+
+
+def attach_proof(step, plan) -> object:
+    """Attach ``step.proof = build_proof(plan)``; falls back to a
+    transparent wrapper for callables that refuse attributes (jitted
+    functions).  Returns the stamped callable."""
+    proof = build_proof(plan)
+    try:
+        step.proof = proof
+        return step
+    except (AttributeError, TypeError):
+        pass
+    orig = step
+
+    def stamped(*args, **kwargs):
+        return orig(*args, **kwargs)
+
+    stamped.__wrapped__ = orig
+    stamped.proof = proof
+    for name in _CARRIED_ATTRS:
+        if hasattr(orig, name):
+            setattr(stamped, name, getattr(orig, name))
+    return stamped
+
+
+def verify_stamp(stamp: ProofStamp, traced_perms=None,
+                 report=None, subject: str = "proof"):
+    """Cross-check one stamp against reality.
+
+    * rules version current (a stale stamp's verdict is void);
+    * when ``traced_perms`` is given (the per-stage ``(src, dst)``
+      pair lists recovered from a traced jaxpr), the stamp's declared
+      schedule fingerprint must equal the traced schedule's digest —
+      the check the ``proof_fingerprint`` fixture seeds broken.
+
+    Records into ``report`` (a
+    :class:`jaxstream.analysis.report.ContractReport`) when given;
+    always returns the list of violation strings.
+    """
+    problems = []
+    if stamp.rules_version != rules.RULES_VERSION:
+        problems.append(
+            f"stamp rules_version v{stamp.rules_version} != current "
+            f"v{rules.RULES_VERSION} — the verdict predates the "
+            f"current rule table")
+    if traced_perms is not None:
+        from ..geometry.connectivity import schedule_fingerprint
+
+        traced = schedule_fingerprint(traced_perms)
+        if stamp.schedule_fingerprint is None:
+            problems.append(
+                "stamp declares no exchange schedule but the traced "
+                "stepper issues ppermutes")
+        elif stamp.schedule_fingerprint != traced:
+            problems.append(
+                f"stamp declares schedule {stamp.schedule_fingerprint} "
+                f"but the traced schedule digests to {traced} — the "
+                f"proof does not describe this stepper")
+    if report is not None:
+        if problems:
+            for p in problems:
+                report.fail("proof.stamp", subject, p)
+        else:
+            report.ok("proof.stamp", subject)
+    return problems

@@ -83,6 +83,8 @@ from ..physics import initial_conditions as ics
 from ..stepping import SCHEMES, integrate_masked, vmap_ensemble
 from ..utils.logging import get_logger
 from .placement import PLACEMENT_MODES, BucketPlan, plan_placement
+from ..plan import rules as _plan_rules
+from ..plan.rules import RULES_VERSION as _PLAN_RULES_VERSION
 from .queue import (AdmissionRefused, QueueFull, RequestQueue,
                     ServerDraining)
 from .request import RequestResult, ScenarioRequest
@@ -137,7 +139,7 @@ class _Bucket:
 
     def __init__(self, group: str, B: int, seg_fn, extract_fn, inject_fn,
                  axes, stack, member_carry, plan: BucketPlan,
-                 mesh=None, carry_sh=None, rep_sh=None):
+                 mesh=None, carry_sh=None, rep_sh=None, proof=None):
         self.group = group
         self.B = B
         self.seg = seg_fn
@@ -146,6 +148,9 @@ class _Bucket:
         self.axes = axes
         self.plan = plan
         self.mesh = mesh
+        #: Round 16: the bucket stepper's capability proof stamp
+        #: (jaxstream.plan.proof) — surfaced in stats and telemetry.
+        self.proof = proof
         self._carry_sh = carry_sh
         self._rep = rep_sh
         self._stack = stack
@@ -203,38 +208,22 @@ class EnsembleServer:
         cfg = self.config
         s = cfg.serve
         if cfg.model.numerics != "dense":
-            raise ValueError(
-                "the serving tier runs the dense covariant solvers; "
-                "set model.numerics: dense")
+            _plan_rules.fail("serve-dense")
         if cfg.model.name != "shallow_water_cov":
             # 'auto' would make the same config's Simulation build the
             # CARTESIAN model for tc2/tc5 — a server that silently
             # swapped models would break the documented B=1
             # bitwise-vs-Simulation contract.
-            raise ValueError(
-                f"model.name={cfg.model.name!r}: the serving tier runs "
-                "the covariant production solver only — set model.name: "
-                "shallow_water_cov (so an unbatched Simulation of the "
-                "same config is the bitwise reference)")
+            _plan_rules.fail("serve-covariant")
         if (cfg.precision.stage != "f32"
                 or cfg.precision.strips not in ("auto", "f32")
                 or cfg.precision.carry != "f32"):
-            raise ValueError(
-                "the serving tier runs f32 numerics; the precision: "
-                "block is not threaded through the bucket steppers yet "
-                "— drop it rather than silently serving f32")
+            _plan_rules.fail("serve-f32")
         if cfg.parallelization.temporal_block > 1:
-            raise ValueError(
-                "parallelization.temporal_block > 1 is not wired into "
-                "the serving tier (per-member masking counts single "
-                "steps); set temporal_block: 1")
+            _plan_rules.fail("serve-no-temporal-block")
         if (cfg.parallelization.use_shard_map
                 or cfg.parallelization.tiles_per_edge > 1):
-            raise ValueError(
-                "the serving tier drives devices through the "
-                "serve.placement: block (mode member/panel), not the "
-                "parallelization flags — drop use_shard_map/"
-                "tiles_per_edge (they configure Simulation runs)")
+            _plan_rules.fail("serve-placement-not-shard-flags")
         if s.guards not in ("off", "evict", "halt"):
             raise ValueError(
                 f"serve.guards={s.guards!r}; valid: 'off', 'evict', "
@@ -272,25 +261,12 @@ class EnsembleServer:
                     f"CPU testing, start Python with XLA_FLAGS="
                     f"--xla_force_host_platform_device_count={n_dev}.")
             if p.mode == "member" and cfg.model.backend != "jnp":
-                raise ValueError(
-                    "placement mode 'member' partitions the vmapped "
-                    "classic stepper over the member mesh axis; the "
-                    "fused Pallas kernels fold every member into ONE "
-                    "custom call GSPMD cannot split — set "
-                    "model.backend: jnp, or placement mode: panel "
-                    "(the shard_map per-face kernel path)")
+                _plan_rules.fail("serve-member-jnp")
             if p.mode == "panel":
                 if not self._grouping:
-                    raise ValueError(
-                        "placement mode 'panel' runs the shard_map "
-                        "ensemble stepper, which bakes orography per "
-                        "device — set serve.group_by_orography: true "
-                        "(mixed-orography batches are a member-"
-                        "parallel / single-chip feature)")
+                    _plan_rules.fail("serve-panel-grouping")
                 if cfg.time.scheme != "ssprk3":
-                    raise ValueError(
-                        "placement mode 'panel' runs the explicit "
-                        "ssprk3 face tier; set time.scheme: ssprk3")
+                    _plan_rules.fail("serve-panel-ssprk3")
             self._plans: Dict[int, BucketPlan] = plan_placement(
                 self.buckets, n_dev, p.mode)
             self._devices = list(devs[:n_dev])
@@ -344,6 +320,10 @@ class EnsembleServer:
                     "guards": s.guards,
                     "placement": p.mode,
                     "group_by_orography": self._grouping,
+                    # Round 16: rule-table version the bucket proof
+                    # stamps were minted against (each 'serve' record
+                    # then names its bucket's plan + verdict).
+                    "rules_version": _PLAN_RULES_VERSION,
                 }))
         self._fault_fired = False
         self._closed = False
@@ -606,6 +586,30 @@ class EnsembleServer:
                     y[k], upd, idx, axis=ax)
             return out
 
+        # Round 16: the bucket's capability proof stamp — which plan
+        # this compiled masked segment implements, and whether the
+        # static matrix covers it (jaxstream.plan).
+        from ..plan.plan import CapabilityPlan
+        from ..plan.proof import build_proof
+        from ..plan.rules import normalize as plan_normalize
+
+        tier = {"fused": "fused", "vmap": "classic",
+                "vmap_b": "classic", "panel": "face"}[impl]
+        if plan.mode == "member":
+            tier = "gspmd"
+        proof = build_proof(plan_normalize(CapabilityPlan(
+            tier=tier, n=cfg.grid.n, halo=self.grid.halo,
+            scheme=cfg.time.scheme, ensemble=B,
+            overlap=(cfg.parallelization.overlap_exchange
+                     and plan.mode == "panel"),
+            donate=cfg.serve.donate, serving=True,
+            placement=("off" if plan.mode == "single" else plan.mode),
+            serve_grouping=self._grouping,
+            num_devices=plan.num_devices,
+            backend=("pallas" if impl == "fused"
+                     else cfg.model.backend),
+            covariant=True)))
+
         donate = (0,) if cfg.serve.donate else ()
         if mesh is None:
             seg_j = jax.jit(seg_body, donate_argnums=donate)
@@ -624,7 +628,7 @@ class EnsembleServer:
                             out_shardings=carry_sh)
         return _Bucket(group, B, seg_j, ex_j, inj_j, axes, stack,
                        member_carry, plan, mesh=mesh,
-                       carry_sh=carry_sh, rep_sh=rep)
+                       carry_sh=carry_sh, rep_sh=rep, proof=proof)
 
     def _impls_for(self, group: str, plan: BucketPlan) -> List[str]:
         """Candidate stepper impls for one bucket, most preferred
@@ -740,6 +744,14 @@ class EnsembleServer:
             "buckets": {str(b): dataclasses.asdict(pl)
                         for b, pl in sorted(self._plans.items())},
         }
+
+    def bucket_proofs(self) -> Dict[str, Optional[dict]]:
+        """Per warm bucket: the capability proof stamp of its compiled
+        masked segment (round 16) — plan key, canonical schedule
+        fingerprint, rules version, matrix-coverage verdict."""
+        return {f"{g}/B{B}": (bk.proof.to_json()
+                              if bk.proof is not None else None)
+                for (g, B), bk in sorted(self._buckets.items())}
 
     # ------------------------------------------------------------ admission
     def refusal_reasons(self) -> List[str]:
@@ -1064,6 +1076,11 @@ class EnsembleServer:
             if self._sink is not None:
                 rec = {
                     "kind": "serve", "bucket": B, "group": group,
+                    "plan": (bk.proof.plan_key
+                             if bk.proof is not None else None),
+                    "proof_verdict": (bk.proof.verdict
+                                      if bk.proof is not None
+                                      else None),
                     "occupancy": round(active_before / B, 4),
                     "utilization": round(member_steps / (B * seg), 4),
                     "queue_depth": len(self.queue),

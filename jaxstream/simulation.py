@@ -48,6 +48,8 @@ from .obs.sink import TelemetrySink, run_manifest
 from .parallel.mesh import (setup_ensemble_sharding, setup_sharding,
                             shard_ensemble_state, shard_state)
 from .parallel.sharded_model import make_stepper_for
+from .plan import build_proof, plan_for
+from .plan import rules as plan_rules
 from .physics import initial_conditions as ics
 from .stepping import (integrate, integrate_with_metrics, jit_integrate,
                        time_carry)
@@ -105,6 +107,13 @@ class Simulation:
     def __init__(self, config: Any = None):
         self.config: Config = load_config(config)
         cfg = self.config
+        # Round 16: resolve the capability plan FIRST — illegal
+        # feature compositions are rejected statically by the
+        # declarative rule table (jaxstream.plan.rules), before any
+        # grid build, device placement or trace, with the same pointer
+        # messages the legacy scattered raises carried.
+        self.plan = plan_for(cfg)
+        self.proof = None
         dtype = _DTYPES[cfg.grid.dtype]
         mcfg = cfg.model
         halo = cfg.grid.halo
@@ -296,14 +305,18 @@ class Simulation:
                     type(e).__name__, e,
                 )
         if (pkw or p_enc is not None) and self._fused_step is None:
-            raise ValueError(
-                "the precision: block (stage/strips/carry != f32) and "
-                "model.nu4_mode != 'split' ride the single-device fused "
-                "covariant stepper: they need model.backend: pallas, "
-                "time.scheme: ssprk3, model.numerics: dense and "
-                "parallelization.num_devices: 1 (sharded tiers take the "
-                "wire accounting only — scripts/comm_probe.py "
-                "--strip-dtype bf16)")
+            plan_rules.fail("precision-needs-fused-path")
+        # The run's proof stamp: rules verdict + schedule fingerprint +
+        # enumerated-matrix coverage for the stepper that will actually
+        # execute (the fused gate above may have fallen back to the
+        # classic path — re-resolve the tier so the stamp is honest).
+        actual = self.plan
+        if actual.tier == "fused" and self._fused_step is None:
+            import dataclasses as _dc
+
+            actual = plan_rules.normalize(
+                _dc.replace(actual, tier="classic"))
+        self.proof = build_proof(actual)
         self._segment_cache: Dict[int, Callable] = {}
 
         # Async host pipeline (io.async_pipeline, round 9): the writer
@@ -426,6 +439,12 @@ class Simulation:
                     "num_devices": cfg.parallelization.num_devices,
                     "use_shard_map": cfg.parallelization.use_shard_map,
                     "temporal_block": tb,
+                    # Round 16: the run's capability plan + proof
+                    # verdict ride the manifest so telemetry names the
+                    # verified execution strategy.
+                    "plan": self.plan.key(),
+                    "proof": (self.proof.to_json()
+                              if self.proof is not None else None),
                 })
             sink = TelemetrySink(o.sink, manifest)
         # Step-0 reference for the drift columns: one eager evaluation
@@ -475,14 +494,10 @@ class Simulation:
                 f"precision.carry={pcfg.carry!r}; valid: 'f32', 'bf16', "
                 "'mixed16'")
         if self.members > 1:
-            raise ValueError(
-                "precision.carry encodings are wired for single runs "
-                "(members: 1); the batched ensemble carry stays f32")
+            plan_rules.fail("carry-needs-single-member")
         m = self.model
         if m is None or not hasattr(m, "encode_carry"):
-            raise ValueError(
-                "precision.carry != 'f32' needs the covariant dense "
-                "model (model.numerics: dense, shallow-water family)")
+            plan_rules.fail("carry-needs-covariant")
         import jax.numpy as jnp
 
         h = self.state["h"]

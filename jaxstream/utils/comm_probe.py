@@ -86,9 +86,12 @@ def temporal_block_plan(n: int, halo: int, temporal_block: int,
     stages = rk_stages * k
     windows = [n + 2 * (D - (i + 1) * halo) for i in range(stages)]
     redundant = [(w * w - n * n) / float(n * n) for w in windows]
+    from ..plan.rules import RULES_VERSION
+
     return {
         "temporal_block": k,
         "schedule_fingerprint": schedule_fingerprint(),
+        "rules_version": RULES_VERSION,
         "deep_halo_width": D,
         "fits": n >= D,
         "ppermutes_per_step": 4.0 / k,
@@ -138,12 +141,15 @@ def batched_exchange_plan(n: int, halo: int, members: int,
     if halo < 1 or n < 1:
         raise ValueError(f"need n >= 1 and halo >= 1, got n={n}, "
                          f"halo={halo}")
+    from ..plan.rules import RULES_VERSION
+
     B = members
     per_step = 4 * rk_stages
     payload = B * 3 * halo * n * dtype_bytes
     return {
         "members": B,
         "schedule_fingerprint": schedule_fingerprint(),
+        "rules_version": RULES_VERSION,
         "ppermutes_per_step": float(per_step),
         "ppermutes_per_member_step": per_step / B,
         "serialized_ppermutes_per_member_step": float(per_step),
@@ -176,9 +182,12 @@ def serve_placement_plan(buckets, num_devices: int, n: int,
     from ..geometry.connectivity import schedule_fingerprint
     from ..serve.placement import placement_report
 
+    from ..plan.rules import RULES_VERSION
+
     out = placement_report(buckets, num_devices, n, halo,
                            dtype_bytes=dtype_bytes)
     out["schedule_fingerprint"] = schedule_fingerprint()
+    out["rules_version"] = RULES_VERSION
     return out
 
 
@@ -444,7 +453,9 @@ def format_report(result: dict) -> str:
                f"{100 * be['wire_bytes_saving_vs_f32']:.0f}% wire)"
                if be.get("wire_bytes_saving_vs_f32") else "")
             + (f" sched={be['schedule_fingerprint']}"
-               if be.get("schedule_fingerprint") else ""))
+               if be.get("schedule_fingerprint") else "")
+            + (f" rules=v{be['rules_version']}"
+               if be.get("rules_version") else ""))
     sp = result.get("serve_placement_plan")
     if sp:
         if sp.get("schedule_fingerprint"):
@@ -483,5 +494,7 @@ def format_report(result: dict) -> str:
                f"{100 * tb['wire_bytes_saving_vs_f32']:.0f}% wire)"
                if tb.get("wire_bytes_saving_vs_f32") else "")
             + (f" sched={tb['schedule_fingerprint']}"
-               if tb.get("schedule_fingerprint") else ""))
+               if tb.get("schedule_fingerprint") else "")
+            + (f" rules=v{tb['rules_version']}"
+               if tb.get("rules_version") else ""))
     return "\n".join(lines)

@@ -10,7 +10,7 @@ Usage::
 
     python scripts/analyze.py [n] [--json] [--schedules-only]
                               [--no-compile]
-                              [--fixture dropped_pair|deep_depth]
+                              [--fixture <name>]
 
 ``[n]`` is the face size of the check grid (default 12 — the matrix
 is resolution-independent; a bigger n only costs trace time).
@@ -18,7 +18,8 @@ is resolution-independent; a bigger n only costs trace time).
 no devices — the pre-commit mode).  ``--no-compile`` skips the two
 checks that need XLA compiles (donation aliasing, member-parallel
 zero-wire HLO), keeping the run trace-only.  ``--fixture`` verifies
-one of the seeded-broken regression schedules instead
+one of the seeded-broken regression fixtures instead (broken
+schedules, an illegal capability plan, a corrupted proof stamp)
 (:mod:`jaxstream.analysis.fixtures`): the checker must FAIL it, so the
 command exits nonzero — CI asserts both fixtures trip and every real
 schedule passes, proving the pass has teeth in the same gate that
@@ -65,8 +66,9 @@ def run(argv):
             continue
         if a == "--fixture":
             if i + 1 >= len(args) or args[i + 1].startswith("--"):
-                print("usage: analyze.py --fixture "
-                      "dropped_pair|deep_depth", file=sys.stderr)
+                print("usage: analyze.py --fixture <name> (one of "
+                      "jaxstream.analysis.fixtures.FIXTURES)",
+                      file=sys.stderr)
                 raise SystemExit(2)
             fixture = args[i + 1]
             consumed.add(i + 1)
@@ -77,7 +79,7 @@ def run(argv):
             # expensive, or weaker) mode with exit 0.
             print(f"analyze.py: unknown argument {a!r}; usage: "
                   f"analyze.py [n] [--json] [--schedules-only] "
-                  f"[--no-compile] [--fixture dropped_pair|deep_depth]",
+                  f"[--no-compile] [--fixture <name>]",
                   file=sys.stderr)
             raise SystemExit(2)
 

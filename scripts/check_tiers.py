@@ -83,6 +83,20 @@ every gate run self-checking):
    listening sockets, and anything but 127.0.0.1 leaks an open port
    to the network from every CI run.
 
+10. **Config sections stay documented; plan tests stay fast +
+    in-process** (round-16 capability-plan satellite).  Two halves:
+    (a) every section key in ``jaxstream/config.py``'s ``_SECTIONS``
+    table must appear as a top-level key inside a fenced config block
+    in ``docs/USAGE.md`` — a new config section whose docs never
+    landed is exactly the drift the plan layer exists to prevent
+    (the rule that rejects a knob should be one ``grep`` from the doc
+    that explains it); (b) a test module importing ``jaxstream.plan``
+    must carry NO ``slow`` markers and must not launch subprocesses —
+    the rule-table rejections, the enumerated plan space and the
+    proof-stamp checks are the static proof surface of the build
+    pipeline and must run in every fast gate on the in-process
+    virtual devices.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -137,6 +151,65 @@ _NETWORK_IMPORT_RE = re.compile(
 #: Anchored so real addresses merely CONTAINING the substring
 #: (10.0.0.0/8, 240.0.0.0) do not trip the lint.
 _WILDCARD_BIND_RE = re.compile(r"(?<![\d.])0\.0\.0\.0(?![\d.])")
+_PLAN_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.plan\b|import\s+jaxstream\.plan\b"
+    r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*plan\b)",
+    re.MULTILINE)
+#: Actual subprocess USAGE (an import or an attribute call), so a
+#: docstring merely mentioning the word does not trip rule 10b.
+_SUBPROC_USE_RE = re.compile(
+    r"^\s*(import|from)\s+subprocess\b|subprocess\.\w+",
+    re.MULTILINE)
+#: The _SECTIONS table in jaxstream/config.py: "name": SomeConfig,
+_SECTIONS_RE = re.compile(
+    r"^_SECTIONS\s*=\s*\{(.*?)\}", re.MULTILINE | re.DOTALL)
+_SECTION_KEY_RE = re.compile(r"\"(\w+)\"\s*:")
+_FENCE_RE = re.compile(r"^```[a-z]*\n(.*?)^```", re.MULTILINE | re.DOTALL)
+
+
+def config_sections(config_py: str):
+    """The ``_SECTIONS`` keys of jaxstream/config.py (regex — this
+    lint must stay import-light, no jax)."""
+    with open(config_py) as fh:
+        m = _SECTIONS_RE.search(fh.read())
+    if not m:
+        return None
+    return _SECTION_KEY_RE.findall(m.group(1))
+
+
+def documented_sections(usage_md: str):
+    """Top-level ``key:`` names inside USAGE.md's fenced blocks."""
+    with open(usage_md) as fh:
+        text = fh.read()
+    keys = set()
+    for block in _FENCE_RE.findall(text):
+        for line in block.splitlines():
+            m = re.match(r"^([A-Za-z_][A-Za-z0-9_]*):", line)
+            if m:
+                keys.add(m.group(1))
+    return keys
+
+
+def lint_config_docs(root: str):
+    """Rule 10a: every config section has a fenced USAGE.md block."""
+    config_py = os.path.join(root, "jaxstream", "config.py")
+    usage_md = os.path.join(root, "docs", "USAGE.md")
+    if not (os.path.exists(config_py) and os.path.exists(usage_md)):
+        return                      # repo layouts without the pair
+    sections = config_sections(config_py)
+    if sections is None:
+        yield (f"{os.path.relpath(config_py)}: could not locate the "
+               f"_SECTIONS table (rule 10a parses it textually — "
+               f"keep the literal dict form)")
+        return
+    documented = documented_sections(usage_md)
+    for name in sections:
+        if name not in documented:
+            yield (f"docs/USAGE.md: config section {name!r} "
+                   f"(_SECTIONS in jaxstream/config.py) has no fenced "
+                   f"``` config block showing a top-level '{name}:' "
+                   f"key — every section the plan layer can reject "
+                   f"must be documented where users write it")
 
 
 def registered_markers(pytest_ini: str) -> set:
@@ -219,6 +292,23 @@ def lint_file(path: str, allowed: set):
                    f"gateway tests open REAL listening sockets and "
                    f"must bind loopback (127.0.0.1) only, or every CI "
                    f"run exposes an open port to the network")
+    if _PLAN_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports jaxstream.plan but marks tests "
+                   f"slow — the capability-plan rejections, the "
+                   f"enumerated plan space and the proof-stamp "
+                   f"checks are the static proof surface of the "
+                   f"build pipeline and must run in every fast gate; "
+                   f"move the slow test to a module that does not "
+                   f"import jaxstream.plan")
+        if _SUBPROC_USE_RE.search(src):
+            yield (f"{rel}: imports jaxstream.plan but launches "
+                   f"subprocesses — plan/pipeline tests must run "
+                   f"IN-PROCESS on the conftest's virtual devices "
+                   f"(a subprocess rewrite would be forced slow by "
+                   f"rule 2, dropping the plan-space proof from the "
+                   f"fast gate); drive scripts/plan.py through its "
+                   f"importable main() instead")
     if _ANALYSIS_IMPORT_RE.search(src):
         if "slow" in used:
             yield (f"{rel}: imports jaxstream.analysis but marks tests "
@@ -254,6 +344,7 @@ def main(repo_root: str = None) -> int:
             continue
         violations += list(lint_file(os.path.join(tests_dir, name),
                                      allowed))
+    violations += list(lint_config_docs(root))
     for v in violations:
         print("check_tiers:", v)
     if not violations:

@@ -1,0 +1,112 @@
+"""Capability-plan explainer (CLI front end for jaxstream.plan).
+
+Usage::
+
+    python scripts/plan.py explain <config.yaml | YAML string>
+    python scripts/plan.py explain <config> --serve
+    python scripts/plan.py --enumerate [n] [--json]
+
+``explain`` resolves a config through ``plan_for`` and prints the
+normalized :class:`~jaxstream.plan.plan.CapabilityPlan` — tier, every
+composition knob, the capability key, the canonical schedule
+fingerprint (explicit-exchange tiers), the declared runtime parity
+budget, and the proof stamp the built stepper will carry.  An illegal
+composition prints the rule pointers and exits 2 — the same messages,
+from the same table, the factories raise at build time, shown here
+*statically* before any trace.  ``--serve`` resolves the config as an
+``EnsembleServer`` deployment instead of a Simulation run.
+
+``--enumerate`` walks the rule table and lists the complete legal plan
+space at the given resolution (default 12) with per-tier counts and
+the rule-table version — the exact space ``jaxstream.analysis``
+verifies and the bench ``contract_check`` stamp records.
+
+``--json`` prints one JSON line instead of the human table.  Pure
+config arithmetic: no devices, no jax tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _explain(source: str, serving: bool, as_json: bool) -> int:
+    from jaxstream.plan import PlanError, build_proof, plan_for
+
+    try:
+        plan = plan_for(source, serving=serving)
+    except PlanError as e:
+        if as_json:
+            print(json.dumps({
+                "ok": False,
+                "violations": [{"rule": v.rule, "pointer": v.pointer}
+                               for v in e.violations]}))
+        else:
+            print("ILLEGAL plan:" if e.violations else str(e))
+            for v in e.violations:
+                print(f"  [{v.rule}] {v.pointer}")
+        return 2
+    stamp = build_proof(plan)
+    if as_json:
+        print(json.dumps({"ok": True, "plan": plan.describe(),
+                          "proof": stamp.to_json()}))
+        return 0
+    d = plan.describe()
+    print(f"plan: {d.pop('key')}   (rules v{d.pop('rules_version')})")
+    fp = d.pop("schedule_fingerprint")
+    parity = d.pop("parity")
+    for k in sorted(d):
+        print(f"  {k:16s} {d[k]}")
+    print(f"  schedule         "
+          f"{fp or '- (no explicit exchange collectives)'}")
+    ref = parity["reference"] or "- (this IS the reference plan)"
+    budget = ("bitwise" if parity["budget"] == 0.0
+              else f"<= {parity['budget']:g} rel")
+    print(f"  parity           {budget} vs {ref}")
+    print(f"proof: {stamp}")
+    return 0
+
+
+def _enumerate(n: int, as_json: bool) -> int:
+    from collections import Counter
+
+    from jaxstream.plan import RULES_VERSION, enumerate_plans
+
+    plans = enumerate_plans(n=n)
+    if as_json:
+        print(json.dumps({
+            "n": n, "rules_version": RULES_VERSION,
+            "size": len(plans),
+            "keys": [p.key() for p in plans]}))
+        return 0
+    counts = Counter(("serve" if p.serving else p.tier)
+                     for p in plans)
+    print(f"legal capability-plan space at n={n} "
+          f"(rules v{RULES_VERSION}): {len(plans)} plans")
+    for tier, c in sorted(counts.items()):
+        print(f"  {tier:12s} {c}")
+    for p in plans:
+        print(f"  - {p.key()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    serving = "--serve" in args
+    args = [a for a in args if a not in ("--json", "--serve")]
+    if args and args[0] == "--enumerate":
+        n = int(args[1]) if len(args) > 1 and args[1].isdigit() else 12
+        return _enumerate(n, as_json)
+    if len(args) == 2 and args[0] == "explain":
+        return _explain(args[1], serving, as_json)
+    print(__doc__.split("Usage::", 1)[1].split("``explain``")[0],
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

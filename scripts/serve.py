@@ -122,6 +122,9 @@ def main(argv=None) -> int:
     placement = server.placement_summary()
     if placement is not None:
         summary["placement"] = placement
+    # Round 16: each warm bucket's capability proof stamp (plan key,
+    # schedule fingerprint, rules version, matrix-coverage verdict).
+    summary["bucket_proofs"] = server.bucket_proofs()
     print(json.dumps(summary))
     return 0 if server.stats["evicted"] == 0 else 1
 

@@ -151,6 +151,12 @@ def summarize(records):
                            default=1),
             "placement_modes": sorted(
                 {s["placement"] for s in serves if s.get("placement")}),
+            # Round 16: the capability plans the segments ran under,
+            # with their proof verdicts (a 'rules_only' here means a
+            # bucket ran OUTSIDE the verified matrix).
+            "plans": sorted({f"{s['plan']}:{s['proof_verdict']}"
+                             for s in serves
+                             if s.get("plan") is not None}),
             "chip_occupancy_mean": _chip_means("chip_occupancy"),
             "chip_utilization_mean": _chip_means("chip_utilization"),
             "timeline": [

@@ -131,24 +131,75 @@ def test_traced_broken_schedule_changes_fingerprint():
 
 
 # ---------------------------------------------------------------------
-# Full composition matrix (the shared run)
+# New seeded-broken fixtures (round 16): plan rules + proof stamps
+# ---------------------------------------------------------------------
+
+def test_fixture_illegal_plan_fails_loudly():
+    rep = fixtures.run_fixture("illegal_plan")
+    assert not rep.passed
+    checks = {v.check for v in rep.violations}
+    assert "plan.rules.stage-policy-needs-fused" in checks
+    # The rejection carries the legacy pointer, not generic prose.
+    assert any("comm_probe.py --strip-dtype" in v.detail
+               for v in rep.violations)
+
+
+def test_fixture_proof_fingerprint_fails_loudly():
+    rep = fixtures.run_fixture("proof_fingerprint")
+    assert not rep.passed
+    assert {v.check for v in rep.violations} == {"proof.stamp"}
+    assert any("does not describe this stepper" in v.detail
+               for v in rep.violations)
+
+
+# ---------------------------------------------------------------------
+# Full composition matrix (the shared run, enumerated from the plan
+# space — no hand-listed variants)
 # ---------------------------------------------------------------------
 
 def test_full_matrix_clean(full_run):
-    """Acceptance: the checker is clean on every stepper variant in
-    the current composition matrix."""
+    """Acceptance: the checker is clean on EVERY plan in the
+    enumerated legal space."""
     code, result = full_run
     assert result["violations"] == [], result["violations"]
     assert code == 0
     assert result["ok"] is True
     assert result["checks_run"] > 400
-    v = result["facts"]["variants"]
-    assert {"face_serialized", "face_overlap", "face_deep_k2",
-            "face_deep_k2_overlap", "ensemble_B2",
-            "ensemble_B2_overlap", "ensemble_B2_tb2",
-            "tt_serialized", "tt_overlap", "gspmd_6dev", "fused_f32",
-            "fused_bf16", "fused_bf16_tb2", "segment_loop_face",
-            "serve_panel", "serve_member"} <= set(v)
+    facts = result["facts"]
+    space = facts["plan_space"]
+    # >= the 16 previously hand-listed variants, and every enumerated
+    # plan actually audited (plus the segment-loop witness).
+    assert space["size"] >= 16
+    from jaxstream.plan.rules import RULES_VERSION
+
+    assert space["rules_version"] == RULES_VERSION
+    v = facts["variants"]
+    assert set(space["keys"]) <= set(v)
+    # The legacy 16-variant matrix, under its enumerated names —
+    # nothing the hand list covered fell out of the space.
+    assert {"face", "face+ov", "face+tb2", "face+ov+tb2",
+            "face+B2", "face+ov+B2", "face+tb2+B2",
+            "tt_sharded", "tt_sharded+ov", "gspmd", "fused",
+            "fused+bf16", "fused+tb2+bf16", "segment_loop_face",
+            "serve_panel+face", "serve_member+gspmd"} <= set(v)
+    # ...and the combos hand-listing missed are now verified too.
+    assert {"face+ov+tb2+B2", "fused+tb2", "gspmd+B2",
+            "tt_sharded+tb2", "serve_single+classic",
+            "serve_single+fused"} <= set(v)
+
+
+def test_no_hand_enumerated_variant_list():
+    """Acceptance: contracts.py contains no hand-enumerated variant
+    list — the matrix is the plan-space enumeration."""
+    import inspect
+
+    from jaxstream.analysis import contracts
+
+    src = inspect.getsource(contracts)
+    assert "enumerate_plans" in src
+    for legacy in ("face_serialized", "ensemble_B2", "fused_bf16_tb2",
+                   "tt_serialized"):
+        assert legacy not in src, legacy
 
 
 def test_collective_counts_match_plans_exactly(full_run):
@@ -167,12 +218,12 @@ def test_collective_counts_match_plans_exactly(full_run):
     p2 = batched_exchange_plan(n, halo, 2)
     tb = temporal_block_plan(n, halo, 2)
 
-    for name in ("face_serialized", "face_overlap"):
+    for name in ("face", "face+ov"):
         assert v[name]["ppermutes_per_step"] == \
             SERIALIZED_PPERMUTES_PER_STEP
         assert v[name]["payload_bytes_per_step"] == \
             p1["wire_bytes_per_member_step"]
-    for name in ("face_deep_k2", "face_deep_k2_overlap"):
+    for name in ("face+tb2", "face+ov+tb2"):
         assert v[name]["ppermutes_per_step"] == \
             tb["ppermutes_per_step"]
         assert v[name]["payload_bytes_per_step"] == \
@@ -180,30 +231,36 @@ def test_collective_counts_match_plans_exactly(full_run):
         # One 3*k*halo-deep strip per stage, conserved wire bytes.
         assert v[name]["payload_shapes"] == [
             [3, tb["deep_halo_width"], n]]
-    for name in ("ensemble_B2", "ensemble_B2_overlap",
-                 "ensemble_B2_tb2"):
+    for name in ("face+B2", "face+ov+B2", "face+tb2+B2",
+                 "face+ov+tb2+B2"):
         assert v[name]["ppermutes_per_step"] == \
             p2["ppermutes_per_step"]
         assert v[name]["payload_bytes_per_step"] == \
             p2["payload_bytes_per_ppermute"] * p2["ppermutes_per_step"]
         assert v[name]["payload_shapes"] == [[2, 3, halo, n]]
     # Exact temporal fusion: k x the per-step schedule in one call.
-    assert v["ensemble_B2_tb2"]["ppermutes_per_call"] == 24
-    assert v["ensemble_B2_tb2"]["rounds"] == [4] * 6
+    assert v["face+tb2+B2"]["ppermutes_per_call"] == 24
+    assert v["face+tb2+B2"]["rounds"] == [4] * 6
     # TT: depth-1 strips; overlap collapses 4 per-field exchanges
-    # into one batched schedule per RK stage.
-    assert v["tt_serialized"]["rounds"] == [16, 16, 16]
-    assert v["tt_overlap"]["rounds"] == [4, 4, 4]
-    assert v["tt_overlap"]["payload_shapes"] == [[4, 1, n]]
+    # into one batched schedule per RK stage; temporal fusion scales
+    # rounds by k at unchanged per-step counts.
+    assert v["tt_sharded"]["rounds"] == [16, 16, 16]
+    assert v["tt_sharded+ov"]["rounds"] == [4, 4, 4]
+    assert v["tt_sharded+ov"]["payload_shapes"] == [[4, 1, n]]
+    assert v["tt_sharded+tb2"]["rounds"] == [16] * 6
+    assert (v["tt_sharded+tb2"]["ppermutes_per_step"]
+            == v["tt_sharded"]["ppermutes_per_step"])
     # Serving placement vs the placement plan.
-    assert v["serve_panel"]["ppermutes_per_step"] == 12
-    assert (v["serve_panel"]["payload_bytes_per_step"]
-            == v["serve_panel"]["plan_payload_bytes_per_step"])
-    assert v["serve_member"]["plan_exchange_bytes_per_step"] == 0.0
-    assert v["serve_member"]["compiled_collective_permutes"] == 0
-    assert v["serve_member"]["compiled_all_to_alls"] == 0
+    assert v["serve_panel+face"]["ppermutes_per_step"] == 12
+    assert (v["serve_panel+face"]["payload_bytes_per_step"]
+            == v["serve_panel+face"]["plan_payload_bytes_per_step"])
+    assert v["serve_member+gspmd"]["plan_exchange_bytes_per_step"] \
+        == 0.0
+    assert v["serve_member+gspmd"]["compiled_collective_permutes"] == 0
+    assert v["serve_member+gspmd"]["compiled_all_to_alls"] == 0
     # GSPMD: schedule is compiler-inferred, nothing explicit to drop.
-    assert v["gspmd_6dev"]["ppermutes_per_call"] == 0
+    assert v["gspmd"]["ppermutes_per_call"] == 0
+    assert v["gspmd+B2"]["ppermutes_per_call"] == 0
 
 
 def test_schedule_fingerprints_consistent(full_run):
@@ -215,9 +272,8 @@ def test_schedule_fingerprints_consistent(full_run):
     fp = schedule_fingerprint()
     assert facts["schedule_fingerprint"] == fp
     v = facts["variants"]
-    for name in ("face_serialized", "face_overlap", "face_deep_k2",
-                 "ensemble_B2", "ensemble_B2_tb2", "tt_serialized",
-                 "tt_overlap"):
+    for name in ("face", "face+ov", "face+tb2", "face+B2",
+                 "face+tb2+B2", "tt_sharded", "tt_sharded+ov"):
         assert v[name]["schedule_fingerprint"] == fp, name
 
     from jaxstream.utils.comm_probe import (batched_exchange_plan,
@@ -229,18 +285,36 @@ def test_schedule_fingerprints_consistent(full_run):
         "schedule_fingerprint"] == fp
 
 
+def test_proof_stamps_verified_across_the_space(full_run):
+    """Every enumerated plan's built stepper carried a proof stamp,
+    the stamp's verdict is 'verified' (the matrix covers its class),
+    and exchange-tier stamps were cross-checked against the TRACED
+    schedule (the proof_fingerprint fixture keeps that check loud)."""
+    _, result = full_run
+    passes = {(p["check"], p["subject"]) for p in result["passes"]}
+    space = result["facts"]["plan_space"]["keys"]
+    for key in space:
+        assert ("proof.stamp_present", key) in passes, key
+        assert ("proof.verdict", key) in passes, key
+    # Exchange tiers got the traced-schedule cross-check.
+    for key in ("face", "face+ov+tb2+B2", "tt_sharded",
+                "serve_panel+face"):
+        assert ("proof.stamp", key) in passes, key
+        assert ("proof.schedule_fingerprint", key) in passes, key
+
+
 def test_precision_policy_conformance(full_run):
     """Policy off => zero bf16 ops anywhere in the trace (no leak
     outside ops/pallas/precision.py regions); policy on => bf16
     present with f32 accumulators still dominant."""
     _, result = full_run
     v = result["facts"]["variants"]
-    assert v["fused_f32"]["bf16_ops"] == 0
-    assert v["fused_bf16"]["bf16_ops"] > 0
-    assert v["fused_bf16"]["f32_ops"] > v["fused_bf16"]["bf16_ops"]
+    assert v["fused"]["bf16_ops"] == 0
+    assert v["fused+bf16"]["bf16_ops"] > 0
+    assert v["fused+bf16"]["f32_ops"] > v["fused+bf16"]["bf16_ops"]
     # Composition: temporal blocking scales both censuses together.
-    assert v["fused_bf16_tb2"]["bf16_ops"] == \
-        2 * v["fused_bf16"]["bf16_ops"]
+    assert v["fused+tb2+bf16"]["bf16_ops"] == \
+        2 * v["fused+bf16"]["bf16_ops"]
 
 
 def test_donation_overlap_and_callback_checks_ran(full_run):
@@ -254,10 +328,14 @@ def test_donation_overlap_and_callback_checks_ran(full_run):
             "jit_integrate(donate=True)") in passes
     assert ("jaxpr.no_donation",
             "jit_integrate(donate=False)") in passes
-    assert ("jaxpr.overlap_windows", "face_overlap") in passes
-    assert ("jaxpr.serialized_schedule", "face_serialized") in passes
-    assert ("jaxpr.overlap_windows", "ensemble_B2_overlap") in passes
-    for subject in ("segment_loop_face", "serve_panel",
-                    "serve_member"):
+    assert ("jaxpr.overlap_windows", "face+ov") in passes
+    assert ("jaxpr.serialized_schedule", "face") in passes
+    assert ("jaxpr.overlap_windows", "face+ov+B2") in passes
+    # The combo hand-listing missed: overlap windows proven through
+    # the exact temporal fusion too.
+    assert ("jaxpr.overlap_windows", "face+ov+tb2+B2") in passes
+    for subject in ("segment_loop_face", "serve_panel+face",
+                    "serve_member+gspmd", "serve_single+classic",
+                    "serve_single+fused"):
         assert ("jaxpr.no_host_callbacks", subject) in passes
     assert ("jaxpr.member_parallel_zero_wire", "serve_member") in passes

@@ -189,8 +189,18 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     from jaxstream.geometry.connectivity import schedule_fingerprint
 
     assert facts["schedule_fingerprint"] == schedule_fingerprint()
-    assert facts["variants"]["face_serialized"][
-        "ppermutes_per_step"] == 12.0
+    assert facts["variants"]["face"]["ppermutes_per_step"] == 12.0
+    # Round 16: the stamp records the enumerated plan-space size and
+    # the rule-table version, so a silently shrinking legal space (a
+    # feature flag dropping out of the verified matrix) fails THIS
+    # tier-1 gate loudly.
+    space = facts["plan_space"]
+    from jaxstream.plan.rules import RULES_VERSION
+
+    assert space["size"] >= 16
+    assert space["size"] == len(space["keys"])
+    assert space["rules_version"] == RULES_VERSION
+    assert set(space["keys"]) <= set(facts["variants"])
 
     # --telemetry writes a schema-valid obs-sink file alongside the
     # stdout JSON (round-8 satellite: bench rides the structured sink).

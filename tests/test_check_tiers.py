@@ -274,3 +274,75 @@ def test_precision_module_with_slow_marker_detected(tmp_path):
         "from jaxstream.ops.pallas import precision\n"
         "def test_a():\n    pass\n")
     assert check_tiers.main(str(tmp_path)) == 0
+
+
+def test_plan_module_rules_detected(tmp_path):
+    """Rule 10b (round-16 satellite): plan/pipeline tests stay
+    non-slow and in-process — a module importing jaxstream.plan may
+    neither carry slow markers nor launch subprocesses (the rule-table
+    rejections, the enumerated plan space and the proof-stamp checks
+    must ride every fast gate)."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_pl.py").write_text(
+        "import pytest\n"
+        "from jaxstream.plan import enumerate_plans\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Subprocess USAGE trips it too...
+    (tests / "test_pl.py").write_text(
+        "import subprocess\n"
+        "from jaxstream.plan import plan_for\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', 'scripts/plan.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # ...but a docstring merely MENTIONING the word does not.
+    (tests / "test_pl.py").write_text(
+        '"""No subprocess startup cost here."""\n'
+        "from jaxstream.plan import plan_for\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
+def test_config_doc_drift_detected(tmp_path):
+    """Rule 10a (round-16 satellite): every _SECTIONS key in
+    jaxstream/config.py must appear as a top-level key in a fenced
+    USAGE.md config block — a new config section whose docs never
+    landed fails the gate."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    (tmp_path / "tests").mkdir()
+    pkg = tmp_path / "jaxstream"
+    pkg.mkdir()
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (pkg / "config.py").write_text(
+        '_SECTIONS = {\n    "grid": 1,\n    "serve": 2,\n}\n')
+    (docs / "USAGE.md").write_text(
+        "# guide\n\n```yaml\ngrid:\n  n: 96\n```\n")
+    assert check_tiers.main(str(tmp_path)) == 1   # 'serve' undocumented
+    (docs / "USAGE.md").write_text(
+        "# guide\n\n```yaml\ngrid:\n  n: 96\n```\n\n"
+        "```yaml\nserve:\n  buckets: '1,4'\n```\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # Repos without the config/docs pair skip rule 10a (the lint's
+    # other rules still run on the synthetic tmp repos above).
+    import os
+    os.remove(str(docs / "USAGE.md"))
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
+def test_real_repo_sections_all_documented():
+    """Acceptance: the live tree passes rule 10a and the parsed
+    section list matches the importable config surface."""
+    sections = check_tiers.config_sections(
+        os.path.join(REPO, "jaxstream", "config.py"))
+    from jaxstream.config import _SECTIONS
+
+    assert sections == list(_SECTIONS)
+    documented = check_tiers.documented_sections(
+        os.path.join(REPO, "docs", "USAGE.md"))
+    assert set(sections) <= documented
