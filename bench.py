@@ -1387,10 +1387,16 @@ def bench_serving_slo(n=96, dt=300.0, n_requests=64, seed=1404,
     Never raises (returns ``{"skipped": ...}``).
     """
     try:
+        import os
+        import shutil
+        import tempfile
+
         from jaxstream.gateway import Gateway
+        from jaxstream.gateway.client import get_text
         from jaxstream.loadgen import (AutoscaleController,
                                        AutoscalePolicy, generate_trace,
                                        run_load)
+        from jaxstream.obs.registry import parse_exposition
 
         levels = tuple(sorted({int(b) for b in buckets.split(",")
                                if b.strip()}))
@@ -1400,12 +1406,20 @@ def bench_serving_slo(n=96, dt=300.0, n_requests=64, seed=1404,
                "buckets": buckets, "segment_steps": seg, "seed": seed,
                "lengths": list(lengths),
                "queue_capacity": queue_capacity}
+        # Round 17: the section runs with request tracing ON and
+        # certifies trace coverage — every completed request must
+        # reassemble into a full span tree (spans_complete == 1.0),
+        # and /v1/metrics must serve a parseable Prometheus payload.
+        sink_dir = tempfile.mkdtemp(prefix="jaxstream_slo_")
+        serve_sink = os.path.join(sink_dir, "serve.jsonl")
+        gw_sink = os.path.join(sink_dir, "gateway.jsonl")
         cfg = {"grid": {"n": n, "halo": 2, "dtype": "float32"},
                "time": {"dt": dt},
                "model": {"name": "shallow_water_cov",
                          "backend": backend},
                "serve": {"buckets": buckets, "segment_steps": seg,
-                         "queue_capacity": queue_capacity}}
+                         "queue_capacity": queue_capacity,
+                         "sink": serve_sink, "trace": True}}
         ctrl = AutoscaleController(AutoscalePolicy(
             levels=levels, queue_high=3, queue_low=0, occ_low=0.6,
             patience=2, cooldown=2))
@@ -1413,20 +1427,41 @@ def bench_serving_slo(n=96, dt=300.0, n_requests=64, seed=1404,
                                mean_gap_s=mean_gap_s,
                                tail_alpha=tail_alpha, lengths=lengths)
         out["families"] = sorted({e["ic"] for e in trace})
-        gw = Gateway(cfg, host="127.0.0.1", port=0, autoscale=ctrl)
+        gw = Gateway(cfg, host="127.0.0.1", port=0, autoscale=ctrl,
+                     sink=gw_sink)
         try:
             gw.start()
             out["warm_compiles"] = gw.warm_compiles
             summary = run_load("127.0.0.1", gw.port, trace,
                                time_scale=1.0, max_workers=max_workers,
-                               dt=dt)
+                               dt=dt, trace_spans=True,
+                               span_sinks=[serve_sink, gw_sink])
             out["slo"] = summary
             out["autoscale"] = ctrl.summary()
             out["steady_recompiles"] = (gw.server.compile_count()
                                         - gw.warm_compiles)
             out["resizes"] = len(ctrl.events)
+            # Scrape the live gateway: the payload must parse as text
+            # exposition 0.0.4 (the structural checks — +Inf buckets,
+            # monotone cumulative counts — live in the parser).
+            status, ctype, text = get_text("127.0.0.1", gw.port,
+                                           "/v1/metrics")
+            parsed = parse_exposition(text)
+            out["metrics_scrape"] = {
+                "status": status,
+                "content_type": ctype,
+                "families": len(parsed["types"]),
+                "samples": sum(len(v)
+                               for v in parsed["samples"].values()),
+                "submitted": parsed["samples"].get(
+                    "jaxstream_requests_submitted_total", {}).get(""),
+                "ok": bool(status == 200
+                           and "version=0.0.4" in ctype
+                           and parsed["types"]),
+            }
         finally:
             gw.close()
+            shutil.rmtree(sink_dir, ignore_errors=True)
         msps = summary["goodput_member_steps_per_sec"]
         if packed_msps:
             out["goodput_vs_packed"] = round(msps / packed_msps, 4)
@@ -1444,7 +1479,10 @@ def bench_serving_slo(n=96, dt=300.0, n_requests=64, seed=1404,
             f"{summary['latency_p50_s']}/{summary['latency_p99_s']}s; "
             f"goodput {msps} member-steps/s; {out['resizes']} "
             f"autoscale resize(s); {out['steady_recompiles']} steady "
-            f"recompiles")
+            f"recompiles; spans_complete "
+            f"{summary.get('spans_complete')} over "
+            f"{summary.get('spans_checked')} trees; metrics scrape "
+            f"{out['metrics_scrape']['families']} families")
         if gates:
             if not summary["accounting_exact"]:
                 raise RuntimeError(
@@ -1462,6 +1500,17 @@ def bench_serving_slo(n=96, dt=300.0, n_requests=64, seed=1404,
                     f"serving_slo: {out['steady_recompiles']} steady-"
                     f"state recompiles after warmup/resizes — the "
                     "warm-bucket claim is broken")
+            if summary.get("spans_complete") != 1.0:
+                raise RuntimeError(
+                    f"serving_slo: trace coverage broken — "
+                    f"spans_complete {summary.get('spans_complete')} "
+                    f"over {summary.get('spans_checked')} requests "
+                    f"(failures: {summary.get('span_failures')})")
+            if not out["metrics_scrape"]["ok"]:
+                raise RuntimeError(
+                    f"serving_slo: /v1/metrics scrape is not valid "
+                    f"Prometheus text exposition: "
+                    f"{out['metrics_scrape']}")
             if packed_msps and not out["meets_goodput_floor"]:
                 raise RuntimeError(
                     f"serving_slo: goodput {msps} member-steps/s is "

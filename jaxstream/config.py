@@ -302,6 +302,15 @@ class ServeConfig:
     fault_member: int = -1
     # Donate the segment carry (XLA aliases input/output state).
     donate: bool = True
+    # Round 17: request-scoped tracing (jaxstream.obs.trace).  Every
+    # admitted request gets a deterministic trace id and its lifecycle
+    # phases (queue wait, pack, per-segment compute/host-wait,
+    # finalize/fetch/flush) land as typed 'span' records in the serve
+    # sink, reassemblable into a tree whose leaf durations sum to the
+    # request's end-to-end latency (docs/USAGE.md "Operator view").
+    # Default off = the sink stream is byte-identical to the untraced
+    # round-14 records (no span records, no trace fields).
+    trace: bool = False
     # Round 12: orography (the TC5 mountain) rides the batch as a
     # traced per-member field (zeros for the flat families), so
     # tc2/tc5/tc6/galewsky requests pack into ONE bucket in strict

@@ -19,8 +19,9 @@ docs/USAGE.md "Network serving" and docs/DESIGN.md "Gateway".
 """
 
 from . import protocol
-from .client import GatewayError, get_json, submit_streaming
+from .client import (GatewayError, get_json, get_text, post_json,
+                     submit_streaming)
 from .gateway import Gateway
 
-__all__ = ["Gateway", "GatewayError", "get_json", "protocol",
-           "submit_streaming"]
+__all__ = ["Gateway", "GatewayError", "get_json", "get_text",
+           "post_json", "protocol", "submit_streaming"]

@@ -15,7 +15,8 @@ from typing import Callable, List, Optional, Tuple
 
 from . import protocol
 
-__all__ = ["GatewayError", "submit_streaming", "get_json"]
+__all__ = ["GatewayError", "submit_streaming", "get_json", "get_text",
+           "post_json"]
 
 
 class GatewayError(RuntimeError):
@@ -78,6 +79,34 @@ def get_json(host: str, port: int, path: str,
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+    finally:
+        conn.close()
+
+
+def get_text(host: str, port: int, path: str,
+             timeout: float = 30.0) -> Tuple[int, str, str]:
+    """GET one text endpoint (``/v1/metrics``).  Returns
+    ``(status, content_type, body)`` — the Prometheus exposition is
+    plain text, not JSON, so :func:`get_json` cannot fetch it."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return (resp.status, resp.getheader("Content-Type", ""),
+                resp.read().decode("utf-8"))
+    finally:
+        conn.close()
+
+
+def post_json(host: str, port: int, path: str, body: dict,
+              timeout: float = 30.0) -> Tuple[int, dict]:
+    """POST one JSON control endpoint (``/v1/profile``)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         return resp.status, json.loads(resp.read().decode("utf-8"))
     finally:

@@ -52,7 +52,10 @@ import time
 from typing import Dict, Optional
 
 from ..config import Config, load_config
+from ..obs import trace as obs_trace
+from ..obs.registry import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ..obs.sink import TelemetrySink, run_manifest
+from ..utils import jax_compat
 from ..serve.queue import AdmissionRefused, QueueFull, ServerDraining
 from ..serve.request import RequestResult, ScenarioRequest
 from ..serve.server import EnsembleServer
@@ -106,7 +109,8 @@ class Gateway:
 
     def __init__(self, config=None, *, host: str = "127.0.0.1",
                  port: int = 0, autoscale=None, warm: bool = True,
-                 sink: str = "", idle_wait: float = 0.005):
+                 sink: str = "", idle_wait: float = 0.005,
+                 profile_dir: str = ""):
         _require_aiohttp()
         self.config: Config = load_config(config)
         self._host = host
@@ -114,9 +118,19 @@ class Gateway:
         self.port: Optional[int] = None
         self._idle_wait = float(idle_wait)
         self._autoscale = autoscale
+        #: Round 17: on-demand profiler capture (``POST /v1/profile``)
+        #: writes ``jax.profiler`` traces under this directory; ''
+        #: disables the endpoint with a typed 501.
+        self._profile_dir = profile_dir
+        self._profiling = False
+        self._profile_lock = threading.Lock()
         self.server = EnsembleServer(self.config,
                                      on_result=self._on_result,
                                      on_segment=self._on_segments)
+        #: The server's scrapeable registry (``GET /v1/metrics``); the
+        #: gateway adds its own shed counters to the same surface.
+        self.metrics = self.server.metrics
+        self._trace_on = bool(self.config.serve.trace)
         if warm:
             self.server.warmup()
         if autoscale is not None:
@@ -255,11 +269,17 @@ class Gateway:
     def _on_result(self, res: RequestResult) -> None:
         """Writer thread: a request reached its final state."""
         self.stats["completed" if res.ok else "evicted"] += 1
-        self._record({"kind": "gateway", "id": res.id, "ic": res.ic,
-                      "status": res.status,
-                      "latency_s": round(res.latency_s, 6),
-                      "steps_run": res.steps_run,
-                      "nsteps": res.nsteps})
+        rec = {"kind": "gateway", "id": res.id, "ic": res.ic,
+               "status": res.status,
+               "latency_s": round(res.latency_s, 6),
+               "steps_run": res.steps_run,
+               "nsteps": res.nsteps}
+        if self._trace_on:
+            tid = obs_trace.trace_id_for(res.id)
+            rec.update(trace_id=tid,
+                       span_id=obs_trace.root_span_id(tid),
+                       parent_id=None)
+        self._record(rec)
         # Encode (ascontiguousarray + tobytes + base64 per field) only
         # when a connection is still subscribed: this runs on the
         # writer thread whose job is overlapping d2h with the next
@@ -267,7 +287,24 @@ class Gateway:
         with self._streams_lock:
             subscribed = res.id in self._streams
         if subscribed:
+            t_eg = time.perf_counter()
             self._post(res.id, protocol.result_event(res))
+            if self._trace_on:
+                # Stream egress: result encode + handoff to the
+                # connection's writer coroutine.  Sits just past the
+                # root interval (the result was already 'ready') —
+                # part of the span-sum epsilon, by design.
+                tid = obs_trace.trace_id_for(res.id)
+                self._record({
+                    "kind": "span", "trace_id": tid,
+                    "span_id": obs_trace.span_id_for(
+                        tid, obs_trace.GATEWAY_EGRESS, 0),
+                    "parent_id": obs_trace.root_span_id(tid),
+                    "id": res.id, "name": obs_trace.GATEWAY_EGRESS,
+                    "seq": 0,
+                    "start_s": round(res.latency_s, 6),
+                    "duration_s": round(
+                        time.perf_counter() - t_eg, 6)})
 
     def _record(self, rec: dict) -> None:
         if self._sink is None:
@@ -296,13 +333,29 @@ class Gateway:
         self.server.submit(req)
         self.stats["submitted"] += 1
 
-    def _shed(self, req_id: str, code: str, message: str) -> dict:
+    def _shed(self, req_id: str, code: str, message: str,
+              started_at: Optional[float] = None) -> dict:
         key = protocol.SHED_STATUS.get(code)
         if key is not None:
             self.stats[key] += 1
-            self._record({"kind": "gateway", "id": req_id, "ic": "",
-                          "status": key, "latency_s": 0.0,
-                          "error": code})
+            self.metrics.counter_inc("jaxstream_requests_shed_total",
+                                     status=key)
+            rec = {"kind": "gateway", "id": req_id, "ic": "",
+                   "status": key, "latency_s": 0.0, "error": code}
+            if self._trace_on:
+                tid = obs_trace.trace_id_for(req_id)
+                rec.update(trace_id=tid,
+                           span_id=obs_trace.root_span_id(tid),
+                           parent_id=None)
+            self._record(rec)
+            if self._trace_on:
+                # Typed sheds carry a terminal root span: a trace
+                # query answers 'what happened to request X' even when
+                # the answer is 'the gateway refused it'.
+                self._record(obs_trace.terminal_span(
+                    req_id, key,
+                    duration_s=(time.perf_counter() - started_at
+                                if started_at is not None else 0.0)))
         return protocol.error_event(code, message, rid=req_id)
 
     # --------------------------------------------------------- HTTP layer
@@ -324,6 +377,8 @@ class Gateway:
         app.router.add_get("/v1/health", self._handle_health)
         app.router.add_get("/v1/ready", self._handle_ready)
         app.router.add_get("/v1/stats", self._handle_stats)
+        app.router.add_get("/v1/metrics", self._handle_metrics)
+        app.router.add_post("/v1/profile", self._handle_profile)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, self._host, self._requested_port)
@@ -362,8 +417,14 @@ class Gateway:
 
         return web.json_response(payload, status=status)
 
-    def _admit_or_error(self, body):
-        """Parse + admit; returns (req, None) or (None, (event, status))."""
+    def _admit_or_error(self, body, started_at: Optional[float] = None):
+        """Parse + admit; returns (req, None) or (None, (event, status)).
+
+        ``started_at`` is the connection handler's ingress stamp
+        (request body in hand) — the start of the ``gateway.ingress``
+        span and the shed terminal span's duration anchor.
+        """
+        t_in0 = time.perf_counter() if started_at is None else started_at
         try:
             req = protocol.request_from_json(body)
         except ValueError as e:
@@ -382,14 +443,16 @@ class Gateway:
             self.submit(req)
         except QueueFull as e:
             self._drop_stream(req.id)
-            return None, (self._shed(req.id, "queue_full", str(e)), 429)
+            return None, (self._shed(req.id, "queue_full", str(e),
+                                     t_in0), 429)
         except ServerDraining as e:
             self._drop_stream(req.id)
-            return None, (self._shed(req.id, "draining", str(e)), 503)
+            return None, (self._shed(req.id, "draining", str(e),
+                                     t_in0), 503)
         except AdmissionRefused as e:
             self._drop_stream(req.id)
             return None, (self._shed(req.id, "admission_refused",
-                                     str(e)), 503)
+                                     str(e), t_in0), 503)
         except Exception as e:
             # Anything unexpected (e.g. the server closed under the
             # still-bound endpoint) must not leak the stream entry —
@@ -400,6 +463,22 @@ class Gateway:
             return None, (protocol.error_event(
                 "internal", f"{type(e).__name__}: {e}", rid=req.id),
                 500)
+        if self._trace_on:
+            # gateway.ingress: decode + admission, parented to the
+            # root the server's trace will emit.  start_s is relative
+            # to the root start (submitted_wall — same perf_counter
+            # clock), so it renders just LEFT of the root interval.
+            tid = obs_trace.trace_id_for(req.id)
+            t_adm = time.perf_counter()
+            self._record({
+                "kind": "span", "trace_id": tid,
+                "span_id": obs_trace.span_id_for(
+                    tid, obs_trace.GATEWAY_INGRESS, 0),
+                "parent_id": obs_trace.root_span_id(tid),
+                "id": req.id, "name": obs_trace.GATEWAY_INGRESS,
+                "seq": 0,
+                "start_s": round(t_in0 - req.submitted_wall, 6),
+                "duration_s": round(t_adm - t_in0, 6)})
         return req, None
 
     def _drop_stream(self, rid: str) -> None:
@@ -411,13 +490,14 @@ class Gateway:
         final result.  This coroutine is the connection's one writer."""
         from aiohttp import web
 
+        t_in0 = time.perf_counter()
         try:
             body = await request.json()
         except Exception as e:
             self.stats["bad_requests"] += 1
             return self._json(protocol.error_event(
                 "bad_request", f"body is not JSON: {e}"), status=400)
-        req, err = self._admit_or_error(body)
+        req, err = self._admit_or_error(body, started_at=t_in0)
         if err is not None:
             return self._json(err[0], status=err[1])
         with self._streams_lock:
@@ -458,13 +538,14 @@ class Gateway:
         async for msg in ws:
             if msg.type != web.WSMsgType.TEXT:
                 break
+            t_in0 = time.perf_counter()
             try:
                 body = json.loads(msg.data)
             except json.JSONDecodeError as e:
                 await ws.send_json(protocol.error_event(
                     "bad_request", f"message is not JSON: {e}"))
                 continue
-            req, err = self._admit_or_error(body)
+            req, err = self._admit_or_error(body, started_at=t_in0)
             if err is not None:
                 await ws.send_json(err[0])
                 continue
@@ -515,6 +596,81 @@ class Gateway:
         """Serving/occupancy/autoscale telemetry for operators and the
         loadgen harness's closed loop."""
         return self._json(self.snapshot())
+
+    async def _handle_metrics(self, request):
+        """GET /v1/metrics: Prometheus text exposition of the server's
+        registry (jaxstream.obs.registry) — counters by typed status,
+        queue/occupancy/bucket-cap/per-chip gauges, latency/wall/
+        host-wait histograms.  Snapshot-on-scrape: the render copies
+        the registry under its creation lock and formats outside it,
+        so a slow scrape never blocks a segment boundary."""
+        from aiohttp import web
+
+        return web.Response(text=self.metrics.render(),
+                            headers={"Content-Type":
+                                     _PROM_CONTENT_TYPE})
+
+    async def _handle_profile(self, request):
+        """POST /v1/profile: start/stop an on-demand ``jax.profiler``
+        trace capture into the gateway's ``profile_dir``.
+
+        Body: ``{"action": "start"|"stop"}``.  Typed failures: 501
+        ``profiler_unavailable`` when the jax build has no profiler or
+        the gateway was started without ``profile_dir``; 409
+        ``profile_conflict`` on start-while-running / stop-while-idle.
+        The capture covers whatever the serving loop runs between the
+        two calls — the compiled segments carry ``serve.segment``
+        named-scope annotations, so the profile regions line up with
+        the sink span names (docs/USAGE.md "Operator view")."""
+        try:
+            body = await request.json()
+        except Exception as e:
+            return self._json(protocol.error_event(
+                "bad_request", f"body is not JSON: {e}"), status=400)
+        action = body.get("action") if isinstance(body, dict) else None
+        if action not in ("start", "stop"):
+            return self._json(protocol.error_event(
+                "bad_request",
+                f"action must be 'start' or 'stop', got {action!r}"),
+                status=400)
+        if not self._profile_dir:
+            return self._json(protocol.error_event(
+                "profiler_unavailable",
+                "this gateway was started without profile_dir; "
+                "restart with Gateway(profile_dir=...) or "
+                "scripts/gateway.py --profile-dir"), status=501)
+        if not jax_compat.profiler_available():
+            return self._json(protocol.error_event(
+                "profiler_unavailable",
+                "jax.profiler.start_trace is unavailable in this jax "
+                "build"), status=501)
+        with self._profile_lock:
+            if action == "start":
+                if self._profiling:
+                    return self._json(protocol.error_event(
+                        "profile_conflict",
+                        "a profiler capture is already running; POST "
+                        "{'action': 'stop'} first"), status=409)
+                try:
+                    jax_compat.start_profiler_trace(self._profile_dir)
+                except RuntimeError as e:
+                    return self._json(protocol.error_event(
+                        "profiler_unavailable", str(e)), status=501)
+                self._profiling = True
+            else:
+                if not self._profiling:
+                    return self._json(protocol.error_event(
+                        "profile_conflict",
+                        "no profiler capture is running"), status=409)
+                try:
+                    jax_compat.stop_profiler_trace()
+                except RuntimeError as e:
+                    return self._json(protocol.error_event(
+                        "profiler_unavailable", str(e)), status=501)
+                finally:
+                    self._profiling = False
+        return self._json({"profiling": self._profiling,
+                           "dir": self._profile_dir})
 
     def snapshot(self) -> dict:
         """The stats payload, also callable in-process (no HTTP)."""

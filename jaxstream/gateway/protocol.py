@@ -62,6 +62,10 @@ ERROR_STATUS: Dict[str, int] = {
     "admission_refused": 503,
     "shutdown": 503,
     "internal": 500,
+    # Round 17, POST /v1/profile: the jax build (or this deployment)
+    # cannot capture profiler traces / start-stop state misuse.
+    "profiler_unavailable": 501,
+    "profile_conflict": 409,
 }
 
 #: Typed-refusal error code -> shed outcome status.  The ONE place the

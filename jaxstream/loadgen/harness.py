@@ -35,6 +35,7 @@ import numpy as np
 
 from ..gateway import protocol
 from ..gateway.client import GatewayError, submit_streaming
+from ..obs import trace as obs_trace
 from ..obs.sink import TelemetrySink, read_records, run_manifest
 from ..utils.logging import get_logger
 
@@ -49,17 +50,25 @@ SHED_STATUSES = tuple(protocol.SHED_STATUS.values())
 
 #: Outcome/sink fields carrying wall-clock time — masked for the
 #: byte-determinism comparison of two runs of the same trace.
-TIMING_FIELDS = ("latency_s", "dispatched_at_s")
+TIMING_FIELDS = ("latency_s", "dispatched_at_s", "server_latency_s")
 
 
-def _one_request(host: str, port: int, entry: dict,
-                 timeout: float) -> dict:
+def _one_request(host: str, port: int, entry: dict, timeout: float,
+                 trace: bool = False) -> dict:
     """Submit one trace entry, stream to completion, classify."""
     req = {k: entry[k] for k in
            ("id", "ic", "nsteps", "seed", "amplitude", "outputs")
            if k in entry}
     out = {"id": entry["id"], "ic": entry["ic"],
            "nsteps": int(entry["nsteps"])}
+    if trace:
+        # The deterministic trace identity — no protocol plumbing
+        # needed (jaxstream.obs.trace digests the request id), so the
+        # client-side records join the server's span trees by id.
+        tid = obs_trace.trace_id_for(entry["id"])
+        out["trace_id"] = tid
+        out["span_id"] = obs_trace.span_id_for(tid, "client", 0)
+        out["parent_id"] = obs_trace.root_span_id(tid)
     t0 = time.perf_counter()
     try:
         status, events = submit_streaming(host, port, req,
@@ -70,6 +79,13 @@ def _one_request(host: str, port: int, entry: dict,
         if final.get("event") == "result":
             out["status"] = final["summary"]["status"]      # ok/evicted
             out["steps_run"] = int(final["summary"]["steps_run"])
+            if trace:
+                # The server-reported end-to-end latency — the span
+                # tree's root duration, which the completeness check
+                # sums against (the client-side latency_s above
+                # additionally carries the HTTP round trip).
+                out["server_latency_s"] = float(
+                    final["summary"].get("latency_s", 0.0))
         else:
             out["status"] = "error"
             out["steps_run"] = 0
@@ -98,7 +114,9 @@ def _one_request(host: str, port: int, entry: dict,
 def run_load(host: str, port: int, trace: List[dict], *,
              time_scale: float = 1.0, max_workers: int = 8,
              request_timeout: float = 300.0,
-             sink: str = "", dt: Optional[float] = None) -> dict:
+             sink: str = "", dt: Optional[float] = None,
+             trace_spans: bool = False,
+             span_sinks: Optional[List[str]] = None) -> dict:
     """Replay ``trace`` against ``host:port``; return the SLO summary.
 
     ``time_scale`` multiplies the trace's arrival offsets (0 = replay
@@ -107,6 +125,17 @@ def run_load(host: str, port: int, trace: List[dict], *,
     goodput into aggregate sim-days/sec when given.  ``sink`` names a
     JSONL file for the per-request ``loadgen`` records + a ``bench``
     summary record.
+
+    ``trace_spans`` (round 17): the gateway's deployment runs with
+    ``serve.trace: true`` — loadgen records then carry
+    ``trace_id``/``span_id``/``parent_id``, and when ``span_sinks``
+    names the serve/gateway sink files the harness ASSERTS span
+    completeness: every completed request must reassemble into exactly
+    one root + >= 1 segment span whose leaf durations sum to the
+    server-reported latency within the declared epsilon
+    (``jaxstream.obs.trace``).  The summary gains ``spans_complete``
+    (fraction) + ``span_failures`` — the bench ``serving_slo`` section
+    enforces ``spans_complete == 1.0``.
     """
     sem = threading.BoundedSemaphore(max_workers)
     outcomes: List[Optional[dict]] = [None] * len(trace)
@@ -118,7 +147,8 @@ def run_load(host: str, port: int, trace: List[dict], *,
             # Stamped BEFORE the request so the field really is the
             # dispatch offset (offered-load timeline), not completion.
             dispatched = round(time.perf_counter() - t_start, 6)
-            out = _one_request(host, port, entry, request_timeout)
+            out = _one_request(host, port, entry, request_timeout,
+                               trace=trace_spans)
             out["dispatched_at_s"] = dispatched
             outcomes[i] = out
         finally:
@@ -158,6 +188,19 @@ def run_load(host: str, port: int, trace: List[dict], *,
               "http_status": 0, "steps_run": 0, "segments": 0}
              for i, o in enumerate(outcomes)]
     summary = summarize_outcomes(final, wall, dt=dt)
+    if trace_spans and span_sinks:
+        # Span-completeness assertion surface: every request the
+        # harness saw COMPLETE (ok or evicted — the server owned it to
+        # a final state) must have a full tree in the serve sinks.
+        records = []
+        for path in span_sinks:
+            records.extend(read_records(path, kind="span"))
+        latencies = {o["id"]: o.get("server_latency_s", 0.0)
+                     for o in final if o["status"] in ("ok", "evicted")}
+        cov = obs_trace.span_coverage(records, latencies)
+        summary["spans_checked"] = cov["checked"]
+        summary["spans_complete"] = cov["spans_complete"]
+        summary["span_failures"] = cov["failures"]
     if sink:
         s = TelemetrySink(sink, run_manifest(config={
             "loadgen": True, "n_requests": len(trace),

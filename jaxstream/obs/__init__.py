@@ -15,12 +15,18 @@ tests/test_obs.py).
 from .metrics import (METRICS, MetricSet, MetricSpec, build_metric_set,
                       default_metrics, fetch_buffer)
 from .monitor import GUARD_POLICIES, HealthError, HealthMonitor
+from .registry import MetricsRegistry, parse_exposition
 from .sink import (RECORD_KINDS, TelemetrySink, read_records,
                    validate_record)
+from .trace import (RequestTrace, span_coverage, span_tree,
+                    trace_id_for, tree_complete)
 
 __all__ = [
     "METRICS", "MetricSet", "MetricSpec", "build_metric_set",
     "default_metrics", "fetch_buffer",
     "GUARD_POLICIES", "HealthError", "HealthMonitor",
+    "MetricsRegistry", "parse_exposition",
     "RECORD_KINDS", "TelemetrySink", "read_records", "validate_record",
+    "RequestTrace", "span_coverage", "span_tree", "trace_id_for",
+    "tree_complete",
 ]

@@ -71,6 +71,16 @@ RECORD_KINDS: Dict[str, tuple] = {
     # 'autoscale'/'autoscale_attach'/'manual').
     "autoscale": ("from_bucket", "to_bucket", "queue_depth",
                   "occupancy", "reason"),
+    # One request-lifecycle span (round 17, jaxstream.obs.trace —
+    # ``serve.trace: true``): the root span (parent_id null) carries
+    # the request's terminal "status" and its end-to-end duration;
+    # leaf spans tile the root interval (queue wait, pack, per-segment
+    # compute/host-wait/boundary, finalize/fetch/flush — segment
+    # leaves also carry "bucket"/"plan"/"chip"/"steps").  Span ids are
+    # deterministic digests, so two runs of one trace byte-match once
+    # the SPAN_TIMING_KEYS wall-clock fields are masked.
+    "span": ("trace_id", "span_id", "parent_id", "id", "name",
+             "start_s", "duration_s"),
 }
 
 SCHEMA_VERSION = 1
@@ -83,7 +93,7 @@ def validate_record(rec: dict) -> dict:
         raise ValueError(
             f"telemetry record kind {kind!r} unknown; valid: "
             f"{sorted(RECORD_KINDS)}")
-    missing = [k for k in RECORD_KINDS[kind] if k not in rec]
+    missing = sorted(k for k in RECORD_KINDS[kind] if k not in rec)
     if missing:
         raise ValueError(
             f"telemetry {kind!r} record missing keys {missing}")

@@ -65,6 +65,7 @@ from __future__ import annotations
 import copy
 import dataclasses
 import os
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -76,7 +77,10 @@ import jax.numpy as jnp
 from ..config import Config, load_config
 from ..geometry.cubed_sphere import build_grid
 from ..io.async_pipeline import BackgroundWriter, HostFetch
+from ..obs import trace as obs_trace
 from ..obs.monitor import HealthMonitor
+from ..obs.registry import (HOST_WAIT_BUCKETS_S, LATENCY_BUCKETS_S,
+                            WALL_BUCKETS_S, MetricsRegistry)
 from ..obs.sink import TelemetrySink, run_manifest
 from ..parallel.mesh import available_devices, setup_ensemble_sharding
 from ..physics import initial_conditions as ics
@@ -309,24 +313,96 @@ class EnsembleServer:
         self._buckets: Dict[tuple, _Bucket] = {}
         self._setups: Dict[tuple, object] = {}
         self._writer: Optional[BackgroundWriter] = None
+        #: Round 17: request-scoped tracing (serve.trace).  One
+        #: RequestTrace per in-flight admitted request; span records
+        #: land in the serve sink at finalize — or, on SINK-LESS
+        #: servers only, are retained in ``trace_spans`` (bounded by
+        #: the caller's request count; a sinked deployment must read
+        #: its sink, not this dict).
+        self._trace_on = bool(s.trace)
+        self._traces: Dict[str, obs_trace.RequestTrace] = {}
+        self.trace_spans: Dict[str, List[dict]] = {}
+        #: The sink gains a second writer when tracing is on (span
+        #: records from the background writer thread, serve/guard
+        #: records from the serving thread) — serialize the two.
+        self._sink_lock = threading.Lock()
+        #: Round 17: the scrapeable metrics registry (obs.registry) —
+        #: updated at segment boundaries on the serving thread, latency
+        #: observations on the writer thread, shed counters by the
+        #: gateway; rendered by ``GET /v1/metrics``.
+        self.metrics = MetricsRegistry()
+        self._init_metrics()
         self._sink = None
         if s.sink:
+            manifest_cfg = {
+                "serving": True, "grid_n": cfg.grid.n,
+                "dt": cfg.time.dt, "buckets": list(self.buckets),
+                "segment_steps": s.segment_steps,
+                "queue_capacity": s.queue_capacity,
+                "guards": s.guards,
+                "placement": p.mode,
+                "group_by_orography": self._grouping,
+                # Round 16: rule-table version the bucket proof
+                # stamps were minted against (each 'serve' record
+                # then names its bucket's plan + verdict).
+                "rules_version": _PLAN_RULES_VERSION,
+            }
+            if self._trace_on:
+                # Only stamped when tracing is ON, so an untraced
+                # run's manifest stays byte-identical to round 14's.
+                manifest_cfg["trace"] = True
             self._sink = TelemetrySink(s.sink, run_manifest(
-                config={
-                    "serving": True, "grid_n": cfg.grid.n,
-                    "dt": cfg.time.dt, "buckets": list(self.buckets),
-                    "segment_steps": s.segment_steps,
-                    "queue_capacity": s.queue_capacity,
-                    "guards": s.guards,
-                    "placement": p.mode,
-                    "group_by_orography": self._grouping,
-                    # Round 16: rule-table version the bucket proof
-                    # stamps were minted against (each 'serve' record
-                    # then names its bucket's plan + verdict).
-                    "rules_version": _PLAN_RULES_VERSION,
-                }))
+                config=manifest_cfg))
         self._fault_fired = False
         self._closed = False
+
+    def _init_metrics(self):
+        """Declare the scrape surface up front (names, types, bucket
+        ladders and HELP text are part of the operator contract —
+        present from the first scrape, not from first traffic)."""
+        m = self.metrics
+        m.counter("jaxstream_requests_submitted_total",
+                  "requests admitted by submit()")
+        m.counter("jaxstream_requests_completed_total",
+                  "requests that reached a final state, by status")
+        m.counter("jaxstream_requests_shed_total",
+                  "typed admission refusals, by shed status")
+        m.counter("jaxstream_segments_total",
+                  "compiled masked segments executed")
+        m.counter("jaxstream_member_steps_total",
+                  "member-steps of work advanced")
+        m.counter("jaxstream_guard_events_total",
+                  "health-guard trips (member evictions)")
+        m.gauge("jaxstream_queue_depth", "request queue depth")
+        m.gauge("jaxstream_queue_capacity", "request queue bound")
+        m.gauge("jaxstream_active_bucket_cap",
+                "largest batch-size bucket packing may use")
+        m.gauge("jaxstream_occupancy",
+                "slot occupancy of the last segment (active/B)")
+        m.gauge("jaxstream_chip_occupancy",
+                "per-member-shard slot occupancy of the last segment")
+        m.gauge("jaxstream_chip_utilization",
+                "per-member-shard advanced-step fraction of the last "
+                "segment")
+        m.histogram("jaxstream_request_latency_seconds",
+                    LATENCY_BUCKETS_S,
+                    "submit-to-result end-to-end latency")
+        m.histogram("jaxstream_segment_wall_seconds", WALL_BUCKETS_S,
+                    "wall seconds per compiled masked segment")
+        m.histogram("jaxstream_host_wait_seconds", HOST_WAIT_BUCKETS_S,
+                    "residual health-stream d2h block per boundary")
+        m.gauge_set("jaxstream_queue_depth", 0)
+        m.gauge_set("jaxstream_queue_capacity",
+                    self.config.serve.queue_capacity)
+        m.gauge_set("jaxstream_active_bucket_cap", self._active_max)
+
+    def _sink_write(self, rec: dict) -> None:
+        """Serialized sink write (serving thread + writer thread when
+        tracing; the lock is uncontended otherwise)."""
+        if self._sink is None:
+            return
+        with self._sink_lock:
+            self._sink.write(rec)
 
     # ------------------------------------------------------------ lifecycle
     def close(self):
@@ -388,12 +464,14 @@ class EnsembleServer:
                 f"bucket {list(self.buckets)} — resizes must land on "
                 "warm executables (add the size to serve.buckets)")
         old, self._active_max = self._active_max, int(max_bucket)
+        self.metrics.gauge_set("jaxstream_active_bucket_cap",
+                               self._active_max)
         if old != max_bucket:
             self.stats["resizes"] += 1
             log.info("serve: resized active bucket cap %d -> %d%s",
                      old, max_bucket, f" ({reason})" if reason else "")
         if self._sink is not None:
-            self._sink.write({
+            self._sink_write({
                 "kind": "autoscale", "from_bucket": old,
                 "to_bucket": int(max_bucket),
                 "queue_depth": (len(self.queue) if queue_depth is None
@@ -797,8 +875,23 @@ class EnsembleServer:
         # queue_full is the queue's own call: a blocking submit waits
         # it out, a non-blocking one gets QueueFull from queue.submit.
         req.submitted_wall = time.perf_counter()
-        self.queue.submit(req, block=block, timeout=timeout)
+        if self._trace_on:
+            # The trace's root interval IS the latency interval: t0 is
+            # the same stamp latency_s is measured from, so the leaf
+            # sum telescopes to the reported latency by construction.
+            # Registered BEFORE the queue publishes the request: the
+            # serving thread may pop it the instant submit returns,
+            # and a mark on an unregistered id is silently dropped —
+            # an incomplete tree, found by review.
+            self._traces[req.id] = obs_trace.RequestTrace(
+                req.id, t0=req.submitted_wall)
+        try:
+            self.queue.submit(req, block=block, timeout=timeout)
+        except Exception:
+            self._traces.pop(req.id, None)
+            raise
         if self._draining and self.queue.remove(req):
+            self._traces.pop(req.id, None)
             # begin_drain raced the enqueue: serve_forever may already
             # have observed (empty queue, draining) and exited, which
             # would strand this request admitted-but-never-served.
@@ -809,6 +902,7 @@ class EnsembleServer:
                 f"server refused {req.id!r}: draining began during "
                 "admission — the request was withdrawn, not stranded")
         self.stats["submitted"] += 1
+        self.metrics.counter_inc("jaxstream_requests_submitted_total")
 
     # -------------------------------------------------------------- serving
     def serve(self):
@@ -926,8 +1020,16 @@ class EnsembleServer:
         B = next(b for b in active if b >= len(batch))
         bk = self._bucket(group, B)
         plan = bk.plan
+        plan_key = bk.proof.plan_key if bk.proof is not None else None
         self.stats["batches"] += 1
 
+        if self._trace_on:
+            # queue.wait ends (and serve.pack opens) for the whole
+            # initial batch at one stamp — the IC builds + the single
+            # stack below are the batch's shared packing work.
+            t_pack = time.perf_counter()
+            for r in batch:
+                self._mark(r.id, obs_trace.PACK, t_pack)
         trees = [self._member_tree(r) for r in batch]
         carry = bk.stack(trees + [trees[0]] * (B - len(batch)))
         slots: List[Optional[_Slot]] = (
@@ -950,6 +1052,8 @@ class EnsembleServer:
             w0 = time.perf_counter()
             active_mask = [sl is not None for sl in slots]
             active_before = sum(active_mask)
+            resident = [(i, sl.req.id) for i, sl in enumerate(slots)
+                        if sl is not None]
             carry, _, nf = bk.seg(carry, bk.put_rem(rem))
             # The health stream rides a HostFetch: its d2h copy chases
             # the segment's compute while the host does the boundary
@@ -966,18 +1070,36 @@ class EnsembleServer:
                 r = self._pop(group)
                 if r is None:
                     break
+                if self._trace_on:
+                    self._mark(r.id, obs_trace.PACK)
                 prepped.append((r, self._member_tree(r)))
             hw0 = time.perf_counter()
             nf_host = np.asarray(nf_fetch.resolve(),
                                  np.float64).reshape(-1)
-            host_wait = time.perf_counter() - hw0
-            wall = time.perf_counter() - w0
+            hw1 = time.perf_counter()
+            host_wait = hw1 - hw0
+            wall = hw1 - w0
             steps_by_slot = rem - new_rem
             member_steps = int(np.sum(steps_by_slot))
             rem = new_rem
             for i, sl in enumerate(slots):
                 if sl is not None:
                     sl.done = sl.req.nsteps - int(rem[i])
+            if self._trace_on:
+                # Three leaves per resident request per segment, at the
+                # SHARED boundary stamps (w0/hw0/hw1): device compute,
+                # health-stream host wait, then boundary work (evict/
+                # extract/refill) which the next segment mark — or the
+                # finalize mark — closes.  Segment leaves carry the
+                # operator attribution: bucket, plan key, chip, steps.
+                for i, rid in resident:
+                    self._mark(rid, obs_trace.SEGMENT, w0, bucket=B,
+                               plan=plan_key,
+                               chip=(chips[i] if chips is not None
+                                     else 0),
+                               steps=int(steps_by_slot[i]))
+                    self._mark(rid, obs_trace.HOST_WAIT, hw0)
+                    self._mark(rid, obs_trace.BOUNDARY, hw1)
             # Per-segment progress stream (round 14, the gateway's
             # hook): one event per slot active during this segment,
             # emitted BEFORE any finalization from this boundary is
@@ -1031,7 +1153,10 @@ class EnsembleServer:
                     if self._sink is not None:
                         # The event is already a schema-valid 'guard'
                         # record; under placement it names the chip.
-                        self._sink.write(ev)
+                        self._sink_write(ev)
+                if events:
+                    self.metrics.counter_inc(
+                        "jaxstream_guard_events_total", len(events))
             for i, sl in enumerate(slots):
                 if sl is not None and rem[i] == 0:
                     fetch = HostFetch(bk.extract(carry, jnp.int32(i)))
@@ -1049,6 +1174,8 @@ class EnsembleServer:
                         r = self._pop(group)
                         if r is None:
                             break
+                        if self._trace_on:
+                            self._mark(r.id, obs_trace.PACK)
                         tree = self._member_tree(r)
                     carry = bk.inject(carry, jnp.int32(i),
                                       bk.put_member(tree))
@@ -1073,6 +1200,34 @@ class EnsembleServer:
             st["completed"] += completed
             st["evicted"] += evicted
             st["host_wait_s"] += host_wait
+            m = self.metrics
+            m.counter_inc("jaxstream_segments_total")
+            if member_steps:
+                m.counter_inc("jaxstream_member_steps_total",
+                              member_steps)
+            if completed:
+                m.counter_inc("jaxstream_requests_completed_total",
+                              completed, status="ok")
+            if evicted:
+                m.counter_inc("jaxstream_requests_completed_total",
+                              evicted, status="evicted")
+            m.gauge_set("jaxstream_queue_depth", len(self.queue))
+            m.gauge_set("jaxstream_occupancy", active_before / B)
+            m.observe("jaxstream_segment_wall_seconds", wall,
+                      buckets=WALL_BUCKETS_S)
+            m.observe("jaxstream_host_wait_seconds", host_wait,
+                      buckets=HOST_WAIT_BUCKETS_S)
+            for j in range(m_shards):
+                occ_j = (sum(active_mask[j * per_shard:
+                                         (j + 1) * per_shard])
+                         / per_shard)
+                util_j = (float(np.sum(
+                    steps_by_slot[j * per_shard:(j + 1) * per_shard]))
+                    / (per_shard * seg))
+                m.gauge_set("jaxstream_chip_occupancy", occ_j,
+                            chip=str(j))
+                m.gauge_set("jaxstream_chip_utilization", util_j,
+                            chip=str(j))
             if self._sink is not None:
                 rec = {
                     "kind": "serve", "bucket": B, "group": group,
@@ -1089,6 +1244,12 @@ class EnsembleServer:
                     "completed": completed, "evicted": evicted,
                     "refilled": refilled, "member_steps": member_steps,
                 }
+                if self._trace_on:
+                    # Which requests this segment advanced (slot
+                    # order) — the dashboard's live in-flight view.
+                    rec["trace_ids"] = [
+                        obs_trace.trace_id_for(rid)
+                        for _, rid in resident]
                 if plan.sharded:
                     rec["placement"] = plan.mode
                     rec["devices"] = plan.num_devices
@@ -1103,7 +1264,7 @@ class EnsembleServer:
                                           (j + 1) * per_shard]))
                             / (per_shard * seg), 4)
                         for j in range(m_shards)]
-                self._sink.write(rec)
+                self._sink_write(rec)
             # Autoscale hook, once per segment boundary — queue depth
             # and last_occupancy are fresh here.  A resize ends this
             # batch's refill (see cap0 note above).
@@ -1114,29 +1275,40 @@ class EnsembleServer:
                          "batch; batch (B=%d) winds down without "
                          "refilling", cap0, self._active_max, B)
 
+    def _mark(self, rid: str, name: str, t: Optional[float] = None,
+              **attrs) -> None:
+        """Add one trace mark for an in-flight request (no-op for
+        untraced ids — e.g. requests admitted before a restart)."""
+        tr = self._traces.get(rid)
+        if tr is not None:
+            tr.mark(name, t, **attrs)
+
     def _finish(self, slot: _Slot, status: str,
                 fetch: Optional[HostFetch], event: Optional[dict] = None):
         """Queue one request's finalization on the background writer —
         the d2h copies (already in flight) resolve there, overlapping
-        the next segment's compute."""
-        latency = (time.perf_counter() - slot.req.submitted_wall
-                   if slot.req.submitted_wall is not None else 0.0)
+        the next segment's compute.  The latency stamp moved (round
+        17) from here to :meth:`_finalize`'s result-ready instant, so
+        the reported latency covers the writer-queue wait and the d2h
+        result fetch — the same interval the request's span tree
+        tiles."""
+        if self._trace_on:
+            self._mark(slot.req.id, obs_trace.FINALIZE_WAIT)
         self._ensure_writer().submit(
-            self._finalize, slot.req, status, slot.done, latency, fetch,
-            event)
+            self._finalize, slot.req, status, slot.done, fetch, event)
 
     def _finalize(self, req: ScenarioRequest, status: str, done: int,
-                  latency: float, fetch: Optional[HostFetch],
-                  event: Optional[dict]):
+                  fetch: Optional[HostFetch], event: Optional[dict]):
+        tr = self._traces.pop(req.id, None) if self._trace_on else None
+        if tr is not None:
+            tr.mark(obs_trace.RESULT_FETCH)
         fields = {}
         if fetch is not None:
             host = fetch.resolve()
             fields = {k: host[k] for k in req.outputs if k in host}
+        if tr is not None:
+            tr.mark(obs_trace.WRITER_FLUSH)
         t_final = done * self.config.time.dt
-        res = RequestResult(
-            id=req.id, ic=req.ic, nsteps=req.nsteps, status=status,
-            t_final=t_final, steps_run=done, latency_s=latency,
-            fields=fields, guard_event=event)
         out_dir = self.config.serve.output_dir
         if out_dir and fields:
             from ..io.history import HistoryWriter
@@ -1146,6 +1318,31 @@ class EnsembleServer:
                 attrs={"request": req.id, "ic": req.ic,
                        "nsteps": req.nsteps, "status": status})
             hw.append(fields, t_final)
+        # The result-ready instant: latency_s and the trace root close
+        # on the SAME stamp, so the span tree's leaf sum telescopes to
+        # the reported latency exactly (obs.trace module docstring).
+        t_end = time.perf_counter()
+        latency = (t_end - req.submitted_wall
+                   if req.submitted_wall is not None else 0.0)
+        res = RequestResult(
+            id=req.id, ic=req.ic, nsteps=req.nsteps, status=status,
+            t_final=t_final, steps_run=done, latency_s=latency,
+            fields=fields, guard_event=event)
+        if tr is not None:
+            spans = tr.finish(status, t_end)
+            if self._sink is not None:
+                for sp in spans:
+                    self._sink_write(sp)
+            else:
+                # Only sink-less (direct/embedded) servers retain the
+                # spans in memory — a sinked deployment already
+                # persisted them, and retaining every request's spans
+                # forever would grow without bound under continuous
+                # traffic (review finding).
+                self.trace_spans[req.id] = spans
+        self.metrics.observe("jaxstream_request_latency_seconds",
+                             latency, buckets=LATENCY_BUCKETS_S,
+                             status=status)
         self.results[req.id] = res
         if self.on_result is not None:
             self.on_result(res)

@@ -18,8 +18,9 @@ import os
 import jax
 
 __all__ = ["LEGACY_SHARD_MAP", "copy_to_host_async", "enable_compile_cache",
-           "maybe_enable_compile_cache", "named_scope", "shard_map",
-           "tpu_compiler_params"]
+           "maybe_enable_compile_cache", "named_scope",
+           "profiler_available", "shard_map", "start_profiler_trace",
+           "stop_profiler_trace", "tpu_compiler_params"]
 
 #: True on the 0.4.x line.  Besides the spelling differences shimmed
 #: below, that line's XLA trips an hlo-verifier bug ("tile_assignment
@@ -62,6 +63,60 @@ def named_scope(name: str):
     if ns is None:  # pragma: no cover - every supported jax has it
         return contextlib.nullcontext()
     return ns(name)
+
+
+def profiler_available() -> bool:
+    """True when this jax build exposes the on-demand trace profiler.
+
+    ``jax.profiler.start_trace``/``stop_trace`` is the capture API on
+    every supported line, but some stripped builds ship without the
+    profiler extension — the gateway's ``POST /v1/profile`` degrades to
+    a typed 501 instead of a 500 stack when this returns False.
+    """
+    prof = getattr(jax, "profiler", None)
+    return (prof is not None and hasattr(prof, "start_trace")
+            and hasattr(prof, "stop_trace"))
+
+
+def start_profiler_trace(log_dir: str) -> None:
+    """Begin a ``jax.profiler`` trace capture into ``log_dir``.
+
+    Raises ``RuntimeError`` when the build has no profiler (callers
+    map it to the typed 501) — never AttributeError soup.  One capture
+    at a time is the profiler's own contract; the gateway serializes
+    start/stop behind its profile state.
+    """
+    if not profiler_available():
+        raise RuntimeError(
+            "jax.profiler.start_trace is unavailable in this jax "
+            "build; on-demand profiling is disabled")
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+    except RuntimeError:
+        raise
+    except Exception as e:
+        # An unwritable log dir (OSError) or a foreign profiler
+        # session must surface as the typed RuntimeError the callers
+        # map to their 501 contract, never an untyped 500.
+        raise RuntimeError(
+            f"profiler trace could not start in {log_dir!r}: "
+            f"{type(e).__name__}: {e}")
+
+
+def stop_profiler_trace() -> None:
+    """End the in-flight ``jax.profiler`` trace capture."""
+    if not profiler_available():
+        raise RuntimeError(
+            "jax.profiler.stop_trace is unavailable in this jax "
+            "build; on-demand profiling is disabled")
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError:
+        raise
+    except Exception as e:
+        raise RuntimeError(
+            f"profiler trace could not stop: {type(e).__name__}: {e}")
 
 
 def copy_to_host_async(tree):

@@ -97,6 +97,18 @@ every gate run self-checking):
     pipeline and must run in every fast gate on the in-process
     virtual devices.
 
+11. **Tracing/dashboard tests stay non-slow, in-process, loopback
+    only** (round-17 observability satellite): a module importing the
+    tracing surface (``jaxstream.obs.trace`` / ``jaxstream.obs.
+    registry``) or the operator dashboard (``telemetry_dashboard``)
+    must carry NO ``slow`` markers, must not launch subprocesses
+    (drive the dashboard/report CLIs through their importable
+    ``main()``), and must never reference a wildcard bind address —
+    the span-completeness proof, the metrics scrape round-trip and
+    the dashboard render are the operator-view acceptance criteria
+    the fast gate certifies on every run, and their gateways open
+    REAL listening sockets.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -154,6 +166,15 @@ _WILDCARD_BIND_RE = re.compile(r"(?<![\d.])0\.0\.0\.0(?![\d.])")
 _PLAN_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.plan\b|import\s+jaxstream\.plan\b"
     r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*plan\b)",
+    re.MULTILINE)
+_TRACE_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.obs\.(trace|registry)\b"
+    r"|import\s+jaxstream\.obs\.(trace|registry)\b"
+    r"|from\s+jaxstream\.obs\s+import\s+[^\n]*"
+    r"\b(trace|registry|RequestTrace|MetricsRegistry"
+    r"|parse_exposition|span_coverage|tree_complete)\b"
+    r"|import\s+telemetry_dashboard\b"
+    r"|from\s+telemetry_dashboard\s+import\b)",
     re.MULTILINE)
 #: Actual subprocess USAGE (an import or an attribute call), so a
 #: docstring merely mentioning the word does not trip rule 10b.
@@ -309,6 +330,30 @@ def lint_file(path: str, allowed: set):
                    f"rule 2, dropping the plan-space proof from the "
                    f"fast gate); drive scripts/plan.py through its "
                    f"importable main() instead")
+    if _TRACE_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports the tracing/dashboard surface "
+                   f"(jaxstream.obs.trace/registry or "
+                   f"telemetry_dashboard) but marks tests slow — the "
+                   f"span-completeness proof, the metrics scrape "
+                   f"round-trip and the dashboard render are the "
+                   f"operator-view acceptance criteria and must run "
+                   f"in every fast gate; move the slow test to a "
+                   f"module that does not import the tracing surface")
+        if _SUBPROC_USE_RE.search(src):
+            yield (f"{rel}: imports the tracing/dashboard surface but "
+                   f"launches subprocesses — tracing/dashboard tests "
+                   f"must run IN-PROCESS (drive "
+                   f"scripts/telemetry_dashboard.py and "
+                   f"scripts/telemetry_report.py through their "
+                   f"importable main() instead; a subprocess rewrite "
+                   f"would be forced slow by rule 2, dropping the "
+                   f"operator-view proof from the fast gate)")
+        if _WILDCARD_BIND_RE.search(src):
+            yield (f"{rel}: imports the tracing/dashboard surface and "
+                   f"references the wildcard bind address 0.0.0.0 — "
+                   f"traced-gateway tests open REAL listening sockets "
+                   f"and must bind loopback (127.0.0.1) only")
     if _ANALYSIS_IMPORT_RE.search(src):
         if "slow" in used:
             yield (f"{rel}: imports jaxstream.analysis but marks tests "

@@ -6,7 +6,7 @@ Usage::
         [--port 8080] [--sink gateway.jsonl]
         [--autoscale-levels 1,4,16] [--queue-high 4] [--queue-low 0]
         [--occ-low 0.5] [--patience 2] [--cooldown 2]
-        [--run-seconds 0]
+        [--run-seconds 0] [--profile-dir profiles/]
 
 ``config.yaml`` is the standard config surface (grid/time/physics/
 model + the ``serve:`` block).  The process serves until SIGTERM or
@@ -22,7 +22,10 @@ at segment boundaries (jaxstream.loadgen.autoscale).
 
 Endpoints: ``POST /v1/requests`` (NDJSON event stream), ``GET /v1/ws``
 (the same protocol over WebSocket), ``/v1/health``, ``/v1/ready``,
-``/v1/stats`` — schema in docs/USAGE.md "Network serving".
+``/v1/stats``, ``GET /v1/metrics`` (Prometheus text exposition) and
+``POST /v1/profile`` (on-demand ``jax.profiler`` capture, enabled by
+``--profile-dir``; typed 501 otherwise) — schema in docs/USAGE.md
+"Network serving" and "Operator view".
 """
 
 from __future__ import annotations
@@ -80,6 +83,10 @@ def main(argv=None) -> int:
     ap.add_argument("--run-seconds", type=float, default=0.0,
                     help="serve for N seconds then drain (0 = until "
                          "SIGTERM/SIGINT)")
+    ap.add_argument("--profile-dir", default="",
+                    help="enable POST /v1/profile: on-demand "
+                         "jax.profiler captures land here (empty = "
+                         "endpoint answers a typed 501)")
     args = ap.parse_args(argv)
 
     from jaxstream.gateway import Gateway
@@ -94,7 +101,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, on_signal)
 
     gw = Gateway(args.config, host=args.host, port=args.port,
-                 autoscale=build_autoscale(args), sink=args.sink)
+                 autoscale=build_autoscale(args), sink=args.sink,
+                 profile_dir=args.profile_dir)
     gw.start()
     log(f"gateway: serving on {gw.url} "
         f"(buckets {list(gw.server.buckets)}, warm "

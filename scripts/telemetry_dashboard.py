@@ -1,0 +1,393 @@
+"""Live operator dashboard over jaxstream telemetry sinks.
+
+Usage::
+
+    python scripts/telemetry_dashboard.py serve.jsonl gateway.jsonl \
+        [load.jsonl ...] [--interval 1.0] [--rows 10] [--once] [--json]
+
+Tails one or many ``jaxstream.obs.sink`` JSONL files — a fleet of
+serving processes writes one sink each; point the dashboard at all of
+them — and renders a live ANSI operator view:
+
+  * **request table** — the most recent completed/evicted requests with
+    a per-phase latency bar (queue / pack / compute / host_wait /
+    boundary / egress) reassembled from their ``span`` records
+    (``serve.trace: true``), plus the in-flight count from the serve
+    stream's ``trace_ids``;
+  * **rates** — member-steps/s and occupancy sparklines from the
+    ``serve`` records, steps/s + drift sparklines from plain
+    ``segment`` records when the sink came from a Simulation run;
+  * **event feed** — the latest ``guard`` (NaN/CFL evictions, with
+    chip attribution) and ``autoscale`` (live bucket-cap resizes)
+    records;
+  * **per-chip occupancy/utilization** — the latest multi-chip
+    placement gauges.
+
+``--once`` renders one frame and exits; ``--json`` emits that frame as
+one machine-readable JSON object instead of ANSI (the form tests and
+CI consume).  Records whose kind this tool does not render are never
+silently dropped: they surface as a loud ``unrendered kinds`` footer
+count (round-17 satellite — same contract as telemetry_report).
+
+stdlib only — this tool must run on a machine with no JAX installed
+(it cannot import jaxstream: the package pulls jax at import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+#: Literal copy of ``jaxstream.obs.trace.PHASE_OF`` (leaf span name ->
+#: report phase bucket).  This tool must run without jaxstream
+#: installed, so it cannot import the source table;
+#: tests/test_trace.py asserts the copies stay identical.
+PHASE_OF = {
+    "gateway.ingress": "ingress",
+    "queue.wait": "queue",
+    "serve.pack": "pack",
+    "serve.segment": "compute",
+    "serve.host_wait": "host_wait",
+    "serve.boundary": "boundary",
+    "finalize.wait": "egress",
+    "result.fetch": "egress",
+    "writer.flush": "egress",
+    "gateway.egress": "egress",
+}
+
+#: Render order + one-letter key + ANSI color of each phase bucket.
+PHASES = ("ingress", "queue", "pack", "compute", "host_wait",
+          "boundary", "egress")
+_PHASE_CH = {"ingress": "i", "queue": "q", "pack": "p", "compute": "C",
+             "host_wait": "h", "boundary": "b", "egress": "e"}
+_PHASE_COLOR = {"ingress": 90, "queue": 33, "pack": 35, "compute": 32,
+                "host_wait": 31, "boundary": 36, "egress": 34}
+
+#: Record kinds this dashboard renders; anything else lands in the
+#: loud ``unrendered kinds`` footer instead of vanishing.
+RENDERED_KINDS = frozenset({
+    "manifest", "span", "serve", "segment", "guard", "autoscale",
+    "gateway", "loadgen", "bench",
+})
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals, width=24):
+    """The last ``width`` values as a unicode sparkline."""
+    vals = [v for v in vals if v is not None][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK[int((v - lo) / span * (len(SPARK) - 1))]
+                   for v in vals)
+
+
+class Tailer:
+    """Incremental reader of one sink file.
+
+    Remembers its byte offset between polls and only parses COMPLETE
+    lines — a writer mid-line (JSONL appends are line-atomic only once
+    the newline lands) never produces a half-parsed record; the
+    partial tail is re-read on the next poll.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+
+    def poll(self):
+        records = []
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self.offset)
+                chunk = fh.read()
+        except OSError:
+            return records              # fleet member not started yet
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return records
+        self.offset += end + 1
+        for line in chunk[:end].split(b"\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # A torn/corrupt line in one fleet member must not
+                # kill the operator view; count it loudly instead.
+                records.append({"kind": "_unparseable"})
+        return records
+
+
+class Dashboard:
+    """Aggregated fleet state -> one renderable frame."""
+
+    def __init__(self, paths, rows=10):
+        self.tailers = [Tailer(p) for p in paths]
+        self.rows = rows
+        self.requests = {}              # id -> request row (span trees)
+        self.order = []                 # completion order of ids
+        self.inflight = {}              # trace_id -> last-seen bucket
+        self.done_tids = set()          # traces with a root span seen
+        self.serve_points = []          # (member_steps/wall, occupancy)
+        self.segment_points = []        # (steps_per_sec, max |drift|)
+        self.events = []                # guard + autoscale feed
+        self.chips = None               # latest per-chip gauges
+        self.outcomes = {}              # kind -> status -> count
+        self.unknown = {}               # kind -> count (loud footer)
+        self.manifests = 0
+
+    # ------------------------------------------------------------ ingest
+    def poll(self):
+        for t in self.tailers:
+            for rec in t.poll():
+                self._ingest(rec)
+
+    def _ingest(self, rec):
+        kind = rec.get("kind")
+        if kind == "span":
+            self._ingest_span(rec)
+        elif kind == "serve":
+            wall = rec.get("wall_s") or 0.0
+            msps = (rec.get("member_steps", 0) / wall) if wall else None
+            self.serve_points.append((msps, rec.get("occupancy")))
+            for tid in rec.get("trace_ids", []):
+                # The background writer can flush a request's root
+                # span BEFORE the serving thread writes the segment
+                # record that still lists it resident — a finished
+                # trace must never re-enter the in-flight view.
+                if tid not in self.done_tids:
+                    self.inflight[tid] = rec.get("bucket")
+            if rec.get("chip_occupancy"):
+                self.chips = {
+                    "occupancy": rec["chip_occupancy"],
+                    "utilization": rec.get("chip_utilization"),
+                    "placement": rec.get("placement"),
+                    "devices": rec.get("devices"),
+                }
+        elif kind == "segment":
+            drifts = [abs(v) for v in rec.get("drift", {}).values()]
+            self.segment_points.append(
+                (rec.get("steps_per_sec"),
+                 max(drifts) if drifts else None))
+        elif kind in ("guard", "autoscale"):
+            self.events.append(rec)
+        elif kind in ("gateway", "loadgen"):
+            by = self.outcomes.setdefault(kind, {})
+            st = rec.get("status", "?")
+            by[st] = by.get(st, 0) + 1
+        elif kind == "manifest":
+            self.manifests += 1
+        elif kind == "bench":
+            pass                        # identity lines; not a panel
+        else:
+            self.unknown[kind] = self.unknown.get(kind, 0) + 1
+
+    def _ingest_span(self, rec):
+        row = self.requests.setdefault(
+            rec["id"], {"id": rec["id"], "status": None,
+                        "latency_s": None, "phases": {}, "bucket": None,
+                        "chip": None, "trace_id": rec.get("trace_id")})
+        if rec.get("parent_id") is None:        # the root span
+            row["status"] = rec.get("status")
+            row["latency_s"] = rec.get("duration_s")
+            self.done_tids.add(rec.get("trace_id"))
+            self.inflight.pop(rec.get("trace_id"), None)
+            if rec["id"] in self.order:
+                self.order.remove(rec["id"])
+            self.order.append(rec["id"])
+            return
+        phase = PHASE_OF.get(rec.get("name"))
+        if phase is None:
+            # A leaf span name this copy of the table does not know —
+            # schema drift; surface it like any unrendered kind.
+            key = f"span:{rec.get('name')}"
+            self.unknown[key] = self.unknown.get(key, 0) + 1
+            return
+        row["phases"][phase] = (row["phases"].get(phase, 0.0)
+                                + rec.get("duration_s", 0.0))
+        if rec.get("name") == "serve.segment":
+            row["bucket"] = rec.get("bucket")
+            row["chip"] = rec.get("chip")
+
+    # ------------------------------------------------------------- frame
+    def frame(self):
+        """The machine-readable frame (the ``--json`` payload)."""
+        recent = [self.requests[rid] for rid in self.order[-self.rows:]]
+        rates = {
+            "member_steps_per_sec": [p[0] for p in self.serve_points],
+            "occupancy": [p[1] for p in self.serve_points],
+            "steps_per_sec": [p[0] for p in self.segment_points],
+            "max_abs_drift": [p[1] for p in self.segment_points],
+        }
+        return {
+            "files": [t.path for t in self.tailers],
+            "manifests": self.manifests,
+            "requests": recent,
+            "n_requests_seen": len(self.requests),
+            "inflight": sorted(self.inflight),
+            "rates": {k: v[-64:] for k, v in rates.items()},
+            "events": self.events[-self.rows:],
+            "chips": self.chips,
+            "outcomes": self.outcomes,
+            "unrendered_kinds": dict(sorted(self.unknown.items())),
+        }
+
+
+# -------------------------------------------------------------- rendering
+def _c(text, code, color):
+    return f"\x1b[{code}m{text}\x1b[0m" if color else text
+
+
+def phase_bar(phases, latency_s, width=28, color=True):
+    """One request's phases as a proportional bar.
+
+    Each phase bucket gets ``round(width * share)`` cells of its
+    letter (colored when ANSI is on); a phase too short for one cell
+    is dropped from the bar but never from the numbers next to it.
+    """
+    total = latency_s or sum(phases.values()) or 1.0
+    out = []
+    for ph in PHASES:
+        d = phases.get(ph, 0.0)
+        n = int(round(width * d / total))
+        if n > 0:
+            out.append(_c(_PHASE_CH[ph] * n, _PHASE_COLOR[ph], color))
+    return "".join(out)
+
+
+def render(frame, color=True):
+    lines = []
+    title = (f"jaxstream operator view — {len(frame['files'])} sink(s), "
+             f"{frame['n_requests_seen']} requests seen, "
+             f"{len(frame['inflight'])} in flight")
+    lines.append(_c(title, 1, color))
+    lines.append("")
+
+    reqs = frame["requests"]
+    lines.append(_c("requests (most recent):", 4, color))
+    if reqs:
+        lines.append(f"  {'id':<14} {'status':<9} {'lat s':>9} "
+                     f"{'bucket':>6} {'chip':>4}  phases")
+        for r in reqs:
+            lat = r["latency_s"]
+            bar = phase_bar(r["phases"], lat, color=color)
+            ph = " ".join(
+                f"{ph[:2]}={r['phases'][ph]:.3f}" for ph in PHASES
+                if ph in r["phases"])
+            lines.append(
+                f"  {r['id']:<14.14} {str(r['status']):<9.9} "
+                f"{lat if lat is None else format(lat, '>9.3f')} "
+                f"{'' if r['bucket'] is None else r['bucket']:>6} "
+                f"{'' if r['chip'] is None else r['chip']:>4}  "
+                f"{bar}")
+            lines.append(f"  {'':<14} {ph}")
+    else:
+        lines.append("  (no span records yet — serving with "
+                     "serve.trace: true?)")
+    lines.append("")
+
+    rates = frame["rates"]
+    lines.append(_c("rates:", 4, color))
+    for key, label in (("member_steps_per_sec", "member-steps/s"),
+                       ("occupancy", "occupancy"),
+                       ("steps_per_sec", "steps/s"),
+                       ("max_abs_drift", "max |drift|")):
+        vals = [v for v in rates.get(key, []) if v is not None]
+        if vals:
+            lines.append(f"  {label:<15} {sparkline(vals)}  "
+                         f"last {vals[-1]:.4g}")
+    if frame["chips"]:
+        ch = frame["chips"]
+        occ = " ".join(f"{v:.2f}" for v in ch["occupancy"])
+        line = (f"  per-chip ({ch.get('placement') or '?'} x"
+                f"{ch.get('devices') or len(ch['occupancy'])}): "
+                f"occ [{occ}]")
+        if ch.get("utilization"):
+            line += (" util ["
+                     + " ".join(f"{v:.2f}" for v in ch["utilization"])
+                     + "]")
+        lines.append(line)
+    for kind, by in sorted(frame["outcomes"].items()):
+        parts = " ".join(f"{k}={v}" for k, v in sorted(by.items()))
+        lines.append(f"  {kind + ' outcomes':<15} {parts}")
+    lines.append("")
+
+    lines.append(_c("events (guard/autoscale):", 4, color))
+    if frame["events"]:
+        for ev in frame["events"]:
+            if ev["kind"] == "guard":
+                who = ("" if ev.get("member") is None
+                       else f" member {ev['member']}")
+                who += ("" if ev.get("chip") is None
+                        else f" chip {ev['chip']}")
+                lines.append(_c(
+                    f"  guard step {ev.get('step')}: {ev.get('event')}"
+                    f"{who} (value {ev.get('value')})", 31, color))
+            else:
+                lines.append(
+                    f"  autoscale bucket {ev.get('from_bucket')} -> "
+                    f"{ev.get('to_bucket')} (queue "
+                    f"{ev.get('queue_depth')}, {ev.get('reason')})")
+    else:
+        lines.append("  none")
+
+    if frame["unrendered_kinds"]:
+        parts = ", ".join(f"{k} x{v}" for k, v in
+                          frame["unrendered_kinds"].items())
+        lines.append("")
+        lines.append(_c(f"!! unrendered kinds (this dashboard does not "
+                        f"know them — schema drift?): {parts}",
+                        33, color))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Live ANSI operator dashboard over jaxstream "
+                    "telemetry sinks (one or many files — a fleet).")
+    ap.add_argument("paths", nargs="+",
+                    help="sink JSONL files to tail (obs.sink format)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (live mode)")
+    ap.add_argument("--rows", type=int, default=10,
+                    help="request-table / event-feed depth")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (tests/CI)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the frame as one JSON object (implies "
+                         "--once unless --interval'd explicitly)")
+    ap.add_argument("--no-color", action="store_true",
+                    help="plain text (no ANSI escapes)")
+    args = ap.parse_args(argv)
+
+    dash = Dashboard(args.paths, rows=args.rows)
+    color = not args.no_color and sys.stdout.isatty()
+    if args.once or args.json:
+        dash.poll()
+        if args.json:
+            print(json.dumps(dash.frame()))
+        else:
+            print(render(dash.frame(), color=color))
+        return 0
+    try:
+        while True:
+            dash.poll()
+            # Clear + home, then one frame: a single write per refresh
+            # keeps partially-drawn frames off slow terminals.
+            sys.stdout.write("\x1b[2J\x1b[H"
+                             + render(dash.frame(), color=color)
+                             + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,8 @@
 
 Usage::
 
-    python scripts/telemetry_report.py run.jsonl [--json]
+    python scripts/telemetry_report.py run.jsonl [more.jsonl ...]
+        [--json] [--trace REQUEST_ID]
 
 Prints, from the run's manifest + segment/guard/bench records:
 
@@ -13,12 +14,24 @@ Prints, from the run's manifest + segment/guard/bench records:
     still shows here);
   * a rate timeline — per segment: step range, wall seconds, steps/s,
     sim-days/sec/chip;
+  * the serving section (occupancy/queue/host-wait timelines) — grown
+    (round 17) with a p50/p99 per-phase latency decomposition table
+    (queue vs compute vs host_wait vs egress ...) when the sinks carry
+    ``span`` records (``serve.trace: true``);
   * guard events (NaN / CFL breaches with their last-good step);
   * bench records, if the file came from ``bench.py --telemetry``.
 
+``--trace REQUEST_ID`` renders one request's span tree instead —
+phase, start offset, duration, bucket/chip per leaf, plus the root's
+terminal status and a leaf-sum-vs-latency check (exit 1 when the id
+has no spans in the given sinks).
+
 ``--json`` emits one machine-readable JSON object instead (the same
-aggregates), for dashboards or the driver.  stdlib only — this tool
-must run on a machine with no JAX installed.
+aggregates), for dashboards or the driver.  Records whose kind this
+report does not render are never silently dropped: they surface as a
+loud ``unrendered kinds`` footer count (round-17 bugfix — silence hid
+schema drift).  stdlib only — this tool must run on a machine with no
+JAX installed.
 """
 
 from __future__ import annotations
@@ -26,6 +39,34 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+#: Literal copy of ``jaxstream.obs.trace.PHASE_OF`` (leaf span name ->
+#: report phase bucket); this tool must run without jaxstream
+#: installed, so it cannot import the source table —
+#: tests/test_trace.py asserts the copies stay identical.
+PHASE_OF = {
+    "gateway.ingress": "ingress",
+    "queue.wait": "queue",
+    "serve.pack": "pack",
+    "serve.segment": "compute",
+    "serve.host_wait": "host_wait",
+    "serve.boundary": "boundary",
+    "finalize.wait": "egress",
+    "result.fetch": "egress",
+    "writer.flush": "egress",
+    "gateway.egress": "egress",
+}
+
+#: Phase render order of the decomposition table.
+PHASES = ("ingress", "queue", "pack", "compute", "host_wait",
+          "boundary", "egress")
+
+#: Record kinds summarize() renders; anything else is counted in the
+#: ``unrendered_kinds`` footer instead of vanishing.
+RENDERED_KINDS = frozenset({
+    "manifest", "segment", "guard", "bench", "serve", "gateway",
+    "loadgen", "autoscale", "span",
+})
 
 
 def load(path):
@@ -41,6 +82,15 @@ def load(path):
                 raise SystemExit(f"{path}:{i + 1}: not JSON ({e})")
     if not records:
         raise SystemExit(f"{path}: empty telemetry file")
+    return records
+
+
+def load_many(paths):
+    """Concatenate several sink files (serve + gateway + loadgen sinks
+    of one deployment; a request's spans may span all of them)."""
+    records = []
+    for p in paths:
+        records.extend(load(p))
     return records
 
 
@@ -80,6 +130,107 @@ def _request_outcomes(recs):
     }
 
 
+def spans_by_request(records):
+    """Group ``span`` records by request id (stdlib mirror of
+    ``jaxstream.obs.trace.spans_by_request``)."""
+    out = {}
+    for rec in records:
+        if rec.get("kind") == "span":
+            out.setdefault(rec["id"], []).append(rec)
+    return out
+
+
+def phase_decomposition(spans_by_id):
+    """Per-phase latency decomposition over completed span trees.
+
+    For every SERVED request — one root span plus at least one leaf:
+    sum its leaf durations into the PHASE_OF buckets, then report
+    p50/p99 seconds per phase plus each phase's mean share of
+    end-to-end latency — the table that answers 'is the fleet
+    queue-bound or compute-bound' at a glance.  Shed requests (a
+    root-only terminal span, duration ~0) are excluded: counting them
+    would dilute the percentiles toward zero exactly when the fleet
+    is overloaded.
+    """
+    per_phase = {ph: [] for ph in PHASES}
+    shares = {ph: [] for ph in PHASES}
+    lat = []
+    n = 0
+    for spans in spans_by_id.values():
+        root = next((s for s in spans if s.get("parent_id") is None),
+                    None)
+        if root is None or root.get("duration_s") is None:
+            continue
+        sums = {}
+        for s in spans:
+            if s.get("parent_id") is None:
+                continue
+            ph = PHASE_OF.get(s.get("name"))
+            if ph is not None:
+                sums[ph] = sums.get(ph, 0.0) + s.get("duration_s", 0.0)
+        if not sums:
+            continue                    # shed terminal span: no leaves
+        total = root["duration_s"]
+        n += 1
+        lat.append(total)
+        for ph in PHASES:
+            if ph in sums:
+                per_phase[ph].append(sums[ph])
+                shares[ph].append(sums[ph] / total if total else 0.0)
+    if not n:
+        return None
+    lat.sort()
+    table = {}
+    for ph in PHASES:
+        vals = sorted(per_phase[ph])
+        if not vals:
+            continue
+        table[ph] = {
+            "n": len(vals),
+            "p50_s": _percentile(vals, 50),
+            "p99_s": _percentile(vals, 99),
+            "mean_share": sum(shares[ph]) / len(shares[ph]),
+        }
+    return {"requests": n, "latency_p50_s": _percentile(lat, 50),
+            "latency_p99_s": _percentile(lat, 99), "phases": table}
+
+
+def span_tree_report(records, request_id):
+    """One request's span tree (the ``--trace`` payload), keyed by
+    request id or trace id; None when the sinks carry no such spans."""
+    spans = [r for r in records if r.get("kind") == "span"
+             and (r.get("id") == request_id
+                  or r.get("trace_id") == request_id)]
+    if not spans:
+        return None
+    root = next((s for s in spans if s.get("parent_id") is None), None)
+    leaves = sorted((s for s in spans
+                     if s.get("parent_id") is not None),
+                    key=lambda s: (s.get("start_s", 0.0),
+                                   s.get("seq", 0)))
+    leaf_sum = sum(s.get("duration_s", 0.0) for s in leaves)
+    out = {
+        "id": spans[0].get("id"),
+        "trace_id": spans[0].get("trace_id"),
+        "status": root.get("status") if root else None,
+        "latency_s": root.get("duration_s") if root else None,
+        "n_roots": sum(1 for s in spans
+                       if s.get("parent_id") is None),
+        "leaf_sum_s": round(leaf_sum, 6),
+        "leaves": [{
+            "name": s.get("name"),
+            "phase": PHASE_OF.get(s.get("name"), "?"),
+            "start_s": s.get("start_s"),
+            "duration_s": s.get("duration_s"),
+            "bucket": s.get("bucket"),
+            "plan": s.get("plan"),
+            "chip": s.get("chip"),
+            "steps": s.get("steps"),
+        } for s in leaves],
+    }
+    return out
+
+
 def summarize(records):
     manifest = next((r for r in records if r.get("kind") == "manifest"), {})
     segments = [r for r in records if r.get("kind") == "segment"]
@@ -89,6 +240,12 @@ def summarize(records):
     gateways = [r for r in records if r.get("kind") == "gateway"]
     loadgens = [r for r in records if r.get("kind") == "loadgen"]
     autoscales = [r for r in records if r.get("kind") == "autoscale"]
+    unrendered = {}
+    for r in records:
+        kind = r.get("kind")
+        if kind not in RENDERED_KINDS:
+            key = str(kind)
+            unrendered[key] = unrendered.get(key, 0) + 1
 
     drift = {}
     if segments:
@@ -187,11 +344,19 @@ def summarize(records):
                         "occupancy": a["occupancy"],
                         "reason": a["reason"]} for a in autoscales],
         }
+    # Round 17: the per-phase latency decomposition over span trees
+    # (serve.trace).  Grown into the serving section when one exists
+    # (the spans came from the serve sink); standalone otherwise (a
+    # gateway-only sink still decomposes its ingress/egress spans).
+    spans = phase_decomposition(spans_by_request(records))
+    if serving is not None and spans is not None:
+        serving["phase_latency"] = spans
     return {"manifest": manifest, "drift": drift, "timeline": timeline,
             "host_wait_total_s": host_wait_total,
             "guards": guards, "bench": benches, "serving": serving,
             "gateway": gateway, "loadgen": loadgen,
-            "autoscale": autoscale,
+            "autoscale": autoscale, "spans": spans,
+            "unrendered_kinds": dict(sorted(unrendered.items())),
             "n_segments": len(segments)}
 
 
@@ -267,6 +432,20 @@ def print_report(s):
                 line += f" utilization [{util_c}]"
             print(line)
 
+    if s.get("spans"):
+        sp = s["spans"]
+        print(f"\nper-phase latency decomposition ({sp['requests']} "
+              f"traced requests; p50/p99 e2e "
+              f"{sp['latency_p50_s']:.4f}/{sp['latency_p99_s']:.4f}s):")
+        print(f"  {'phase':<10} {'n':>5} {'p50 s':>10} {'p99 s':>10} "
+              f"{'share':>7}")
+        for ph in PHASES:
+            row = sp["phases"].get(ph)
+            if row is None:
+                continue
+            print(f"  {ph:<10} {row['n']:>5} {row['p50_s']:>10.4f} "
+                  f"{row['p99_s']:>10.4f} {row['mean_share']:>6.1%}")
+
     for name in ("gateway", "loadgen"):
         sec = s.get(name)
         if not sec:
@@ -309,15 +488,60 @@ def print_report(s):
         print(f"bench: {b['metric']} = {b['value']} {b['unit']}"
               + (f"  {json.dumps(extra)}" if extra else ""))
 
+    if s.get("unrendered_kinds"):
+        parts = ", ".join(f"{k} x{v}"
+                          for k, v in s["unrendered_kinds"].items())
+        print(f"\n!! unrendered kinds (this report does not know them "
+              f"— schema drift?): {parts}")
+
+
+def print_trace(tree):
+    print(f"request {tree['id']} (trace {tree['trace_id']}): "
+          f"status {tree['status']}, latency "
+          f"{tree['latency_s'] if tree['latency_s'] is None else format(tree['latency_s'], '.6f')}s, "
+          f"{len(tree['leaves'])} leaf spans, leaf sum "
+          f"{tree['leaf_sum_s']:.6f}s")
+    if tree["n_roots"] != 1:
+        print(f"!! {tree['n_roots']} root spans (expected exactly 1)")
+    print(f"  {'phase':<10} {'span':<16} {'start s':>10} {'dur s':>10} "
+          f"{'bucket':>6} {'chip':>4}  attrs")
+    for lf in tree["leaves"]:
+        attrs = " ".join(
+            f"{k}={lf[k]}" for k in ("plan", "steps")
+            if lf.get(k) is not None)
+        print(f"  {lf['phase']:<10} {lf['name']:<16} "
+              f"{lf['start_s']:>10.6f} {lf['duration_s']:>10.6f} "
+              f"{'' if lf['bucket'] is None else lf['bucket']:>6} "
+              f"{'' if lf['chip'] is None else lf['chip']:>4}  {attrs}")
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Summarize a jaxstream telemetry JSONL file.")
-    ap.add_argument("path", help="telemetry JSONL file (obs.sink format)")
+        description="Summarize jaxstream telemetry JSONL file(s).")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="telemetry JSONL file(s) (obs.sink format); "
+                         "pass a deployment's serve + gateway + "
+                         "loadgen sinks together")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")
+    ap.add_argument("--trace", metavar="REQUEST_ID", default=None,
+                    help="render one request's span tree (by request "
+                         "id or trace id) instead of the summary")
     args = ap.parse_args(argv)
-    s = summarize(load(args.path))
+    records = load_many(args.paths)
+    if args.trace is not None:
+        tree = span_tree_report(records, args.trace)
+        if tree is None:
+            print(f"no span records for request {args.trace!r} in "
+                  f"{', '.join(args.paths)} (was the deployment "
+                  f"running with serve.trace: true?)")
+            return 1
+        if args.json:
+            print(json.dumps(tree))
+        else:
+            print_trace(tree)
+        return 0
+    s = summarize(records)
     if args.json:
         print(json.dumps(s))
     else:

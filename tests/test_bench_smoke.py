@@ -140,6 +140,24 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert az["events"][0]["to_bucket"] == 2
     # The trace mixed IC families (seeded — deterministic).
     assert len(slo["families"]) >= 2
+    # Round 17: the section runs with request tracing ON and the
+    # spans_complete == 1.0 floor ENFORCED inside bench_serving_slo —
+    # every completed request reassembled into a full span tree whose
+    # leaf durations sum to its reported latency (a breach surfaces
+    # as "skipped" and fails above).  The stamp is also asserted here
+    # so the canary cannot silently stop checking it.
+    assert s["spans_checked"] == s["completed"]
+    assert s["spans_complete"] == 1.0
+    assert s["span_failures"] == {}
+    # ...and the live /v1/metrics scrape parsed as Prometheus text
+    # exposition 0.0.4 (structure validated by parse_exposition —
+    # +Inf buckets, monotone cumulative counts).
+    scrape = slo["metrics_scrape"]
+    assert scrape["ok"] is True
+    assert scrape["status"] == 200
+    assert "version=0.0.4" in scrape["content_type"]
+    assert scrape["families"] >= 10
+    assert scrape["submitted"] == s["n_requests"]
 
     # The precision ladder (round 10) ran all four rows through the
     # real --precision-report code path: reduced-precision stage

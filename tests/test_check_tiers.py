@@ -307,6 +307,57 @@ def test_plan_module_rules_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 0
 
 
+def test_trace_module_rules_detected(tmp_path):
+    """Rule 11 (round-17 satellite): tracing/dashboard tests stay
+    non-slow, in-process and loopback-only — a module importing
+    jaxstream.obs.trace/registry or telemetry_dashboard may not carry
+    slow markers, launch subprocesses, or reference a wildcard bind
+    (the span-completeness proof and the metrics scrape round-trip
+    must ride every fast gate)."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Slow-marked tracing module trips the lint.
+    (tests / "test_tr.py").write_text(
+        "import pytest\n"
+        "from jaxstream.obs import trace as obs_trace\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Subprocess USAGE around the dashboard trips it too.
+    (tests / "test_tr.py").write_text(
+        "import subprocess\n"
+        "import telemetry_dashboard\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', "
+        "'scripts/telemetry_dashboard.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # A wildcard bind trips it (concatenated so THIS module does not
+    # itself contain the literal).
+    (tests / "test_tr.py").write_text(
+        "from jaxstream.obs.registry import parse_exposition\n"
+        "def test_a():\n"
+        "    parse_exposition('x{host=\"0.0." + "0.0\"} 1')\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Loopback-bound, unmarked, in-process tracing module is clean —
+    # including the registry-name import form and the dashboard's
+    # importable main().
+    (tests / "test_tr.py").write_text(
+        "from jaxstream.obs.registry import MetricsRegistry\n"
+        "import telemetry_dashboard\n"
+        "def test_a():\n"
+        "    telemetry_dashboard.main(['s.jsonl', '--once',"
+        " '--json'])\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # A module importing only non-tracing obs symbols is NOT claimed
+    # by rule 11 (rule 3 still keeps it non-slow).
+    (tests / "test_tr.py").write_text(
+        "from jaxstream.obs.sink import read_records\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_config_doc_drift_detected(tmp_path):
     """Rule 10a (round-16 satellite): every _SECTIONS key in
     jaxstream/config.py must appear as a top-level key in a fenced

@@ -1,0 +1,596 @@
+"""Request tracing + operator-view acceptance (round 17).
+
+All tier-1 (check_tiers rule 11 — non-slow, in-process, loopback only):
+
+  * the flagship: a loadgen run through the HTTP gateway with
+    ``serve.trace: true`` yields, for EVERY completed request, a
+    reassemblable span tree — exactly one root, >= 1 ``serve.segment``
+    leaf, leaf durations summing to the server-reported end-to-end
+    latency within the declared epsilon (``spans_complete == 1.0``);
+  * typed sheds carry a terminal root span with the shed status, and
+    evicted requests a complete tree with status ``evicted``;
+  * ``GET /v1/metrics`` round-trips: the scrape parses as Prometheus
+    text exposition 0.0.4 with monotone histogram buckets and counters
+    matching the traffic;
+  * ``scripts/telemetry_dashboard.py --once --json`` renders the
+    request table, rates, event feed and per-chip occupancy from the
+    sinks of a real gateway+loadgen run;
+  * trace/span ids are byte-stable: pinned digests + two runs of the
+    same requests produce byte-identical span records once wall-clock
+    fields are masked;
+  * with tracing OFF the sink stream is unchanged — no span records,
+    no trace fields, manifest byte-compatible with round 14;
+  * ``POST /v1/profile`` start/stop with typed 501/409 failures.
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+from jaxstream.config import load_config
+from jaxstream.gateway import Gateway, get_text, post_json
+from jaxstream.gateway.client import GatewayError, submit_streaming
+from jaxstream.loadgen import generate_trace, run_load
+from jaxstream.obs import trace as obs_trace
+from jaxstream.obs.registry import MetricsRegistry, parse_exposition
+from jaxstream.obs.sink import read_records, validate_record
+from jaxstream.serve.request import ScenarioRequest
+from jaxstream.serve.server import EnsembleServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+N, DT = 8, 600.0
+HOST = "127.0.0.1"
+N_REQS = 10
+
+
+def _cfg(**serve):
+    s = {"buckets": "1,2", "segment_steps": 2, "queue_capacity": 64,
+         "trace": True}
+    s.update(serve)
+    return {
+        "grid": {"n": N},
+        "time": {"dt": DT},
+        "model": {"name": "shallow_water_cov", "backend": "jnp"},
+        "serve": s,
+    }
+
+
+# --------------------------------------------------- the traced deployment
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """ONE gateway+loadgen run with tracing on; every test reads its
+    artifacts (sinks, summary, per-request results) instead of paying
+    its own serving run."""
+    d = tmp_path_factory.mktemp("traced")
+    paths = {k: str(d / f"{k}.jsonl")
+             for k in ("serve", "gateway", "load")}
+    cfg = _cfg(sink=paths["serve"])
+    gw = Gateway(cfg, host=HOST, port=0, sink=paths["gateway"])
+    gw.start()
+    trace = generate_trace(N_REQS, seed=171, mean_gap_s=0.002,
+                           tail_alpha=1.4, lengths=(1, 2, 3, 5))
+    summary = run_load(
+        HOST, gw.port, trace, time_scale=0.0, max_workers=4,
+        sink=paths["load"], dt=DT, trace_spans=True,
+        span_sinks=[paths["serve"], paths["gateway"]])
+    yield {"gw": gw, "paths": paths, "summary": summary,
+           "trace": trace, "dir": d}
+    gw.close(drain=False)
+
+
+def test_span_trees_complete_for_every_request(traced_run):
+    """The round-17 acceptance criterion: every completed request's
+    span tree reassembles, with leaf durations summing to the
+    server-reported latency within the declared epsilon."""
+    s = traced_run["summary"]
+    assert s["completed"] == N_REQS
+    assert s["spans_checked"] == N_REQS
+    assert s["spans_complete"] == 1.0, s["span_failures"]
+    assert s["span_failures"] == {}
+
+    recs = read_records(traced_run["paths"]["serve"], kind="span")
+    grouped = obs_trace.spans_by_request(recs)
+    assert set(grouped) == {e["id"] for e in traced_run["trace"]}
+    for rid, spans in grouped.items():
+        tree = obs_trace.span_tree(spans)
+        assert tree["n_roots"] == 1, rid
+        names = [s["name"] for s in tree["leaves"]]
+        assert names.count("serve.segment") >= 1, rid
+        # The lifecycle reads in order: queue -> pack -> segments.
+        assert names[0] == "queue.wait"
+        assert names[1] == "serve.pack"
+        res = traced_run["gw"].server.results[rid]
+        ok, why = obs_trace.tree_complete(spans, res.latency_s)
+        assert ok, (rid, why)
+        # Segment leaves carry the operator attribution.
+        seg = next(s for s in tree["leaves"]
+                   if s["name"] == "serve.segment")
+        assert seg["bucket"] in (1, 2)
+        assert seg["chip"] == 0
+        assert seg["plan"].startswith("serve_")
+        # Every span record is schema-valid under the sink contract.
+        for rec in spans:
+            validate_record(rec)
+
+
+def test_gateway_spans_and_record_trace_fields(traced_run):
+    """Gateway records join the trees: ingress/egress spans parented
+    to the recomputed root id, 'gateway'/'loadgen' records carrying
+    trace_id/span_id/parent_id."""
+    grecs = read_records(traced_run["paths"]["gateway"])
+    spans = [r for r in grecs if r["kind"] == "span"]
+    by_name = {}
+    for sp in spans:
+        by_name.setdefault(sp["name"], []).append(sp)
+    assert len(by_name["gateway.ingress"]) == N_REQS
+    assert len(by_name["gateway.egress"]) == N_REQS
+    for sp in spans:
+        tid = obs_trace.trace_id_for(sp["id"])
+        assert sp["trace_id"] == tid
+        assert sp["parent_id"] == obs_trace.root_span_id(tid)
+    for r in grecs:
+        if r["kind"] == "gateway":
+            tid = obs_trace.trace_id_for(r["id"])
+            assert r["trace_id"] == tid
+            assert r["span_id"] == obs_trace.root_span_id(tid)
+            assert r["parent_id"] is None
+    lrecs = read_records(traced_run["paths"]["load"], kind="loadgen")
+    for r in lrecs:
+        assert r["trace_id"] == obs_trace.trace_id_for(r["id"])
+        assert r["parent_id"] == obs_trace.root_span_id(r["trace_id"])
+
+
+def test_metrics_endpoint_scrape_roundtrip(traced_run):
+    """GET /v1/metrics serves valid Prometheus text exposition whose
+    counters match the traffic the fixture ran."""
+    gw = traced_run["gw"]
+    status, ctype, text = get_text(HOST, gw.port, "/v1/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    parsed = parse_exposition(text)       # validates structure too
+    t = parsed["types"]
+    assert t["jaxstream_requests_submitted_total"] == "counter"
+    assert t["jaxstream_queue_depth"] == "gauge"
+    assert t["jaxstream_request_latency_seconds"] == "histogram"
+    sm = parsed["samples"]
+    assert sm["jaxstream_requests_submitted_total"][""] == N_REQS
+    assert sm["jaxstream_requests_completed_total"]['status="ok"'] \
+        == N_REQS
+    assert sm["jaxstream_request_latency_seconds_count"][
+        'status="ok"'] == N_REQS
+    assert sm["jaxstream_segments_total"][""] >= 1
+    assert sm["jaxstream_member_steps_total"][""] == sum(
+        e["nsteps"] for e in traced_run["trace"])
+    assert sm["jaxstream_queue_capacity"][""] == 64
+    assert sm["jaxstream_active_bucket_cap"][""] == 2
+    assert 'chip="0"' in sm["jaxstream_chip_occupancy"]
+    # Histogram sums track real time: latency sum >= wall sum of its
+    # own observations is not checkable here, but both are positive.
+    assert sm["jaxstream_request_latency_seconds_sum"][
+        'status="ok"'] > 0
+    assert sm["jaxstream_segment_wall_seconds_count"][""] >= 1
+
+
+def test_dashboard_once_json_renders_the_fleet(traced_run, capsys):
+    """scripts/telemetry_dashboard.py --once --json over the run's
+    three sinks: request table, rates, events, outcomes — the CI
+    surface of the operator view."""
+    import telemetry_dashboard
+
+    p = traced_run["paths"]
+    rc = telemetry_dashboard.main(
+        [p["serve"], p["gateway"], p["load"], "--once", "--json",
+         "--rows", str(N_REQS)])
+    assert rc == 0
+    frame = json.loads(capsys.readouterr().out)
+    assert frame["n_requests_seen"] == N_REQS
+    assert frame["inflight"] == []        # everything completed
+    assert frame["unrendered_kinds"] == {}
+    assert len(frame["requests"]) == N_REQS
+    for row in frame["requests"]:
+        assert row["status"] == "ok"
+        assert row["latency_s"] > 0
+        assert row["phases"]["compute"] > 0
+        assert "queue" in row["phases"]
+        assert row["bucket"] in (1, 2)
+    rates = frame["rates"]
+    assert len(rates["member_steps_per_sec"]) >= 1
+    assert all(0 < v <= 1 for v in rates["occupancy"])
+    assert frame["outcomes"]["gateway"] == {"ok": N_REQS}
+    assert frame["outcomes"]["loadgen"] == {"ok": N_REQS}
+
+    # The ANSI frame (plain): one stable structural render.
+    rc = telemetry_dashboard.main(
+        [p["serve"], p["gateway"], "--once", "--no-color"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "jaxstream operator view" in text
+    assert "requests (most recent):" in text
+    assert "rates:" in text
+    assert "events (guard/autoscale):" in text
+    assert "\x1b[" not in text            # --no-color means it
+
+
+def test_telemetry_report_decomposition_and_trace_view(traced_run,
+                                                      capsys):
+    """The report grows the serving section with the p50/p99 per-phase
+    decomposition, and --trace renders one request's span tree."""
+    import telemetry_report
+
+    p = traced_run["paths"]
+    recs = telemetry_report.load_many(
+        [p["serve"], p["gateway"], p["load"]])
+    s = telemetry_report.summarize(recs)
+    assert s["unrendered_kinds"] == {}
+    dec = s["serving"]["phase_latency"]
+    assert dec is s["spans"]
+    assert dec["requests"] == N_REQS
+    for ph in ("queue", "compute", "host_wait", "egress"):
+        row = dec["phases"][ph]
+        assert row["n"] == N_REQS
+        assert 0 <= row["p50_s"] <= row["p99_s"]
+        assert 0.0 <= row["mean_share"] <= 1.0
+    # Shares of one request sum to ~1 (the telescoping property seen
+    # through the report's aggregation).
+    total_share = sum(r["mean_share"] for r in dec["phases"].values())
+    assert 0.9 <= total_share <= 1.1
+    # Shed terminal spans (root-only trees, duration ~0) must NOT
+    # dilute the decomposition — overload is exactly when the table
+    # matters (review finding).
+    s3 = telemetry_report.summarize(
+        recs + [obs_trace.terminal_span("shedX", "shed_queue_full")])
+    assert s3["spans"]["requests"] == N_REQS
+    assert s3["spans"]["latency_p50_s"] == dec["latency_p50_s"]
+
+    rid = traced_run["trace"][0]["id"]
+    assert telemetry_report.main([p["serve"], p["gateway"],
+                                  "--trace", rid]) == 0
+    out = capsys.readouterr().out
+    assert f"request {rid}" in out
+    assert "serve.segment" in out and "queue.wait" in out
+    # --json form carries the machine-readable tree.
+    assert telemetry_report.main([p["serve"], "--trace", rid,
+                                  "--json"]) == 0
+    tree = json.loads(capsys.readouterr().out)
+    assert tree["status"] == "ok" and tree["n_roots"] == 1
+    assert abs(tree["leaf_sum_s"] - tree["latency_s"]) \
+        <= obs_trace.EPSILON_ABS_S \
+        + obs_trace.EPSILON_FRAC * tree["latency_s"]
+    # An id with no spans is a loud nonzero exit, not silence.
+    assert telemetry_report.main([p["serve"], "--trace",
+                                  "nonesuch"]) == 1
+    capsys.readouterr()
+
+
+def test_shed_requests_carry_terminal_spans(tmp_path):
+    """A typed shed (503 draining) writes a root-only terminal span
+    with the shed status — 'what happened to request X' has an answer
+    even when the answer is 'refused'.  warm=False: this gateway never
+    serves, so it compiles nothing."""
+    sink = str(tmp_path / "gw.jsonl")
+    gw = Gateway(_cfg(), host=HOST, port=0, warm=False, sink=sink)
+    gw.start()
+    try:
+        gw.server.begin_drain()
+        with pytest.raises(GatewayError, match="503"):
+            submit_streaming(HOST, gw.port,
+                             {"id": "shed0", "ic": "tc2", "nsteps": 2,
+                              "outputs": ["h"]})
+        status, _, text = get_text(HOST, gw.port, "/v1/metrics")
+        assert status == 200
+        sm = parse_exposition(text)["samples"]
+        assert sm["jaxstream_requests_shed_total"][
+            'status="shed_draining"'] == 1
+    finally:
+        gw.close(drain=False)
+    spans = read_records(sink, kind="span")
+    assert len(spans) == 1
+    sp = spans[0]
+    validate_record(sp)
+    assert sp["id"] == "shed0"
+    assert sp["status"] == "shed_draining"
+    assert sp["parent_id"] is None
+    assert sp["span_id"] == obs_trace.root_span_id(
+        obs_trace.trace_id_for("shed0"))
+    tree = obs_trace.span_tree(spans)
+    assert tree["n_roots"] == 1 and tree["leaves"] == []
+
+
+def test_dashboard_feed_and_chip_panels(tmp_path, capsys):
+    """The event feed (guard/autoscale), the per-chip panel and the
+    loud unrendered-kind footer — driven from a synthetic fleet sink
+    so the panels are asserted exactly (pure stdlib, no serving)."""
+    import telemetry_dashboard
+
+    p = tmp_path / "fleet.jsonl"
+    recs = [
+        {"kind": "autoscale", "from_bucket": 1, "to_bucket": 2,
+         "queue_depth": 4, "occupancy": 1.0, "reason": "autoscale"},
+        {"kind": "guard", "step": 8, "event": "nonfinite",
+         "value": 1.0, "policy": "evict", "member": 3, "chip": 1,
+         "last_good_step": 6},
+        {"kind": "serve", "bucket": 4, "occupancy": 0.75,
+         "wall_s": 0.1, "member_steps": 6, "queue_depth": 0,
+         "chip_occupancy": [1.0, 0.5],
+         "chip_utilization": [0.9, 0.4],
+         "placement": "member", "devices": 2},
+        {"kind": "mystery", "x": 1},
+    ]
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    assert telemetry_dashboard.main([str(p), "--once",
+                                     "--json"]) == 0
+    frame = json.loads(capsys.readouterr().out)
+    assert [e["kind"] for e in frame["events"]] \
+        == ["autoscale", "guard"]
+    assert frame["chips"] == {"occupancy": [1.0, 0.5],
+                              "utilization": [0.9, 0.4],
+                              "placement": "member", "devices": 2}
+    assert frame["unrendered_kinds"] == {"mystery": 1}
+    assert frame["rates"]["member_steps_per_sec"] == [60.0]
+    assert telemetry_dashboard.main([str(p), "--once",
+                                     "--no-color"]) == 0
+    text = capsys.readouterr().out
+    assert "autoscale bucket 1 -> 2" in text
+    assert "guard step 8: nonfinite member 3 chip 1" in text
+    assert "per-chip (member x2): occ [1.00 0.50]" in text
+    assert "util [0.90 0.40]" in text
+    assert "unrendered kinds" in text and "mystery x1" in text
+
+
+def test_evicted_request_has_complete_tree_with_status(tmp_path,
+                                                       capsys):
+    """An injected-NaN eviction still yields a COMPLETE span tree —
+    root status 'evicted', >= 1 segment leaf, leaf sum == latency."""
+    sink = str(tmp_path / "serve.jsonl")
+    d = _cfg(buckets="2", sink=sink, fault_member=1,
+             max_guard_events=10)
+    d["observability"] = {"fault_step": 2}
+    srv = EnsembleServer(load_config(d))
+    srv.submit(ScenarioRequest(id="ok0", ic="tc2", nsteps=6,
+                               outputs=("h",)))
+    srv.submit(ScenarioRequest(id="bad0", ic="tc2", nsteps=6,
+                               outputs=("h",)))
+    srv.serve()
+    srv.close()
+    assert srv.results["bad0"].status == "evicted"
+    assert srv.results["ok0"].status == "ok"
+    grouped = obs_trace.spans_by_request(read_records(sink,
+                                                      kind="span"))
+    for rid in ("ok0", "bad0"):
+        ok, why = obs_trace.tree_complete(
+            grouped[rid], srv.results[rid].latency_s)
+        assert ok, (rid, why)
+    root = obs_trace.span_tree(grouped["bad0"])["root"]
+    assert root["status"] == "evicted"
+    # The registry counted the eviction under its typed status.
+    sm = parse_exposition(srv.metrics.render())["samples"]
+    assert sm["jaxstream_requests_completed_total"][
+        'status="evicted"'] == 1
+    assert sm["jaxstream_guard_events_total"][""] >= 1
+    # The dashboard shows the eviction: status in the request table,
+    # the guard trip in the event feed — from the REAL run's sink.
+    import telemetry_dashboard
+
+    assert telemetry_dashboard.main([sink, "--once", "--json"]) == 0
+    frame = json.loads(capsys.readouterr().out)
+    by_id = {r["id"]: r for r in frame["requests"]}
+    assert by_id["bad0"]["status"] == "evicted"
+    assert by_id["ok0"]["status"] == "ok"
+    assert any(e["kind"] == "guard" for e in frame["events"])
+
+
+def test_trace_ids_byte_stable_across_runs(tmp_path):
+    """Pinned digests (process-independence by construction) + two
+    runs of the same requests on one server produce byte-identical
+    span records once SPAN_TIMING_KEYS are masked."""
+    # The digest contract: pure functions of the request id — these
+    # hex literals must never change (dashboards and retention tooling
+    # may key on them across deployments).
+    assert obs_trace.trace_id_for("r0") == "75ba4657944557d4"
+    assert obs_trace.span_id_for("75ba4657944557d4", "request", 0) \
+        == "72e8a7d32bcf"
+    assert obs_trace.root_span_id("75ba4657944557d4") \
+        == "72e8a7d32bcf"
+
+    # Sink-LESS server: trace_spans retention is the direct-caller
+    # surface (sinked deployments read their sink instead — the
+    # retention dict would otherwise grow without bound).
+    srv = EnsembleServer(load_config(_cfg(buckets="1")))
+    runs = []
+    for _ in range(2):
+        for i in range(3):
+            srv.submit(ScenarioRequest(id=f"det{i}", ic="tc2",
+                                       nsteps=3, outputs=("h",)))
+        srv.serve()
+        runs.append([sp for rid in ("det0", "det1", "det2")
+                     for sp in srv.trace_spans[rid]])
+    srv.close()
+    a, b = (obs_trace.masked_spans(r) for r in runs)
+    assert a == b
+    assert len(a) >= 3 * 4                # 3 roots + >=3 leaves each
+    # Unmasked they differ (durations are real wall time) — the mask
+    # does work, it does not hide a constant.
+    assert [json.dumps(r, sort_keys=True) for r in runs[0]] \
+        != [json.dumps(r, sort_keys=True) for r in runs[1]]
+
+
+def test_trace_off_sink_records_unchanged(tmp_path):
+    """serve.trace defaults OFF, and off means off: no span records,
+    no trace fields, no manifest marker — the byte-identical-to-round-
+    14 contract."""
+    sink = str(tmp_path / "off.jsonl")
+    cfg = load_config(_cfg(buckets="1", sink=sink, trace=False))
+    assert load_config(
+        {"serve": {}}).serve.trace is False      # the default
+    srv = EnsembleServer(cfg)
+    srv.submit(ScenarioRequest(id="x0", ic="tc2", nsteps=2,
+                               outputs=("h",)))
+    srv.serve()
+    srv.close()
+    recs = read_records(sink)
+    assert sorted({r["kind"] for r in recs}) == ["manifest", "serve"]
+    for r in recs:
+        assert "trace_id" not in r and "trace_ids" not in r
+        assert "span_id" not in r
+    assert "trace" not in recs[0]["config"]
+    assert srv.trace_spans == {}
+
+
+def test_profile_endpoint_typed_contract(tmp_path):
+    """POST /v1/profile: 501 without profile_dir, start/stop round
+    trip with 409 on state misuse.  warm=False — no compiles."""
+    gw = Gateway(_cfg(), host=HOST, port=0, warm=False)
+    gw.start()
+    try:
+        st, body = post_json(HOST, gw.port, "/v1/profile",
+                             {"action": "start"})
+        assert st == 501
+        assert body["error"] == "profiler_unavailable"
+        st, body = post_json(HOST, gw.port, "/v1/profile",
+                             {"action": "bogus"})
+        assert st == 400
+    finally:
+        gw.close(drain=False)
+
+    from jaxstream.utils import jax_compat
+    if not jax_compat.profiler_available():
+        pytest.skip("this jax build has no profiler")
+    prof_dir = str(tmp_path / "prof")
+    gw = Gateway(_cfg(), host=HOST, port=0, warm=False,
+                 profile_dir=prof_dir)
+    gw.start()
+    try:
+        st, body = post_json(HOST, gw.port, "/v1/profile",
+                             {"action": "stop"})
+        assert st == 409 and body["error"] == "profile_conflict"
+        st, body = post_json(HOST, gw.port, "/v1/profile",
+                             {"action": "start"})
+        assert st == 200 and body["profiling"] is True
+        st, body = post_json(HOST, gw.port, "/v1/profile",
+                             {"action": "start"})
+        assert st == 409
+        st, body = post_json(HOST, gw.port, "/v1/profile",
+                             {"action": "stop"})
+        assert st == 200 and body["profiling"] is False
+        assert os.path.isdir(prof_dir)
+    finally:
+        gw.close(drain=False)
+
+
+# ----------------------------------------------------------- pure units
+def test_phase_table_copies_stay_identical():
+    """The stdlib scripts cannot import jaxstream; each carries a
+    literal copy of PHASE_OF.  This is the drift guard."""
+    import telemetry_dashboard
+    import telemetry_report
+
+    assert telemetry_dashboard.PHASE_OF == obs_trace.PHASE_OF
+    assert telemetry_report.PHASE_OF == obs_trace.PHASE_OF
+    assert set(obs_trace.PHASE_OF.values()) \
+        == set(telemetry_dashboard.PHASES) \
+        == set(telemetry_report.PHASES)
+
+
+def test_request_trace_marks_telescope_exactly():
+    tr = obs_trace.RequestTrace("u0", t0=100.0)
+    tr.mark("serve.pack", 100.5)
+    tr.mark("serve.segment", 100.75, bucket=2, chip=1, steps=4)
+    tr.mark("serve.host_wait", 101.0)
+    spans = tr.finish("ok", t_end=101.25)
+    root, leaves = spans[0], spans[1:]
+    assert root["duration_s"] == 1.25
+    assert root["status"] == "ok"
+    assert [l["name"] for l in leaves] == [
+        "queue.wait", "serve.pack", "serve.segment", "serve.host_wait"]
+    assert sum(l["duration_s"] for l in leaves) == root["duration_s"]
+    assert [l["start_s"] for l in leaves] == [0.0, 0.5, 0.75, 1.0]
+    seg = leaves[2]
+    assert (seg["bucket"], seg["chip"], seg["steps"]) == (2, 1, 4)
+    assert all(l["parent_id"] == root["span_id"] for l in leaves)
+    ok, why = obs_trace.tree_complete(spans, 1.25)
+    assert ok, why
+
+
+def test_tree_complete_failure_reasons():
+    tr = obs_trace.RequestTrace("u1", t0=0.0)
+    tr.mark("serve.segment", 0.5)
+    spans = tr.finish("ok", t_end=1.0)
+    ok, why = obs_trace.tree_complete(spans, 100.0)
+    assert not ok and "exceeds eps" in why
+    ok, why = obs_trace.tree_complete(spans[1:], 1.0)
+    assert not ok and "0 root spans" in why
+    ok, why = obs_trace.tree_complete(spans + spans[:1], 1.0)
+    assert not ok and "2 root spans" in why
+    no_seg = obs_trace.RequestTrace("u2", t0=0.0).finish("ok", 1.0)
+    ok, why = obs_trace.tree_complete(no_seg, 1.0)
+    assert not ok and "serve.segment" in why
+    term = obs_trace.terminal_span("u3", "shed_queue_full")
+    validate_record(term)
+    cov = obs_trace.span_coverage(
+        spans, {"u1": 1.0, "ghost": 2.0})
+    assert cov["checked"] == 2 and cov["complete"] == 1
+    assert cov["spans_complete"] == 0.5
+    assert "ghost" in cov["failures"]
+
+
+def test_metrics_registry_render_parse_roundtrip():
+    m = MetricsRegistry()
+    m.counter_inc("jobs_total", 3, status="ok")
+    m.counter_inc("jobs_total", status="ok")
+    m.counter_inc("jobs_total", status="bad")
+    m.gauge_set("depth", 7)
+    m.gauge_set("depth", 2)               # last write wins
+    for v in (0.01, 0.2, 5.0, 99.0):
+        m.observe("lat_seconds", v, buckets=(0.1, 1.0, 10.0))
+    text = m.render()
+    parsed = parse_exposition(text)
+    assert parsed["types"] == {"jobs_total": "counter",
+                               "depth": "gauge",
+                               "lat_seconds": "histogram"}
+    sm = parsed["samples"]
+    assert sm["jobs_total"]['status="ok"'] == 4
+    assert sm["jobs_total"]['status="bad"'] == 1
+    assert sm["depth"][""] == 2
+    assert sm["lat_seconds_count"][""] == 4
+    assert sm["lat_seconds_sum"][""] == pytest.approx(104.21)
+    assert sm["lat_seconds_bucket"]['le="0.1"'] == 1
+    assert sm["lat_seconds_bucket"]['le="1"'] == 2
+    assert sm["lat_seconds_bucket"]['le="10"'] == 3
+    assert sm["lat_seconds_bucket"]['le="+Inf"'] == 4
+
+    with pytest.raises(ValueError, match="already declared"):
+        m.gauge_set("jobs_total", 1)
+    with pytest.raises(ValueError, match="bad metric name"):
+        m.counter("7up")
+    # The parser is a real validator: truncated histograms and
+    # non-monotone cumulative counts are loud.
+    with pytest.raises(ValueError, match="\\+Inf"):
+        parse_exposition("# TYPE h histogram\n"
+                         'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+    with pytest.raises(ValueError, match="monotone"):
+        parse_exposition("# TYPE h histogram\n"
+                         'h_bucket{le="1"} 5\n'
+                         'h_bucket{le="+Inf"} 2\n'
+                         "h_sum 1\nh_count 2\n")
+    with pytest.raises(ValueError, match="not a valid"):
+        parse_exposition("what even is this\n")
+
+
+def test_sink_span_schema_and_sorted_errors():
+    """Round-17 bugfix half: sink rejection messages list keys/kinds
+    SORTED, so two builds produce identical error text."""
+    with pytest.raises(ValueError) as ei:
+        validate_record({"kind": "span"})
+    missing = re.findall(r"'(\w+)'", str(ei.value).split("[")[1])
+    assert missing == sorted(missing)
+    with pytest.raises(ValueError) as ei:
+        validate_record({"kind": "zeppelin"})
+    kinds = re.findall(r"'(\w+)'", str(ei.value).split("valid:")[1])
+    assert kinds == sorted(kinds)
+    assert "span" in kinds
