@@ -29,6 +29,7 @@ __all__ = [
     "PrecisionConfig",
     "PlacementConfig",
     "ServeConfig",
+    "DAConfig",
     "Config",
     "load_config",
 ]
@@ -327,6 +328,44 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DAConfig:
+    """Ensemble data assimilation (``jaxstream.da``, round 18) — the
+    EnKF cycle on the batched ensemble steppers.  ``cycles: 0`` (the
+    default) disables cycling entirely; with ``cycles > 0`` the
+    drivers (:func:`jaxstream.da.run_cycle` in-process,
+    :func:`jaxstream.da.run_cycle_gateway` through the HTTP gateway,
+    ``scripts/assimilate.py``) run that many forecast->observe->
+    analyze rounds against a hidden truth run.  Ensemble size/seed/
+    amplitude come from the ``ensemble:`` block; the plan layer
+    rejects illegal compositions statically (members >= 2, dense f32
+    single-device tiers, no temporal blocking — docs/USAGE.md "Data
+    assimilation")."""
+    cycles: int = 0           # assimilation cycles; 0 = da off
+    cycle_steps: int = 8      # forecast steps between analyses
+    nstations: int = 64       # seeded h-observing stations
+    obs_seed: int = 7         # station draw + obs noise seed
+    obs_sigma: float = 1.0    # observation error std (m of h)
+    inflation: float = 1.05   # multiplicative prior inflation
+    # Gaspari-Cohn localization half-width in km; 0 = OFF (the pure
+    # B x B ensemble-space solve — fine for dense networks/large B,
+    # spurious at small B; see USAGE "when EnKF loses").
+    localization_km: float = 0.0
+    # Ensemble-statistics guards over the cycle (spread collapse /
+    # filter divergence): 'off' | 'warn' | 'halt'.
+    guards: str = "warn"
+    # Posterior spread below this fraction of the INITIAL spread
+    # trips the spread_collapse guard.  A healthy analysis contracts
+    # spread a lot (to ~ the posterior error) — the guard is for the
+    # runaway contraction that leaves the filter rejecting all future
+    # observations, hence the deliberately low default.
+    spread_collapse_factor: float = 0.01
+    # Prior RMSE above this multiple of prior spread trips the
+    # filter_divergence guard.
+    divergence_ratio: float = 10.0
+    sink: str = ""            # JSONL path for per-cycle 'da' records
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grid: GridConfig = GridConfig()
     parallelization: ParallelConfig = ParallelConfig()
@@ -338,6 +377,7 @@ class Config:
     observability: ObservabilityConfig = ObservabilityConfig()
     precision: PrecisionConfig = PrecisionConfig()
     serve: ServeConfig = ServeConfig()
+    da: DAConfig = DAConfig()
 
 
 _SECTIONS = {
@@ -351,6 +391,7 @@ _SECTIONS = {
     "observability": ObservabilityConfig,
     "precision": PrecisionConfig,
     "serve": ServeConfig,
+    "da": DAConfig,
 }
 
 

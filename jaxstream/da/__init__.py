@@ -1,0 +1,27 @@
+"""Ensemble data assimilation (round 18): the EnKF cycle subsystem.
+
+Closes ROADMAP open item 2 — synthetic observation networks over the
+cubed sphere (:mod:`.observations`), the stochastic perturbed-
+observations EnKF analysis as pure on-device linear algebra over the
+member axis (:mod:`.enkf`), and the cycling driver (:mod:`.cycle`) in
+two modes: in-process on the config's batched stepper, and as a
+client holding a persistent member batch across cycles through the
+HTTP gateway (``scripts/assimilate.py``; docs/USAGE.md "Data
+assimilation").
+"""
+
+from .enkf import (area_weights, enkf_analysis, ensemble_rmse,
+                   ensemble_spread)
+from .observations import (ObservationNetwork, build_network,
+                           great_circle_weights, observe,
+                           perturbed_observations)
+from .cycle import DA_TIMING_KEYS, DAGuards, run_cycle, \
+    run_cycle_gateway
+
+__all__ = [
+    "ObservationNetwork", "build_network", "observe",
+    "perturbed_observations", "great_circle_weights",
+    "enkf_analysis", "ensemble_spread", "ensemble_rmse",
+    "area_weights", "DA_TIMING_KEYS", "DAGuards", "run_cycle",
+    "run_cycle_gateway",
+]

@@ -1,0 +1,542 @@
+"""The EnKF cycling driver — forecast, observe, analyze, repeat.
+
+Closes the forecast loop (ROADMAP open item 2): a hidden *truth* run
+is observed through a seeded station network each ``da.cycle_steps``
+steps, and the perturbed-IC member batch is pulled toward those
+observations by the stochastic EnKF analysis
+(:mod:`jaxstream.da.enkf`), then re-launched — the workload that turns
+"runs test cases" into "runs a forecast system".  Two drivers share
+every non-forecast piece (network, analysis jit, guards, sink
+records):
+
+* :func:`run_cycle` — **in-process**: the member batch rides the
+  config's own batched stepper (the fused member-fold kernels where
+  the plan resolves ``fused``, the vmapped classic otherwise), the
+  forecast runs under :func:`jaxstream.stepping.integrate_with_metrics`
+  with the round-18 ``h_spread``/``ens_mean_drift`` specs, and the
+  spread-collapse / filter-divergence guards fire off the IN-LOOP
+  device metric buffer — not a host-side recomputation.
+* :func:`run_cycle_gateway` — **as a client**: the member batch (plus
+  the hidden truth, riding the same bucket) persists across cycles
+  *through the HTTP gateway* — per-member result fetch, analysis
+  update, re-submit the analysis states as raw-array initial
+  conditions (the round-18 ``ic: array`` request family).  One
+  workload exercises admission, packing, per-member masking, result
+  streaming and telemetry end to end.
+
+Each cycle emits one typed ``da`` sink record (prior/posterior spread
+and ensemble-mean RMSE vs the hidden truth, innovation statistics), so
+``scripts/telemetry_report.py`` and the live dashboard render the
+cycle as it runs.  All outputs are byte-deterministic for a given
+config once :data:`DA_TIMING_KEYS` are masked.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config, load_config
+from ..geometry.cubed_sphere import build_grid
+from ..models.shallow_water_cov import (ENSEMBLE_STATE_AXES,
+                                        CovariantShallowWater)
+from ..obs import metrics as obs_metrics
+from ..obs.monitor import HealthError, HealthMonitor
+from ..obs.sink import TelemetrySink, run_manifest
+from ..physics import initial_conditions as ics
+from ..plan import rules as plan_rules
+from ..plan.plan import plan_for
+from ..plan.proof import build_proof
+from .. import stepping
+from ..utils.logging import get_logger
+from .enkf import area_weights, enkf_analysis, ensemble_rmse, \
+    ensemble_spread
+from .observations import (build_network, great_circle_weights,
+                           perturbed_observations)
+
+__all__ = ["DA_TIMING_KEYS", "DAGuards", "run_cycle",
+           "run_cycle_gateway"]
+
+log = get_logger(__name__)
+
+#: ``da`` record keys that carry wall-clock time — masked by the
+#: byte-determinism comparisons (everything else is deterministic for
+#: a given config).
+DA_TIMING_KEYS = ("wall_s",)
+
+
+class DAGuards:
+    """Spread-collapse / filter-divergence guards over the per-cycle
+    ensemble statistics.
+
+    Rides a :class:`jaxstream.obs.monitor.HealthMonitor` so guard
+    events land in the same ``monitor.events`` surface every other
+    guard in the repo uses (sink ``guard`` records, admission budgets).
+    Two conditions, both classic EnKF failure modes:
+
+    * ``spread_collapse``: the posterior spread fell below
+      ``spread_collapse_factor`` times the INITIAL ensemble spread —
+      the filter has become overconfident and will reject future
+      observations (inflation too weak / observations too trusted).
+    * ``filter_divergence``: the prior ensemble-mean RMSE exceeds
+      ``divergence_ratio`` times the prior spread — the truth has left
+      the ensemble's own uncertainty envelope, so the gain can no
+      longer pull the mean back.
+
+    Policy semantics mirror the monitor's: ``warn`` records and
+    continues, ``halt`` raises :class:`HealthError` LOUDLY with the
+    breaching cycle.
+    """
+
+    def __init__(self, policy: str, spread0: float,
+                 collapse_factor: float, divergence_ratio: float):
+        if policy not in ("off", "warn", "halt"):
+            raise ValueError(
+                f"da.guards={policy!r}; valid: 'off', 'warn', 'halt'")
+        self.policy = policy
+        self.spread0 = float(spread0)
+        self.collapse_factor = float(collapse_factor)
+        self.divergence_ratio = float(divergence_ratio)
+        self.monitor = (HealthMonitor((), policy="warn")
+                        if policy != "off" else None)
+
+    @property
+    def events(self) -> list:
+        return self.monitor.events if self.monitor is not None else []
+
+    def check(self, cycle: int, step: int, t: float,
+              spread_prior: float, spread_post: float,
+              rmse_prior: float) -> List[dict]:
+        if self.monitor is None:
+            return []
+        breaches = []
+        floor = self.collapse_factor * self.spread0
+        if spread_post < floor:
+            breaches.append((
+                "spread_collapse", spread_post,
+                f"posterior spread {spread_post:.3g} < "
+                f"{self.collapse_factor:g} x initial spread "
+                f"{self.spread0:.3g}"))
+        if rmse_prior > self.divergence_ratio * max(spread_prior,
+                                                    1e-30):
+            breaches.append((
+                "filter_divergence", rmse_prior,
+                f"prior RMSE {rmse_prior:.3g} > "
+                f"{self.divergence_ratio:g} x prior spread "
+                f"{spread_prior:.3g}"))
+        events = []
+        for kind, value, detail in breaches:
+            event = {
+                "kind": "guard", "event": kind, "step": int(step),
+                "t": float(t), "value": float(value),
+                "policy": self.policy, "cycle": int(cycle),
+                "last_good_step": self.monitor.last_good_step,
+                "last_good_t": self.monitor.last_good_t,
+            }
+            events.append(event)
+            self.monitor.events.append(event)
+            log.warning("da guard: %s at cycle %d (%s) — policy %r",
+                        kind, cycle, detail, self.policy)
+            if self.policy == "halt":
+                raise HealthError(kind, step, value,
+                                  self.monitor.last_good_step,
+                                  self.monitor.last_good_t)
+        if not breaches:
+            self.monitor.last_good_step = int(step)
+            self.monitor.last_good_t = float(t)
+        return events
+
+
+class _Problem:
+    """Shared setup of both drivers: grid, model, ICs, network,
+    localization weights, analysis/stat jits.
+
+    ``serving=True`` (the gateway-client driver) resolves the
+    SERVING plan — the forecast executes in the deployment's bucket
+    steppers, so that is the program the cycle's proof stamp names;
+    the in-process driver resolves the config's own (da-marked)
+    forecast plan, which the da-* rules constrain statically."""
+
+    def __init__(self, cfg: Config, serving: bool = False):
+        self.cfg = cfg
+        d, ens = cfg.da, cfg.ensemble
+        if d.cycles < 1:
+            raise ValueError(
+                f"da.cycles must be >= 1 to run a cycle, got "
+                f"{d.cycles}")
+        if d.cycle_steps < 1:
+            raise ValueError(
+                f"da.cycle_steps must be >= 1, got {d.cycle_steps}")
+        if not 0.0 < d.spread_collapse_factor < 1.0:
+            raise ValueError(
+                "da.spread_collapse_factor must be in (0, 1), got "
+                f"{d.spread_collapse_factor}")
+        if d.inflation < 1.0:
+            raise ValueError(
+                f"da.inflation must be >= 1.0, got {d.inflation}")
+        if ens.members < 2:
+            # The serving resolution would not reach the da rules —
+            # raise the same pointer the table carries.
+            plan_rules.fail("da-needs-ensemble")
+        # The plan layer owns composition legality (da-* rules on the
+        # in-process forecast: members >= 2, dense f32 single-device
+        # tiers, no temporal blocking) — rejected statically.
+        self.plan = plan_for(cfg, serving=serving)
+        self.proof = build_proof(self.plan)
+        self.B = ens.members
+        halo = cfg.grid.halo
+        if cfg.model.scheme == "ppm":
+            halo = max(halo, 3)
+        dtype = {"float32": jnp.float32, "float64": jnp.float64,
+                 "bfloat16": jnp.bfloat16}[cfg.grid.dtype]
+        self.grid = build_grid(cfg.grid.n, halo=halo,
+                               radius=cfg.grid.radius, dtype=dtype,
+                               metrics=cfg.grid.metrics)
+        p, m = cfg.physics, cfg.model
+        name = m.initial_condition
+        b_ext = None
+        if name == "tc2":
+            h, v = ics.williamson_tc2(self.grid, p.gravity, p.omega,
+                                      alpha_rot=m.ic_angle)
+        elif name == "tc5":
+            h, v, b_ext = ics.williamson_tc5(self.grid, p.gravity,
+                                             p.omega)
+        elif name == "tc6":
+            h, v = ics.williamson_tc6(self.grid, p.gravity, p.omega)
+        elif name == "galewsky":
+            h, v = ics.galewsky(self.grid, p.gravity, p.omega)
+        else:
+            raise ValueError(
+                f"da cycling drives the shallow-water families "
+                f"(tc2/tc5/tc6/galewsky); got initial_condition="
+                f"{name!r}")
+        self.model = CovariantShallowWater(
+            self.grid, gravity=p.gravity, omega=p.omega, b_ext=b_ext,
+            scheme=m.scheme, limiter=m.limiter,
+            nu4=p.hyperdiffusion, backend=m.backend)
+        # Hidden truth: the unperturbed IC.  Members 1..B of a (B+1)-
+        # member perturbed draw — every member differs from the truth,
+        # so the initial ensemble-mean error is nonzero and the
+        # cycled-vs-free comparison measures the filter, not the IC.
+        self.truth0 = self.model.initial_state(h, v)
+        h_b = ics.perturbed_ensemble(self.grid, h, self.B + 1,
+                                     seed=ens.seed,
+                                     amplitude=ens.amplitude)
+        members = [self.model.initial_state(h_b[i + 1], v)
+                   for i in range(self.B)]
+        self.ens0 = self.model.stack_ensemble(members)
+        self.net = build_network(self.grid, d.nstations, d.obs_seed,
+                                 d.obs_sigma)
+        self.rho_xy = self.rho_yy = None
+        if d.localization_km > 0.0:
+            self.rho_xy, self.rho_yy = great_circle_weights(
+                self.grid, self.net, d.localization_km)
+        self.w = area_weights(self.grid)
+        self.key0 = jax.random.PRNGKey(d.obs_seed)
+
+        def stats_fn(h, truth_h):
+            return {"spread": ensemble_spread(h, self.w),
+                    "rmse": ensemble_rmse(h, truth_h, self.w)}
+
+        def analysis_fn(h, u, truth_h, key):
+            y_obs, eps = perturbed_observations(self.net, truth_h,
+                                                key, self.B)
+            h_a, u_a, st = enkf_analysis(
+                h, u, self.net, y_obs, eps, inflation=d.inflation,
+                rho_xy=self.rho_xy, rho_yy=self.rho_yy)
+            st.update({f"{k}_post": v
+                       for k, v in stats_fn(h_a, truth_h).items()})
+            st.update(stats_fn(h, truth_h))
+            return h_a, u_a, st
+
+        self.stats = jax.jit(stats_fn)
+        self.analysis = jax.jit(analysis_fn)
+
+    def guards(self) -> DAGuards:
+        d = self.cfg.da
+        spread0 = float(self.stats(self.ens0["h"],
+                                   self.truth0["h"])["spread"])
+        return DAGuards(d.guards, spread0, d.spread_collapse_factor,
+                        d.divergence_ratio)
+
+    def manifest_config(self, mode: str, assimilate: bool) -> dict:
+        d = self.cfg.da
+        return {
+            "da": True, "mode": mode, "assimilate": assimilate,
+            "grid_n": self.cfg.grid.n, "dt": self.cfg.time.dt,
+            "members": self.B, "cycles": d.cycles,
+            "cycle_steps": d.cycle_steps, "nstations": self.net.p,
+            "obs_sigma": d.obs_sigma, "inflation": d.inflation,
+            "localization_km": d.localization_km,
+            "plan": self.plan.key(), "proof_verdict":
+                self.proof.verdict,
+            "rules_version": plan_rules.RULES_VERSION,
+        }
+
+
+def _summary(mode: str, assimilate: bool, prob: _Problem,
+             records: List[dict], guards: DAGuards) -> dict:
+    rmses = [r["rmse"] for r in records]
+    return {
+        "mode": mode, "assimilate": assimilate,
+        "plan": prob.plan.key(),
+        "proof_verdict": prob.proof.verdict,
+        "members": prob.B, "nstations": prob.net.p,
+        "cycles": records,
+        "final_rmse": rmses[-1] if rmses else None,
+        "mean_rmse": (sum(rmses) / len(rmses)) if rmses else None,
+        "final_spread": records[-1]["spread_post"] if records
+        else None,
+        "guard_events": list(guards.events),
+    }
+
+
+def run_cycle(config=None, assimilate: bool = True,
+              sink: Optional[str] = None) -> dict:
+    """In-process EnKF cycle on the config's batched stepper.
+
+    ``assimilate=False`` runs the FREE ensemble — identical seeds,
+    identical forecast executable, no analysis — the baseline the
+    forecast claim is measured against.  Returns the summary dict
+    (per-cycle records under ``"cycles"``); writes ``da`` sink
+    records when ``da.sink`` (or ``sink``) names a path.
+    """
+    cfg = load_config(config)
+    prob = _Problem(cfg)
+    d, dt, seg = cfg.da, cfg.time.dt, cfg.da.cycle_steps
+    m = prob.model
+
+    fused = prob.plan.tier == "fused"
+    if fused:
+        # The batched compact carry (ENSEMBLE_CARRY_AXES layout): the
+        # analysis rewrites h/u, so strips are re-packed per cycle.
+        step = m.make_fused_step(dt, ensemble=prob.B)
+        prep = m.ensemble_compact_state
+    else:
+        step = stepping.vmap_ensemble(
+            m.make_step(dt, cfg.time.scheme), ENSEMBLE_STATE_AXES)
+        prep = lambda st: st
+
+    # Round-18 satellite: the ensemble statistics ride the DEVICE
+    # metric buffer inside the compiled forecast segment — the guard
+    # reads the in-loop h_spread row, not a host recomputation.
+    ms = obs_metrics.build_metric_set(
+        prob.grid, m, prob.ens0, ("h_spread", "ens_mean_drift"),
+        dt, cfg.physics.gravity)
+    metric_fn = lambda y, t: ms.values({"h": y["h"], "u": y["u"]})
+
+    def forecast_fn(y, t, step0):
+        return stepping.integrate_with_metrics(
+            step, y, t, seg, dt, metric_fn, every=seg, n_samples=1,
+            step0=step0, steps_per_call=1)
+
+    forecast = jax.jit(forecast_fn)
+    truth_step = m.make_step(dt, cfg.time.scheme)
+    truth_seg = jax.jit(
+        lambda y, t: stepping.integrate(truth_step, y, t, seg, dt,
+                                        unroll=1))
+
+    guards = prob.guards()
+    sink_path = sink if sink is not None else d.sink
+    tsink = (TelemetrySink(sink_path, run_manifest(
+        config=prob.manifest_config("inprocess", assimilate)))
+        if sink_path else None)
+    records: List[dict] = []
+    truth, y = prob.truth0, prep(prob.ens0)
+    t = 0.0
+    try:
+        for c in range(d.cycles):
+            w0 = time.perf_counter()
+            truth, _ = truth_seg(truth, jnp.asarray(t, jnp.float32))
+            y, _, buf = forecast(y, jnp.asarray(t, jnp.float32),
+                                 jnp.int32(c * seg))
+            buf_host = obs_metrics.fetch_buffer(buf)
+            spread_inloop = float(buf_host[0, 0])
+            drift_inloop = float(buf_host[1, 0])
+            t = (c + 1) * seg * dt
+            step_now = (c + 1) * seg
+            h, u = y["h"], y["u"]
+            key = jax.random.fold_in(prob.key0, c)
+            if assimilate:
+                h_a, u_a, st = prob.analysis(h, u, truth["h"], key)
+                st = {k: float(v) for k, v in st.items()}
+                y = prep({"h": h_a, "u": u_a})
+            else:
+                base = {k: float(v)
+                        for k, v in prob.stats(h, truth["h"]).items()}
+                st = dict(base)
+                st.update({f"{k}_post": v for k, v in base.items()})
+                st.update(innovation_mean=0.0, innovation_rms=0.0)
+                y = prep({"h": h, "u": u})
+            rec = {
+                "kind": "da", "cycle": c, "step": step_now,
+                "t": float(t), "mode": "inprocess",
+                "spread": round(spread_inloop, 10),
+                "rmse": round(st["rmse"], 10),
+                "spread_post": round(st["spread_post"], 10),
+                "rmse_post": round(st["rmse_post"], 10),
+                "innovation_mean": round(st["innovation_mean"], 10),
+                "innovation_rms": round(st["innovation_rms"], 10),
+                "ens_mean_drift": round(drift_inloop, 10),
+                "nobs": prob.net.p,
+                "wall_s": round(time.perf_counter() - w0, 6),
+            }
+            records.append(rec)
+            if tsink is not None:
+                tsink.write(rec)
+            try:
+                events = guards.check(c, step_now, t, spread_inloop,
+                                      st["spread_post"], st["rmse"])
+            except HealthError:
+                if tsink is not None:
+                    for ev in guards.events:
+                        tsink.write(ev)
+                raise
+            if tsink is not None:
+                for ev in events:
+                    tsink.write(ev)
+    finally:
+        if tsink is not None:
+            tsink.close()
+    return _summary("inprocess", assimilate, prob, records, guards)
+
+
+def run_cycle_gateway(config=None, host: str = "127.0.0.1",
+                      port: int = 0, assimilate: bool = True,
+                      sink: Optional[str] = None,
+                      timeout: float = 300.0) -> dict:
+    """The EnKF cycle as a GATEWAY CLIENT (round 18's closed loop).
+
+    Holds a persistent member batch — members 0..B-1 plus the hidden
+    truth — across cycles through the HTTP gateway at ``(host,
+    port)``: each cycle submits ``B + 1`` raw-array requests
+    (``ic: array``), streams their results, runs the analysis update
+    on the fetched member states, and re-submits the analysis states
+    as the next cycle's initial conditions.  The truth rides the same
+    batch (it is "hidden" from the *filter* — only its station
+    observations enter the update), so every request packs into one
+    bucket and per-member results are byte-deterministic run to run.
+
+    The serving config should pin ``serve.buckets`` to the single
+    bucket ``B + 1`` — a smaller warm bucket would let an early
+    admission run in a different executable and break byte
+    determinism across runs (docs/USAGE.md "Data assimilation").
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..gateway import protocol, submit_streaming
+    from ..gateway.client import final_result
+
+    cfg = load_config(config)
+    prob = _Problem(cfg, serving=True)
+    d, dt, seg = cfg.da, cfg.time.dt, cfg.da.cycle_steps
+    guards = prob.guards()
+    sink_path = sink if sink is not None else d.sink
+    tsink = (TelemetrySink(sink_path, run_manifest(
+        config=prob.manifest_config("gateway", assimilate)))
+        if sink_path else None)
+
+    def submit_batch(cycle: int, states: Dict[str, dict]):
+        """Submit one cycle's member batch; returns id -> result."""
+        def one(item):
+            rid, st = item
+            body = {
+                "id": rid, "ic": "array", "nsteps": seg,
+                "outputs": ["h", "u"],
+                "state": {k: protocol.encode_array(v)
+                          for k, v in st.items()},
+            }
+            status, events = submit_streaming(host, port, body,
+                                              timeout=timeout)
+            res = final_result(events)
+            if res is None or not res.ok:
+                raise RuntimeError(
+                    f"da gateway cycle {cycle}: request {rid!r} did "
+                    f"not complete ok "
+                    f"(status={getattr(res, 'status', None)!r})")
+            return rid, res
+        with ThreadPoolExecutor(max_workers=len(states)) as ex:
+            return dict(ex.map(one, sorted(states.items())))
+
+    def to_np(st):
+        return {k: np.asarray(v) for k, v in st.items()}
+
+    states = {f"m{i}": to_np(prob.model.member_state(prob.ens0, i))
+              for i in range(prob.B)}
+    states["truth"] = to_np(prob.truth0)
+    records: List[dict] = []
+    # Distinct id prefixes per run kind: a cycled run and its free
+    # baseline often share one gateway (assimilate.py
+    # --free-baseline), and trace ids are request-id digests — reused
+    # ids would collide the two runs' span trees in the serve sink.
+    prefix = "da" if assimilate else "dafree"
+    try:
+        for c in range(d.cycles):
+            w0 = time.perf_counter()
+            results = submit_batch(
+                c, {f"{prefix}-c{c}-{k}": v
+                    for k, v in states.items()})
+            by_key = {rid.split("-", 2)[2]: res
+                      for rid, res in results.items()}
+            truth_h = jnp.asarray(by_key["truth"].fields["h"])
+            h = jnp.stack([
+                jnp.asarray(by_key[f"m{i}"].fields["h"])
+                for i in range(prob.B)])
+            u = jnp.stack([
+                jnp.asarray(by_key[f"m{i}"].fields["u"])
+                for i in range(prob.B)], axis=1)
+            t = (c + 1) * seg * dt
+            step_now = (c + 1) * seg
+            key = jax.random.fold_in(prob.key0, c)
+            if assimilate:
+                # analysis_fn already computes the prior spread/rmse
+                # on its inputs — no second stats launch needed.
+                h_a, u_a, st = prob.analysis(h, u, truth_h, key)
+                st = {k: float(v) for k, v in st.items()}
+            else:
+                h_a, u_a = h, u
+                st = {k: float(v)
+                      for k, v in prob.stats(h, truth_h).items()}
+                st.update({f"{k}_post": st[k]
+                           for k in ("spread", "rmse")})
+                st.update(innovation_mean=0.0, innovation_rms=0.0)
+            rec = {
+                "kind": "da", "cycle": c, "step": step_now,
+                "t": float(t), "mode": "gateway",
+                "spread": round(st["spread"], 10),
+                "rmse": round(st["rmse"], 10),
+                "spread_post": round(st["spread_post"], 10),
+                "rmse_post": round(st["rmse_post"], 10),
+                "innovation_mean": round(st["innovation_mean"], 10),
+                "innovation_rms": round(st["innovation_rms"], 10),
+                "nobs": prob.net.p,
+                "wall_s": round(time.perf_counter() - w0, 6),
+            }
+            records.append(rec)
+            if tsink is not None:
+                tsink.write(rec)
+            try:
+                events = guards.check(c, step_now, t, st["spread"],
+                                      st["spread_post"], st["rmse"])
+            except HealthError:
+                if tsink is not None:
+                    for ev in guards.events:
+                        tsink.write(ev)
+                raise
+            if tsink is not None:
+                for ev in events:
+                    tsink.write(ev)
+            h_np = np.asarray(h_a)
+            u_np = np.asarray(u_a)
+            states = {f"m{i}": {"h": h_np[i], "u": u_np[:, i]}
+                      for i in range(prob.B)}
+            states["truth"] = to_np(by_key["truth"].fields)
+    finally:
+        if tsink is not None:
+            tsink.close()
+    return _summary("gateway", assimilate, prob, records, guards)

@@ -1,0 +1,136 @@
+"""The stochastic (perturbed-observations) EnKF analysis step.
+
+Pure on-device linear algebra over the member axis — the whole update
+is a handful of matmuls and one small solve, traced into ONE jit by
+the cycle driver, so between forecast and analysis the ensemble state
+never leaves the device (docs/DESIGN.md "EnKF as a service"):
+
+* **Batched innovations**: every member's innovation ``d_i = (y + eps_i)
+  - H x_i`` is formed in one ``(B, p)`` block (Burgers et al. 1998 —
+  the stochastic perturbed-observations form, whose analysis ensemble
+  has the correct posterior covariance in expectation).
+* **B x B ensemble-space solve** (the default, ``localization_km: 0``):
+  by the push-through identity the Kalman gain applied to the
+  innovations is ``X'^T C^{-1} Y' D^T`` with ``C = (B-1) sigma^2 I_B +
+  Y' Y'^T`` — a ``(B, B)`` solve however many cells or stations exist,
+  the textbook reason the analysis lives comfortably on device.
+* **Covariance localization by great-circle distance**
+  (``localization_km > 0``): the Gaspari–Cohn taper of
+  :func:`..da.observations.great_circle_weights` Schur-multiplies the
+  sample covariances ``P_xy``/``P_yy``; the solve moves to observation
+  space (``p x p`` — still tiny) because tapering breaks the low-rank
+  structure the ensemble-space form exploits.  Small ensembles need
+  this: spurious long-range sample covariances are what makes a raw
+  B=4..16 EnKF update remote cells off noise.
+* **Multiplicative inflation**: prior anomalies are scaled by
+  ``inflation`` before the update — the standard counter to the
+  sampling-error spread deficit that otherwise collapses the filter.
+
+Every function is shape-polymorphic in the member count and f32
+throughout (the serving tier's numerics — analysis states re-enter the
+gateway as f32 ``ic: array`` payloads byte-unchanged).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils import diagnostics as diag
+from .observations import ObservationNetwork, observe
+
+__all__ = ["enkf_analysis", "ensemble_spread", "ensemble_rmse",
+           "area_weights"]
+
+
+def area_weights(grid):
+    """Normalized interior cell-area weights ``(6, n, n)``, f32 (the
+    analysis dtype) — so a coarse cubed-sphere corner cell does not
+    count like an equatorial one.  The formula is the shared one in
+    :mod:`jaxstream.utils.diagnostics` — the SAME weights back the
+    in-loop ``h_spread`` MetricSpec, so the guard's prior (in-loop)
+    and posterior (analysis) spreads can never drift apart."""
+    return diag.ensemble_area_weights(grid, jnp.float32)
+
+
+def ensemble_spread(h, w):
+    """Area-weighted RMS ensemble spread of ``h`` ``(B, 6, n, n)``
+    (:func:`jaxstream.utils.diagnostics.ensemble_spread`)."""
+    return diag.ensemble_spread(h, w)
+
+
+def ensemble_rmse(h, truth_h, w):
+    """Area-weighted RMSE of the ensemble mean against the (hidden)
+    truth field."""
+    return diag.ensemble_mean_rmse(h, truth_h, w)
+
+
+def _flatten_members(h, u):
+    """(B, N) height block and (B, 2N) velocity block."""
+    B = h.shape[0]
+    return (h.reshape(B, -1),
+            jnp.moveaxis(u, 1, 0).reshape(B, -1))
+
+
+def enkf_analysis(h, u, net: ObservationNetwork, y_obs, obs_pert,
+                  inflation: float = 1.0, rho_xy=None, rho_yy=None):
+    """One analysis update of a member batch.
+
+    ``h`` ``(B, 6, n, n)`` / ``u`` ``(2, B, 6, n, n)`` — the interior
+    ensemble state in the repo's member-axis layout; ``y_obs`` ``(p,)``
+    the measured station heights; ``obs_pert`` ``(B, p)`` the member
+    observation perturbations (:func:`..da.observations.
+    perturbed_observations`).  ``rho_xy``/``rho_yy`` switch on
+    localization (both or neither).  Returns ``(h_a, u_a, stats)``
+    with ``stats`` a dict of 0-d device scalars (innovation mean/RMS)
+    — the caller fetches them with the cycle's one stats transfer.
+
+    Both prognostics are updated by the same ensemble regression
+    (heights observed, winds corrected through the sampled h–u
+    covariances), which is what keeps analysis states balanced enough
+    to re-enter the forecast without re-initialization.
+    """
+    if (rho_xy is None) != (rho_yy is None):
+        raise ValueError("localization needs both rho_xy and rho_yy")
+    B = h.shape[0]
+    h_shape, u_shape = h.shape, u.shape
+    Xh, Xu = _flatten_members(h, u)
+    mh, mu = jnp.mean(Xh, axis=0), jnp.mean(Xu, axis=0)
+    infl = jnp.asarray(inflation, Xh.dtype)
+    Ah = infl * (Xh - mh)                  # prior anomalies, inflated
+    Au = infl * (Xu - mu)
+    Xh, Xu = mh + Ah, mu + Au              # the inflated prior
+    h_prior = Xh.reshape(h_shape)
+    Hx = observe(net, h_prior)             # (B, p)
+    Yp = Hx - jnp.mean(Hx, axis=0)
+    D = (y_obs[None, :] + obs_pert) - Hx   # batched innovations
+    sigma2 = jnp.asarray(net.sigma, Xh.dtype) ** 2
+
+    if rho_xy is None:
+        # Ensemble-space form: K D^T = X'^T C^{-1} Y' D^T with
+        # C = (B-1) sigma^2 I + Y' Y'^T  — one (B, B) solve.
+        C = ((B - 1) * sigma2 * jnp.eye(B, dtype=Xh.dtype)
+             + Yp @ Yp.T)
+        W = jnp.linalg.solve(C, Yp @ D.T)  # (B, B)
+        Xh_a = Xh + W.T @ Ah
+        Xu_a = Xu + W.T @ Au
+    else:
+        # Observation-space form with Schur localization: P_yy and
+        # P_xy tapered by great-circle distance, one (p, p) solve.
+        Pyy = (rho_yy * (Yp.T @ Yp) / (B - 1)
+               + sigma2 * jnp.eye(Yp.shape[1], dtype=Xh.dtype))
+        S = jnp.linalg.solve(Pyy, D.T)     # (p, B)
+        Kh = rho_xy * (Ah.T @ Yp) / (B - 1)            # (N, p)
+        Ku = jnp.concatenate([rho_xy, rho_xy], axis=0) \
+            * (Au.T @ Yp) / (B - 1)                    # (2N, p)
+        Xh_a = Xh + (Kh @ S).T
+        Xu_a = Xu + (Ku @ S).T
+
+    innov = y_obs - jnp.mean(Hx, axis=0)
+    stats = {
+        "innovation_mean": jnp.mean(innov),
+        "innovation_rms": jnp.sqrt(jnp.mean(innov * innov)),
+    }
+    h_a = Xh_a.reshape(h_shape)
+    u_a = jnp.moveaxis(
+        Xu_a.reshape((B, 2) + u_shape[2:]), 0, 1)
+    return h_a, u_a, stats

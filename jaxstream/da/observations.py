@@ -1,0 +1,156 @@
+"""Synthetic observation networks over the cubed sphere.
+
+The forecast loop's measurement half: a seeded *station set* — fixed
+cell centers drawn deterministically over the sphere — whose
+observation operator ``H`` is a pure-JAX gather over the interior
+``(6, n, n)`` layout (advanced indexing on the last three axes, so the
+SAME operator observes a single state or a whole ``(B, 6, n, n)``
+member batch with no reshape).  Observations of the hidden truth run
+are the truth's gathered heights plus seeded Gaussian error — the
+standard synthetic-obs (OSSE) recipe the EnKF cycle assimilates
+(Galewsky et al. 2004 jet as the chaotic test bed; docs/USAGE.md
+"Data assimilation").
+
+Everything is deterministic in ``(n, nstations, seed)``: station
+draws use a ``numpy`` generator, observation noise a ``jax.random``
+key folded per cycle, so two runs of one cycle configuration produce
+byte-identical observation sequences (the acceptance criterion the
+cycle tests byte-compare under).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ObservationNetwork", "build_network", "observe",
+           "perturbed_observations", "great_circle_weights"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ObservationNetwork:
+    """``nstations`` fixed h-observing stations at interior cell
+    centers.  ``face``/``iy``/``ix`` index the interior ``(6, n, n)``
+    layout; ``xyz`` is the stations' unit position (3, p) used for
+    great-circle localization; ``sigma`` the observation error std
+    (meters of h)."""
+
+    face: np.ndarray            # (p,) int
+    iy: np.ndarray              # (p,) int
+    ix: np.ndarray              # (p,) int
+    xyz: np.ndarray             # (3, p) float, unit vectors
+    sigma: float
+
+    @property
+    def p(self) -> int:
+        return int(self.face.shape[0])
+
+
+def build_network(grid, nstations: int, seed: int,
+                  sigma: float) -> ObservationNetwork:
+    """Draw a seeded station set: ``nstations`` distinct interior
+    cells, sampled uniformly over the global cell index space with a
+    deterministic ``numpy`` generator.  Cell-uniform sampling is
+    near-area-uniform on the cubed sphere (equiangular cells vary ~
+    30% in area), which is all a synthetic network needs — the draw is
+    part of the experiment's identity, not a physical station list."""
+    n = grid.n
+    if nstations < 1:
+        raise ValueError(f"da.nstations must be >= 1, got {nstations}")
+    if nstations > 6 * n * n:
+        raise ValueError(
+            f"da.nstations={nstations} exceeds the {6 * n * n} "
+            f"interior cells of a C{n} grid")
+    if sigma <= 0.0:
+        raise ValueError(f"da.obs_sigma must be > 0, got {sigma}")
+    rng = np.random.default_rng(seed)
+    flat = rng.choice(6 * n * n, size=nstations, replace=False)
+    flat.sort()                 # canonical order: network identity is
+    face, rest = np.divmod(flat, n * n)      # the SET, not the draw
+    iy, ix = np.divmod(rest, n)
+    xyz_int = np.asarray(grid.interior(grid.xyz), np.float64)
+    xyz = xyz_int[:, face, iy, ix]
+    xyz = xyz / np.linalg.norm(xyz, axis=0, keepdims=True)
+    return ObservationNetwork(face=face, iy=iy, ix=ix, xyz=xyz,
+                              sigma=float(sigma))
+
+
+def observe(net: ObservationNetwork, h):
+    """The observation operator ``H``: gather station heights out of
+    an interior ``(6, n, n)`` field — or a member batch ``(B, 6, n,
+    n)``, returning ``(B, p)``.  A pure gather, so it traces into the
+    analysis jit with no host round trip."""
+    return h[..., net.face, net.iy, net.ix]
+
+
+def perturbed_observations(net: ObservationNetwork, truth_h, key,
+                           members: int):
+    """One cycle's synthetic observations.
+
+    Returns ``(y_obs, obs_perturbations)``: ``y_obs`` ``(p,)`` is
+    ``H(truth) + sigma * eps0`` (the measured values), and
+    ``obs_perturbations`` ``(B, p)`` the per-member stochastic
+    observation perturbations of the perturbed-observations EnKF
+    (Burgers et al. 1998) — drawn from the SAME fold of ``key`` so one
+    key pins the cycle's whole stochastic state.
+    """
+    y_true = observe(net, truth_h)
+    k_obs, k_mem = jax.random.split(key)
+    eps0 = jax.random.normal(k_obs, y_true.shape, y_true.dtype)
+    eps = jax.random.normal(k_mem, (members,) + y_true.shape,
+                            y_true.dtype)
+    return y_true + net.sigma * eps0, net.sigma * eps
+
+
+def great_circle_weights(grid, net: ObservationNetwork,
+                         radius_km: float):
+    """Gaspari–Cohn-style covariance localization weights by
+    great-circle distance.
+
+    Returns ``(rho_xy, rho_yy)``: ``rho_xy`` ``(N, p)`` tapers the
+    state–observation covariances (``N = 6 n^2`` interior cells, in
+    flattened ``(6, n, n)`` order — the same order the analysis
+    flattens state blocks into), ``rho_yy`` ``(p, p)`` the
+    observation–observation covariances.  The taper is the compactly
+    supported Gaspari & Cohn (1999) 5th-order polynomial with support
+    ``2 * radius_km`` (half-width ``c = radius_km``), evaluated on the
+    sphere's great-circle distances — zero beyond 2c, so distant
+    spurious sample covariances are cut exactly.
+    """
+    if radius_km <= 0.0:
+        raise ValueError(
+            f"localization radius must be > 0 km, got {radius_km}")
+    xyz_int = np.asarray(grid.interior(grid.xyz), np.float64)
+    cells = xyz_int.reshape(3, -1)
+    cells = cells / np.linalg.norm(cells, axis=0, keepdims=True)
+    radius_m = float(radius_km) * 1.0e3
+
+    def dist_to(points):
+        cosang = np.clip(points.T @ net.xyz, -1.0, 1.0)
+        return float(grid.radius) * np.arccos(cosang)
+
+    rho_xy = _gaspari_cohn(dist_to(cells) / radius_m)
+    rho_yy = _gaspari_cohn(dist_to(net.xyz) / radius_m)
+    return (jnp.asarray(rho_xy, jnp.float32),
+            jnp.asarray(rho_yy, jnp.float32))
+
+
+def _gaspari_cohn(r: np.ndarray) -> np.ndarray:
+    """Gaspari & Cohn (1999) eq. 4.10 taper; ``r`` = distance / c
+    (c the half-support).  1 at r=0, 0 for r >= 2."""
+    r = np.asarray(r, np.float64)
+    out = np.zeros_like(r)
+    near = r <= 1.0
+    far = (r > 1.0) & (r < 2.0)
+    x = r[near]
+    out[near] = (-0.25 * x**5 + 0.5 * x**4 + 0.625 * x**3
+                 - (5.0 / 3.0) * x**2 + 1.0)
+    x = r[far]
+    out[far] = (x**5 / 12.0 - 0.5 * x**4 + 0.625 * x**3
+                + (5.0 / 3.0) * x**2 - 5.0 * x + 4.0
+                - 2.0 / (3.0 * x))
+    return np.clip(out, 0.0, 1.0)

@@ -453,6 +453,15 @@ class Gateway:
             self._drop_stream(req.id)
             return None, (self._shed(req.id, "admission_refused",
                                      str(e), t_in0), 503)
+        except ValueError as e:
+            # Deployment-level request validation (round 18: an
+            # ic 'array' state whose shape/dtype does not match the
+            # serving grid) — a caller bug, typed 400 like the codec's
+            # own rejections, never an untyped 500.
+            self._drop_stream(req.id)
+            self.stats["bad_requests"] += 1
+            return None, (protocol.error_event(
+                "bad_request", str(e), rid=req.id), 400)
         except Exception as e:
             # Anything unexpected (e.g. the server closed under the
             # still-bound endpoint) must not leak the stream entry —

@@ -111,6 +111,20 @@ def request_from_json(d) -> ScenarioRequest:
                          "remove it from the request body")
     if not d.get("id"):
         raise ValueError("request body needs a non-empty 'id'")
+    if isinstance(d.get("state"), dict):
+        # Raw-array initial conditions (ic: 'array', round 18): each
+        # field arrives as the byte-preserving b64 payload encode_array
+        # produces — decode to host numpy so the request codec's
+        # output is what a direct EnsembleServer submission carries.
+        d = dict(d)
+        try:
+            d["state"] = {k: (decode_array(v) if isinstance(v, dict)
+                              else v)
+                          for k, v in d["state"].items()}
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(
+                f"bad 'state' array payload: {type(e).__name__}: {e}"
+            ) from None
     try:
         return ScenarioRequest.from_dict(d)
     except TypeError as e:

@@ -127,6 +127,19 @@ class _Ctx:
     def all_arrays(self):
         return [v for v in self.state.values()]
 
+    @property
+    def field_all(self):
+        """The full (possibly member-batched) primary field."""
+        return self.state[self.ms.field_key]
+
+    @property
+    def area_w(self):
+        """Normalized interior cell-area weights — the shared formula
+        (utils.diagnostics) the area-RMS ensemble statistics and the
+        EnKF cycle's analysis-side spread both integrate under."""
+        return self._memo(
+            "area_w", lambda: diag.ensemble_area_weights(self.grid))
+
 
 METRICS: Dict[str, MetricSpec] = {}
 
@@ -166,6 +179,23 @@ _register("cfl", "dt * max_cell (sqrt(gh) + |v|)(1/dxa + 1/dxb)", {"swe"},
               * c.ms.inv_dx))
 _register("nonfinite_count", "number of non-finite state entries "
           "(all members)", set(), _nonfinite)
+# Round 18 (ensemble data assimilation): in-loop ensemble statistics.
+# Both ride the DEVICE metric buffer of a member-batched run — the
+# EnKF cycle's spread-collapse guard and the dashboard sparkline read
+# the stream, not a host-side Simulation diagnostic.  'ensemble' is a
+# capability tag only member-batched states provide (field rank 4).
+_register("h_spread", "area-RMS ensemble spread of h "
+          "(sqrt of weighted mean member variance)",
+          {"swe", "ensemble"},
+          lambda c: diag.ensemble_spread(c.field_all, c.area_w))
+# Member 0 is the unperturbed control in standard `ensemble:` runs;
+# DA-cycle ensembles perturb every member, so there the statistic
+# reads mean-vs-first-member (still the mean's wander scale, no
+# longer a control comparison — docs/USAGE.md "Data assimilation").
+_register("ens_mean_drift", "area-RMS distance of the ensemble-mean "
+          "h from member 0",
+          {"swe", "ensemble"},
+          lambda c: diag.ensemble_mean_drift(c.field_all, c.area_w))
 _register("tracer_mass", "integral q dA", {"advection"},
           lambda c: diag.total_mass(c.grid, c.field0))
 _register("tracer_max", "max q (shape preservation)", {"advection"},
@@ -257,12 +287,15 @@ class MetricSet:
         return jnp.stack([jnp.asarray(s.fn(ctx)) for s in self.specs])
 
 
-def resolve_metric_names(names, family: str, cov: bool) -> tuple:
+def resolve_metric_names(names, family: str, cov: bool,
+                         batched: bool = False) -> tuple:
     """Config value -> validated metric-name tuple.
 
     Accepts a list/tuple, a comma-separated string, or ``"default"`` /
     ``""`` (the family ladder).  Unknown names and metrics a family
-    cannot provide raise with the valid set listed.
+    cannot provide raise with the valid set listed.  ``batched`` adds
+    the ``ensemble`` capability (member-batched states only — the
+    round-18 spread/drift statistics are undefined for a single run).
     """
     if isinstance(names, str):
         names = (default_metrics(family, cov)
@@ -272,7 +305,8 @@ def resolve_metric_names(names, family: str, cov: bool) -> tuple:
         names = tuple(names)
         if not names:
             names = default_metrics(family, cov)
-    caps = {family} | ({"cov"} if cov else set())
+    caps = {family} | ({"cov"} if cov else set()) \
+        | ({"ensemble"} if batched else set())
     valid = sorted(n for n, s in METRICS.items() if s.requires <= caps)
     for n in names:
         if n not in METRICS:
@@ -301,10 +335,11 @@ def build_metric_set(grid, model, example_state, names, dt: float,
     """
     family = state_family(example_state)
     cov = family == "swe" and "u" in example_state
-    names = resolve_metric_names(names, family, cov)
-    specs = tuple(METRICS[n] for n in names)
     field_key = {"swe": "h", "advection": "q", "diffusion": "T"}[family]
     field = example_state[field_key]
+    names = resolve_metric_names(
+        names, family, cov, batched=getattr(field, "ndim", 0) == 4)
+    specs = tuple(METRICS[n] for n in names)
     if member_rows and getattr(field, "ndim", 0) == 4:
         extra = member_nonfinite_specs(field.shape[0])
         names = names + tuple(s.name for s in extra)

@@ -81,6 +81,18 @@ RECORD_KINDS: Dict[str, tuple] = {
     # the SPAN_TIMING_KEYS wall-clock fields are masked.
     "span": ("trace_id", "span_id", "parent_id", "id", "name",
              "start_s", "duration_s"),
+    # One EnKF assimilation cycle (round 18, jaxstream.da): prior/
+    # posterior area-RMS ensemble spread and ensemble-mean RMSE vs the
+    # hidden truth, plus innovation statistics — the columns
+    # telemetry_report's assimilation section and the dashboard's
+    # cycle table/spread sparkline render.  "mode" is 'inprocess' or
+    # 'gateway' (the round-18 client that cycles THROUGH the HTTP
+    # front door).  Optional: "innovation_mean", "ens_mean_drift"
+    # (the in-loop device-buffer statistic, in-process mode only),
+    # "nobs", "wall_s".  Guard records appended by the DA guards carry
+    # event 'spread_collapse' / 'filter_divergence' and a "cycle" key.
+    "da": ("cycle", "step", "t", "mode", "spread", "rmse",
+           "spread_post", "rmse_post", "innovation_rms"),
 }
 
 SCHEMA_VERSION = 1

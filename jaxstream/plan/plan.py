@@ -63,6 +63,13 @@ class CapabilityPlan:
     serving: bool = False          # a serve-bucket plan
     placement: str = "off"         # serve placement mode
     serve_grouping: bool = False   # serve.group_by_orography
+    #: Round 18: an EnKF-cycled forecast plan (``da.cycles > 0``) —
+    #: the in-process cycle's batched forecast stepper.  The analysis
+    #: update is pure member-axis linear algebra OUTSIDE the stepper,
+    #: so a da plan's compiled program is its ensemble twin's; the
+    #: marker keys the coverage class the cycle's stamp is checked
+    #: against (gateway-client cycles ride the serving plans instead).
+    da: bool = False
 
     # -- derived predicates the rule table matches on ------------------
     @property
@@ -110,6 +117,8 @@ class CapabilityPlan:
             parts.append("strips_bf16")
         if self.carry != "f32":
             parts.append("carry_" + self.carry)
+        if self.da:
+            parts.append("da")
         return "+".join(parts)
 
     def key(self) -> str:
@@ -160,7 +169,7 @@ class CapabilityPlan:
         base = dataclasses.replace(
             self, overlap=False, temporal_block=1, ensemble=1,
             stage="f32", strips="f32", carry="f32", serving=False,
-            placement="off", serve_grouping=False)
+            placement="off", serve_grouping=False, da=False)
         base = rules.normalize(base)
         if self == base:
             ref_key = None
@@ -337,5 +346,6 @@ def plan_for(config, serving: bool = False) -> CapabilityPlan:
         obs_interval=cfg.observability.interval,
         serving=serving, placement=placement,
         serve_grouping=cfg.serve.group_by_orography,
+        da=(cfg.da.cycles > 0 and not serving),
     )
     return reject_illegal(plan)

@@ -49,7 +49,9 @@ __all__ = [
 
 #: Bump when a rule is added/removed or its semantics change — proof
 #: stamps, comm_probe plans and the bench contract stamp all carry it.
-RULES_VERSION = 1
+#: v2 (round 18): the ``da`` axis (EnKF-cycled forecast plans) and its
+#: four composition edges entered the table.
+RULES_VERSION = 2
 
 #: Every capability tier a config can resolve to.  ``schedule_only``
 #: tiers cannot be traced on the in-process device pool (the block
@@ -382,6 +384,41 @@ RULES: Tuple[Rule, ...] = (
            "placement mode 'panel' runs the explicit ssprk3 face "
            "tier; set time.scheme: ssprk3")),
 
+    # -- ensemble data assimilation (round 18) -------------------------
+    _r("da-needs-ensemble", "requires",
+       [("da", (True,))],
+       [("ensemble", lambda v: v > 1)],
+       pointer=(
+           "da.cycles > 0 runs the EnKF analysis over the member "
+           "axis; set ensemble.members >= 2 (a single member has no "
+           "ensemble covariance to filter with)")),
+    _r("da-single-device", "requires",
+       [("da", (True,))],
+       [("tier", ("fused", "classic")), ("num_devices", (1,))],
+       pointer=(
+           "the in-process EnKF cycle drives the single-device "
+           "batched steppers (the analysis update contracts the "
+           "member axis — every member reads every member's "
+           "anomalies, an all-gather the cycle driver does not "
+           "issue on a sharded mesh); set num_devices: 1 and "
+           "use_shard_map: false, or run multi-chip ensembles "
+           "through the gateway client (scripts/assimilate.py "
+           "--mode gateway)")),
+    _r("da-no-temporal-block", "requires",
+       [("da", (True,))],
+       [("temporal_block", (1,))],
+       pointer=(
+           "da.cycle_steps counts single steps and analysis states "
+           "re-enter the forecast at cycle boundaries; set "
+           "parallelization.temporal_block: 1")),
+    _r("da-f32", "excludes",
+       [("da", (True,)), ("stage_policy_on", (True,))],
+       pointer=(
+           "the EnKF analysis is f32 linear algebra over the member "
+           "axis and analysis states re-enter the forecast "
+           "byte-preserved; run the cycle with the precision: block "
+           "off (all-f32)")),
+
     # -- canonicalization (implies: inert knobs normalize away) -------
     _r("overlap-needs-explicit-exchange", "implies",
        [("tier", ("fused", "classic", "gspmd", "tt"))],
@@ -389,6 +426,12 @@ RULES: Tuple[Rule, ...] = (
     _r("serve-member-or-off-no-overlap", "implies",
        [("serving", (True,)), ("placement", ("off", "member"))],
        [("overlap", False)]),
+    # A serving bucket is never itself a da plan: the gateway-client
+    # cycle rides ordinary serving plans (the analysis lives in the
+    # client), so the marker normalizes away.
+    _r("serve-no-da", "implies",
+       [("serving", (True,))],
+       [("da", False)]),
 )
 
 _BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}
@@ -478,6 +521,11 @@ DEFAULT_AXES = {
     "ensemble": {"fused": (1, 2), "classic": (1, 2), "face": (1, 2),
                  "gspmd": (1, 2), "*": (1,)},
     "stage": {"fused": ("f32", "bf16"), "*": ("f32",)},
+    #: Round 18: EnKF-cycled forecast plans — the da marker on the
+    #: single-device batched tiers (the rules prune it everywhere
+    #: else: da needs B >= 2, k = 1, f32).
+    "da": {"fused": (False, True), "classic": (False, True),
+           "*": (False,)},
     #: Serving sub-space: placement modes explored at the packed B=2
     #: bucket ('off' = the single-chip round-11 path).
     "placement": ("off", "member", "panel"),
@@ -506,15 +554,16 @@ def enumerate_plans(n: int = 12, halo: int = 2, axes=None,
     axes = axes or DEFAULT_AXES
     out = {}
     for tier in axes["tier"]:
-        for ov, tb, B, stage in itertools.product(
+        for ov, tb, B, stage, da in itertools.product(
                 _axis(axes, "overlap", tier),
                 _axis(axes, "temporal_block", tier),
                 _axis(axes, "ensemble", tier),
-                _axis(axes, "stage", tier)):
+                _axis(axes, "stage", tier),
+                _axis(axes, "da", tier)):
             p = CapabilityPlan(
                 tier=tier, n=n, halo=halo, overlap=ov,
                 temporal_block=tb, ensemble=B, stage=stage,
-                strips=stage,
+                strips=stage, da=da,
                 num_devices=(6 if tier in ("face", "gspmd",
                                            "tt_sharded") else 1),
                 use_shard_map=tier in ("face", "tt_sharded"),

@@ -27,6 +27,15 @@ SWE_FAMILIES: Dict[str, str] = {
     "tc5": "oro",
     "tc6": "flat",
     "galewsky": "flat",
+    # Round 18: raw-array initial conditions — the request carries the
+    # full interior prognostic state itself (``state``: h (6, n, n), u
+    # (2, 6, n, n)), byte-preserved through the gateway's b64 array
+    # codec.  The restart/assimilation primitive: a checkpointed
+    # member or an EnKF analysis state re-enters the serving loop as
+    # an ordinary request.  Flat-bottom (no orography is implied by an
+    # array; tc5 continuations ride the traced per-member mountain of
+    # the mixed-batch default only if resubmitted as 'tc5').
+    "array": "flat",
 }
 
 #: Fields a request may ask back (interior prognostics).
@@ -50,6 +59,13 @@ class ScenarioRequest:
     seed: int = -1
     amplitude: float = 1.0e-3
     outputs: Tuple[str, ...] = ("h",)
+    #: Raw-array initial conditions (``ic: "array"``, round 18): the
+    #: interior prognostic state ``{"h": (6, n, n), "u": (2, 6, n,
+    #: n)}`` as host numpy arrays.  Shape/dtype are validated against
+    #: the deployment's grid at admission (:meth:`EnsembleServer.
+    #: validate_request`) — a mismatched array must land as a typed
+    #: 400, never mid-batch on the serving thread.
+    state: Optional[Dict] = None
     #: wall-clock bookkeeping, stamped by the server
     submitted_wall: Optional[float] = None
 
@@ -85,6 +101,33 @@ class ScenarioRequest:
             raise ValueError(
                 f"request {self.id!r}: unknown output fields {bad}; "
                 f"valid: {list(OUTPUT_FIELDS)}")
+        if self.ic == "array":
+            import numpy as np
+
+            if not isinstance(self.state, dict):
+                raise ValueError(
+                    f"request {self.id!r}: ic 'array' needs a 'state' "
+                    "mapping with the interior prognostic arrays "
+                    "{'h': (6, n, n), 'u': (2, 6, n, n)}")
+            if set(self.state) != set(OUTPUT_FIELDS):
+                raise ValueError(
+                    f"request {self.id!r}: ic 'array' state must "
+                    f"carry exactly {sorted(OUTPUT_FIELDS)}; got "
+                    f"{sorted(self.state)}")
+            for k, v in self.state.items():
+                if not isinstance(v, np.ndarray):
+                    raise ValueError(
+                        f"request {self.id!r}: state[{k!r}] must be a "
+                        f"numpy array, got {type(v).__name__}")
+            if self.seed >= 0 and self.amplitude != 0.0:
+                raise ValueError(
+                    f"request {self.id!r}: seed/amplitude "
+                    "perturbations apply to the named IC families; "
+                    "perturb the array client-side (or set seed: -1)")
+        elif self.state is not None:
+            raise ValueError(
+                f"request {self.id!r}: 'state' is only valid with "
+                "ic 'array'")
 
     @property
     def group(self) -> str:

@@ -546,12 +546,48 @@ class EnsembleServer:
         return self._models[group]
 
     def _request_state(self, req: ScenarioRequest):
-        """A request's interior initial state (deterministic in seed)."""
+        """A request's interior initial state (deterministic in seed).
+
+        ``ic: 'array'`` requests carry the interior state themselves
+        (round 18): the arrays go on device as-is — byte-preserving,
+        so a checkpointed member or an EnKF analysis state resubmitted
+        through the gateway continues bitwise (validated at admission
+        by :meth:`validate_request`)."""
+        if req.ic == "array":
+            return {k: jnp.asarray(v) for k, v in req.state.items()}
         h, v, _ = self._ic(req.ic)
         if req.seed >= 0 and req.amplitude != 0.0:
             h = ics.perturbed_ensemble(self.grid, h, 2, seed=req.seed,
                                        amplitude=req.amplitude)[1]
         return self._model(self._group(req)).initial_state(h, v)
+
+    def validate_request(self, req: ScenarioRequest) -> None:
+        """Admission-time deployment validation (raises ValueError).
+
+        The dataclass validated everything grid-independent; this
+        checks what only the deployment knows — an ``ic: 'array'``
+        state's shapes and dtype against the serving grid.  Runs in
+        :meth:`submit` so a mismatched array is a typed 400 at the
+        gateway, never a shape error mid-batch on the serving thread.
+        """
+        if req.ic != "array":
+            return
+        n = self.grid.n
+        dtype = str(np.dtype(self.config.grid.dtype))
+        expect = {"h": (6, n, n), "u": (2, 6, n, n)}
+        for k, shape in expect.items():
+            a = req.state[k]
+            if tuple(a.shape) != shape:
+                raise ValueError(
+                    f"request {req.id!r}: ic 'array' field {k!r} has "
+                    f"shape {tuple(a.shape)}; this deployment serves "
+                    f"C{n} interior states of shape {shape}")
+            if str(a.dtype) != dtype:
+                raise ValueError(
+                    f"request {req.id!r}: ic 'array' field {k!r} has "
+                    f"dtype {a.dtype}; this deployment serves "
+                    f"{dtype} states (byte-preserving continuations "
+                    f"need the exact dtype)")
 
     def _member_tree(self, req: ScenarioRequest):
         """The request's member tree: interior state, plus its traced
@@ -858,6 +894,7 @@ class EnsembleServer:
         after :meth:`begin_drain`)."""
         if self._closed:
             raise RuntimeError("EnsembleServer is closed")
+        self.validate_request(req)
         reasons = self.refusal_reasons()
         if "draining" in reasons:
             self.stats["refused"] += 1

@@ -11,7 +11,9 @@ import jax.numpy as jnp
 
 from ..geometry.cubed_sphere import CubedSphereGrid
 
-__all__ = ["total_mass", "total_energy", "potential_enstrophy", "error_norms"]
+__all__ = ["total_mass", "total_energy", "potential_enstrophy",
+           "error_norms", "ensemble_area_weights", "ensemble_spread",
+           "ensemble_mean_rmse", "ensemble_mean_drift"]
 
 
 def _wsum(grid: CubedSphereGrid, field_int):
@@ -21,6 +23,39 @@ def _wsum(grid: CubedSphereGrid, field_int):
 def total_mass(grid: CubedSphereGrid, h_int):
     """integral h dA (h interior (6,n,n))."""
     return _wsum(grid, h_int)
+
+
+# -- ensemble statistics (round 18) -----------------------------------
+# The ONE definition of the area-weighted ensemble spread/RMSE/drift
+# formulas: the in-loop MetricSpecs (obs.metrics h_spread /
+# ens_mean_drift) and the EnKF cycle's guards + records (jaxstream.da)
+# both consume these — the guard compares prior (in-loop) against
+# posterior (analysis) spread, so the two sides must be the same
+# formula by construction, not by parallel maintenance.
+
+def ensemble_area_weights(grid: CubedSphereGrid, dtype=None):
+    """Normalized interior cell-area weights (sum 1)."""
+    w = grid.interior(grid.area)
+    w = w / jnp.sum(w)
+    return w.astype(dtype) if dtype is not None else w
+
+
+def ensemble_spread(h_b, w):
+    """Area-weighted RMS ensemble spread of ``h_b`` ``(B, 6, n, n)``:
+    ``sqrt(sum_cells w * var_members)`` (ddof=1)."""
+    return jnp.sqrt(jnp.sum(w * jnp.var(h_b, axis=0, ddof=1)))
+
+
+def ensemble_mean_rmse(h_b, ref, w):
+    """Area-weighted RMSE of the ensemble mean against ``ref``."""
+    err = jnp.mean(h_b, axis=0) - ref
+    return jnp.sqrt(jnp.sum(w * err * err))
+
+
+def ensemble_mean_drift(h_b, w):
+    """Area-weighted RMS distance of the ensemble mean from member
+    0."""
+    return ensemble_mean_rmse(h_b, h_b[0], w)
 
 
 def total_energy(grid: CubedSphereGrid, h_int, v_int, gravity: float, b_int=0.0):

@@ -109,6 +109,16 @@ every gate run self-checking):
     the fast gate certifies on every run, and their gateways open
     REAL listening sockets.
 
+12. **Assimilation tests stay non-slow and in-process** (round-18
+    EnKF satellite): a module importing ``jaxstream.da`` must carry
+    NO ``slow`` markers and must not launch subprocesses — the
+    closed-loop forecast claim (cycled RMSE beats the free ensemble
+    through the HTTP gateway), the byte-determinism of the cycle
+    outputs, the seeded spread-collapse guard and the raw-array
+    restart round trip are the acceptance criteria the fast gate
+    certifies on every run; drive ``scripts/assimilate.py`` through
+    its importable ``main()``/``run()``.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -175,6 +185,10 @@ _TRACE_IMPORT_RE = re.compile(
     r"|parse_exposition|span_coverage|tree_complete)\b"
     r"|import\s+telemetry_dashboard\b"
     r"|from\s+telemetry_dashboard\s+import\b)",
+    re.MULTILINE)
+_DA_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.da\b|import\s+jaxstream\.da\b"
+    r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*da\b)",
     re.MULTILINE)
 #: Actual subprocess USAGE (an import or an attribute call), so a
 #: docstring merely mentioning the word does not trip rule 10b.
@@ -354,6 +368,23 @@ def lint_file(path: str, allowed: set):
                    f"references the wildcard bind address 0.0.0.0 — "
                    f"traced-gateway tests open REAL listening sockets "
                    f"and must bind loopback (127.0.0.1) only")
+    if _DA_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports jaxstream.da but marks tests slow "
+                   f"— the assimilation acceptance criteria (the "
+                   f"closed-loop gateway forecast claim, cycle byte "
+                   f"determinism, the spread-collapse guard, the "
+                   f"raw-array restart round trip) must run in every "
+                   f"fast gate; move the slow test to a module that "
+                   f"does not import jaxstream.da")
+        if _SUBPROC_USE_RE.search(src):
+            yield (f"{rel}: imports jaxstream.da but launches "
+                   f"subprocesses — assimilation tests must run "
+                   f"IN-PROCESS on the conftest's virtual devices "
+                   f"(drive scripts/assimilate.py through its "
+                   f"importable main()/run(); a subprocess rewrite "
+                   f"would be forced slow by rule 2, dropping the "
+                   f"forecast-loop proof from the fast gate)")
     if _ANALYSIS_IMPORT_RE.search(src):
         if "slow" in used:
             yield (f"{rel}: imports jaxstream.analysis but marks tests "

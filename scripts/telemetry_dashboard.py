@@ -69,7 +69,7 @@ _PHASE_COLOR = {"ingress": 90, "queue": 33, "pack": 35, "compute": 32,
 #: loud ``unrendered kinds`` footer instead of vanishing.
 RENDERED_KINDS = frozenset({
     "manifest", "span", "serve", "segment", "guard", "autoscale",
-    "gateway", "loadgen", "bench",
+    "gateway", "loadgen", "bench", "da",
 })
 
 SPARK = "▁▂▃▄▅▆▇█"
@@ -136,6 +136,7 @@ class Dashboard:
         self.done_tids = set()          # traces with a root span seen
         self.serve_points = []          # (member_steps/wall, occupancy)
         self.segment_points = []        # (steps_per_sec, max |drift|)
+        self.da_cycles = []             # EnKF 'da' cycle records
         self.events = []                # guard + autoscale feed
         self.chips = None               # latest per-chip gauges
         self.outcomes = {}              # kind -> status -> count
@@ -175,6 +176,8 @@ class Dashboard:
             self.segment_points.append(
                 (rec.get("steps_per_sec"),
                  max(drifts) if drifts else None))
+        elif kind == "da":
+            self.da_cycles.append(rec)
         elif kind in ("guard", "autoscale"):
             self.events.append(rec)
         elif kind in ("gateway", "loadgen"):
@@ -233,6 +236,17 @@ class Dashboard:
             "inflight": sorted(self.inflight),
             "rates": {k: v[-64:] for k, v in rates.items()},
             "events": self.events[-self.rows:],
+            "assimilation": {
+                "cycles": [
+                    {k: c.get(k) for k in
+                     ("cycle", "t", "mode", "spread", "rmse",
+                      "spread_post", "rmse_post", "innovation_rms")}
+                    for c in self.da_cycles[-self.rows:]],
+                "spread_trend": [c.get("spread")
+                                 for c in self.da_cycles][-64:],
+                "rmse_trend": [c.get("rmse")
+                               for c in self.da_cycles][-64:],
+            } if self.da_cycles else None,
             "chips": self.chips,
             "outcomes": self.outcomes,
             "unrendered_kinds": dict(sorted(self.unknown.items())),
@@ -317,6 +331,26 @@ def render(frame, color=True):
         parts = " ".join(f"{k}={v}" for k, v in sorted(by.items()))
         lines.append(f"  {kind + ' outcomes':<15} {parts}")
     lines.append("")
+
+    if frame.get("assimilation"):
+        da = frame["assimilation"]
+        lines.append(_c("assimilation (EnKF cycle):", 4, color))
+        lines.append(f"  {'cycle':>5} {'spread':>9} {'rmse':>9} "
+                     f"{'spread+':>9} {'rmse+':>9} {'innov':>9}")
+        for c in da["cycles"]:
+            lines.append(
+                f"  {c['cycle']:>5} {c['spread']:>9.4f} "
+                f"{c['rmse']:>9.4f} {c['spread_post']:>9.4f} "
+                f"{c['rmse_post']:>9.4f} {c['innovation_rms']:>9.4f}")
+        spread = [v for v in da["spread_trend"] if v is not None]
+        rmse = [v for v in da["rmse_trend"] if v is not None]
+        if spread:
+            lines.append(f"  {'spread':<15} {sparkline(spread)}  "
+                         f"last {spread[-1]:.4g}")
+        if rmse:
+            lines.append(f"  {'rmse':<15} {sparkline(rmse)}  "
+                         f"last {rmse[-1]:.4g}")
+        lines.append("")
 
     lines.append(_c("events (guard/autoscale):", 4, color))
     if frame["events"]:

@@ -65,7 +65,7 @@ PHASES = ("ingress", "queue", "pack", "compute", "host_wait",
 #: ``unrendered_kinds`` footer instead of vanishing.
 RENDERED_KINDS = frozenset({
     "manifest", "segment", "guard", "bench", "serve", "gateway",
-    "loadgen", "autoscale", "span",
+    "loadgen", "autoscale", "span", "da",
 })
 
 
@@ -240,6 +240,7 @@ def summarize(records):
     gateways = [r for r in records if r.get("kind") == "gateway"]
     loadgens = [r for r in records if r.get("kind") == "loadgen"]
     autoscales = [r for r in records if r.get("kind") == "autoscale"]
+    das = [r for r in records if r.get("kind") == "da"]
     unrendered = {}
     for r in records:
         kind = r.get("kind")
@@ -344,6 +345,29 @@ def summarize(records):
                         "occupancy": a["occupancy"],
                         "reason": a["reason"]} for a in autoscales],
         }
+    # Round 18: the EnKF assimilation cycle ('da' records, jaxstream.
+    # da) — prior/posterior spread + ensemble-mean RMSE per cycle;
+    # the spread trend is the filter-health signal at a glance.
+    assimilation = None
+    if das:
+        last = das[-1]
+        assimilation = {
+            "cycles": len(das),
+            "mode": last.get("mode", "?"),
+            "nobs": last.get("nobs"),
+            "final_rmse": last["rmse"],
+            "final_rmse_post": last["rmse_post"],
+            "final_spread": last["spread_post"],
+            "rmse_trend": [d["rmse"] for d in das],
+            "spread_trend": [d["spread"] for d in das],
+            "timeline": [
+                {"cycle": d["cycle"], "t": d["t"],
+                 "spread": d["spread"], "rmse": d["rmse"],
+                 "spread_post": d["spread_post"],
+                 "rmse_post": d["rmse_post"],
+                 "innovation_rms": d["innovation_rms"]}
+                for d in das],
+        }
     # Round 17: the per-phase latency decomposition over span trees
     # (serve.trace).  Grown into the serving section when one exists
     # (the spans came from the serve sink); standalone otherwise (a
@@ -356,6 +380,7 @@ def summarize(records):
             "guards": guards, "bench": benches, "serving": serving,
             "gateway": gateway, "loadgen": loadgen,
             "autoscale": autoscale, "spans": spans,
+            "assimilation": assimilation,
             "unrendered_kinds": dict(sorted(unrendered.items())),
             "n_segments": len(segments)}
 
@@ -445,6 +470,23 @@ def print_report(s):
                 continue
             print(f"  {ph:<10} {row['n']:>5} {row['p50_s']:>10.4f} "
                   f"{row['p99_s']:>10.4f} {row['mean_share']:>6.1%}")
+
+    if s.get("assimilation"):
+        da = s["assimilation"]
+        print(f"\nassimilation (EnKF cycle, mode {da['mode']}, "
+              f"{da['nobs']} stations):")
+        print(f"  {'cycle':>5} {'t (s)':>10} {'spread':>10} "
+              f"{'rmse':>10} {'spread+':>10} {'rmse+':>10} "
+              f"{'innov rms':>10}")
+        for c in da["timeline"]:
+            print(f"  {c['cycle']:>5} {c['t']:>10.0f} "
+                  f"{c['spread']:>10.4f} {c['rmse']:>10.4f} "
+                  f"{c['spread_post']:>10.4f} {c['rmse_post']:>10.4f} "
+                  f"{c['innovation_rms']:>10.4f}")
+        print(f"  {da['cycles']} cycles: final rmse "
+              f"{da['final_rmse']:.4f} (post-analysis "
+              f"{da['final_rmse_post']:.4f}), final spread "
+              f"{da['final_spread']:.4f}")
 
     for name in ("gateway", "loadgen"):
         sec = s.get(name)

@@ -159,6 +159,27 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert scrape["families"] >= 10
     assert scrape["submitted"] == s["n_requests"]
 
+    # The assimilation canary (round 18) closed the forecast loop
+    # through the REAL bench_assimilation code path: hidden truth run,
+    # seeded 48-station network, B=4 batched Galewsky forecast with
+    # the in-loop h_spread stream, the B x B stochastic analysis, and
+    # the free-ensemble baseline under identical seeds.  The forecast
+    # claim and filter health ARE enforced inside bench_assimilation
+    # (gates=True) — a breach surfaces as "skipped" and fails here —
+    # and re-asserted so the canary cannot silently stop checking.
+    da = rec["assimilation"]
+    assert "skipped" not in da, da
+    assert da["beats_free_run"] is True
+    assert da["cycled_final_rmse"] < da["free_final_rmse"]
+    assert da["rmse_reduction"] > 0.0
+    assert da["guard_events"] == 0
+    assert da["plan"] == "classic+B4+da"
+    assert da["proof_verdict"] == "verified"
+    assert len(da["cycle_records"]) == da["cycles"]
+    for c in da["cycle_records"]:
+        assert c["spread"] > 0.0 and c["spread_post"] > 0.0
+        assert c["nobs"] == da["nstations"]
+
     # The precision ladder (round 10) ran all four rows through the
     # real --precision-report code path: reduced-precision stage
     # kernels, carry encoders, and the precision-corrected roofline

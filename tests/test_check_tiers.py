@@ -358,6 +358,43 @@ def test_trace_module_rules_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 0
 
 
+def test_da_module_rules_detected(tmp_path):
+    """Rule 12 (round-18 satellite): assimilation tests stay non-slow
+    and in-process — a module importing jaxstream.da may not carry
+    slow markers or launch subprocesses (the closed-loop forecast
+    claim and the cycle byte-determinism proof must ride every fast
+    gate)."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Slow-marked da module trips the lint.
+    (tests / "test_d.py").write_text(
+        "import pytest\n"
+        "from jaxstream.da import run_cycle\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Subprocess USAGE around the assimilate CLI trips it too.
+    (tests / "test_d.py").write_text(
+        "import subprocess\n"
+        "import jaxstream.da\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', 'scripts/assimilate.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Unmarked, in-process da module is clean (incl. the
+    # from-jaxstream import form).
+    (tests / "test_d.py").write_text(
+        "from jaxstream import da\n"
+        "def test_a():\n    da.run_cycle\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # 'dashboard'-style names must not false-positive the da regex.
+    (tests / "test_d.py").write_text(
+        "from jaxstream.gateway import protocol\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_config_doc_drift_detected(tmp_path):
     """Rule 10a (round-16 satellite): every _SECTIONS key in
     jaxstream/config.py must appear as a top-level key in a fenced
