@@ -47,7 +47,16 @@ trace through the asyncio HTTP gateway over loopback with live
 autoscaling and enforces the SLO floors: request p50/p99 latency,
 goodput >= 0.5x the packed serving rate, completed + typed-shed ==
 submitted, >= 1 autoscale resize, zero steady-state recompiles after
-the resize.  ``python bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
+the resize.  The ``perf`` field (round 19, ``bench_perf``) is the performance
+observatory's section — hardware identity, a full cost stamp of the
+bench stepper (XLA memory_analysis footprint bytes, compile seconds,
+the flops-vs-analytic cross-check on XLA-visible rungs) and a live
+device-memory snapshot — and the ``perf_ledger`` field
+(``bench_perf_ledger``) gates this run against the recorded
+``BENCH_r*.json`` trajectory (enforced on accelerators, reported-only
+for CPU smoke; ``scripts/perf_ledger.py`` renders/checks the same
+history offline).
+``python bench.py --smoke`` runs the C24 bitrot canary instead (no gates;
 wired into tier-1 via tests/test_bench_smoke.py); ``python bench.py
 --compile-report`` prints cold-vs-warm compile seconds for the
 ``JAXSTREAM_COMPILE_CACHE`` persistent-cache opt-in; ``python bench.py
@@ -80,6 +89,17 @@ def _device_count() -> int:
         return len(jax.devices())
     except Exception:
         return 1
+
+
+def _platform() -> str:
+    """Device platform id ('unknown' when jax is unavailable) — the
+    hardware tag the perf ledger classes trajectory points by."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
 
 
 def _argv_value(flag: str) -> str:
@@ -147,37 +167,20 @@ def _roofline_json(steps_per_sec: float, n: int, scale: float = 1.0,
       lane); ``pct_of_compute_roof`` stays the f32 roof so rows remain
       comparable across variants.
 
-    Returns None when the profiling helpers are unavailable (never
-    fails a variant on this).
+    Round 19: the arithmetic itself moved to
+    ``jaxstream.obs.perf.roofline_json`` — the ONE definition of cost
+    accounting the probe CLIs and the serving cost stamps share; this
+    wrapper keeps bench's never-fail-a-variant contract.  Returns None
+    when the profiling helpers are unavailable.
     """
     try:
-        from jaxstream.utils.profiling import (TPU_V5E_VPU, Roofline,
-                                               analytic_cov_step_cost,
-                                               mixed_vpu_roof)
+        from jaxstream.obs.perf import roofline_json
 
-        c = analytic_cov_step_cost(n, ensemble=ensemble,
-                                   carry_bytes=carry_bytes, nu4=nu4,
-                                   precision=precision)
-        r = Roofline(c["flops"] * scale, c["bytes"] * scale * bytes_scale,
-                     1.0 / steps_per_sec, TPU_V5E_VPU)
-        out = {
-            "achieved_tflops": round(r.achieved_tflops, 3),
-            "pct_of_compute_roof": round(
-                100 * r.achieved_tflops / r.roof.peak_tflops, 1),
-            "achieved_gbps": round(r.achieved_gbps, 1),
-            "pct_of_hbm": round(
-                100 * r.achieved_gbps / r.roof.hbm_gbps, 1),
-            "ai": round(r.ai, 3),
-        }
-        if carry_bytes is not None and carry_bytes != 4:
-            out["carry_bytes"] = carry_bytes
-        if precision == "bf16":
-            mroof = mixed_vpu_roof(c["bf16_flop_fraction"])
-            out["bf16_flop_fraction"] = round(c["bf16_flop_fraction"], 3)
-            out["mixed_roof_tflops"] = round(mroof.peak_tflops, 2)
-            out["pct_of_mixed_roof"] = round(
-                100 * r.achieved_tflops / mroof.peak_tflops, 1)
-        return out
+        return roofline_json(steps_per_sec, n, scale=scale,
+                             bytes_scale=bytes_scale,
+                             ensemble=ensemble,
+                             carry_bytes=carry_bytes, nu4=nu4,
+                             precision=precision)
     except Exception as e:
         log(f"bench: variant roofline unavailable ({e})")
         return None
@@ -1931,6 +1934,112 @@ def bench_precision_report(n=384, dt=BENCH_DT, interpret=False,
     return out
 
 
+def bench_perf(n=96, dt=300.0, probe_pallas=True):
+    """Performance-observatory section (round 19): hardware identity,
+    a full cost stamp of the bench stepper, and a live device-memory
+    snapshot — the fields the cross-round regression ledger
+    machine-normalizes (``scripts/perf_ledger.py``).
+
+    The stamped stepper mirrors bench's own rung ladder: the covariant
+    fused Pallas stepper where it compiles (its flops are INVISIBLE to
+    XLA's counter, so the stamp skips the analytic band check and says
+    so — the footprint/compile fields are still real), the classic jnp
+    stepper otherwise (XLA sees every op; the flops-vs-analytic ratio
+    is the cross-check).  The stamp's AOT compile is the recorded
+    ``compile_seconds``.  Never raises (returns ``{"skipped": ...}``).
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from jaxstream.config import (EARTH_GRAVITY, EARTH_OMEGA,
+                                      EARTH_RADIUS)
+        from jaxstream.geometry.cubed_sphere import build_grid
+        from jaxstream.models.shallow_water_cov import \
+            CovariantShallowWater
+        from jaxstream.obs import perf as obs_perf
+        from jaxstream.physics.initial_conditions import williamson_tc2
+
+        out = {"hardware": jax.devices()[0].platform, "n": n}
+        out["memory"] = obs_perf.device_memory_record()
+        grid = build_grid(n, halo=2, radius=EARTH_RADIUS,
+                          dtype=jnp.float32)
+        h_ext, v_ext = williamson_tc2(grid, EARTH_GRAVITY, EARTH_OMEGA)
+        rung, step, y = None, None, None
+        if probe_pallas:
+            try:
+                m = CovariantShallowWater(
+                    grid, gravity=EARTH_GRAVITY, omega=EARTH_OMEGA,
+                    backend="pallas")
+                step = m.make_fused_step(dt)
+                y = m.compact_state(m.initial_state(h_ext, v_ext))
+                jax.block_until_ready(jax.jit(step)(y,
+                                                    jnp.float32(0.0)))
+                rung = "cov_fused"
+            except Exception as e:
+                log(f"bench perf: fused stepper unavailable "
+                    f"({type(e).__name__}); stamping the classic rung")
+        if rung is None:
+            m = CovariantShallowWater(grid, gravity=EARTH_GRAVITY,
+                                      omega=EARTH_OMEGA)
+            step = m.make_step(dt, "ssprk3")
+            y = m.initial_state(h_ext, v_ext)
+            rung = "classic"
+        stamp = obs_perf.measure_cost(
+            step, y, jnp.float32(0.0),
+            plan_key=f"bench:{rung}_C{n}",
+            analytic=obs_perf.analytic_cost(n),
+            xla_visible=(rung == "classic"))
+        out["rung"] = rung
+        out["cost"] = stamp.to_json()
+        log(f"bench perf: {stamp} (hardware {out['hardware']}, "
+            f"memory "
+            + ("unavailable" if out["memory"].get("unavailable")
+               else f"{out['memory']['bytes_in_use']} in use of "
+                    f"{out['memory']['limit_bytes']}") + ")")
+        return out
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench perf: unavailable ({type(e).__name__}: {e})")
+        return {"skipped": f"{type(e).__name__}: {e}"}
+
+
+def bench_perf_ledger(rec):
+    """Round-19 CI satellite: every bench run (full + ``--smoke``)
+    carries the regression ledger's verdict — the assembled record is
+    appended to the recorded ``BENCH_r*.json`` trajectory as the
+    candidate point and gated against the best comparable history
+    (same section, same hardware class; ``jaxstream.obs.perf.
+    check_trajectory``).  CPU-smoke candidates are reported-only
+    (``enforced: false``); an accelerator run that regressed beyond
+    the band stamps ``ok: false`` LOUDLY for the driver.  Never raises
+    (reports ``skipped``); asserted by ``tests/test_bench_smoke.py``.
+    """
+    import os
+
+    try:
+        from jaxstream.obs import perf as obs_perf
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        points = obs_perf.load_bench_history(root)
+        points.append(obs_perf.parse_bench_point(
+            {"parsed": rec}, label="candidate"))
+        res = obs_perf.check_trajectory(points)
+        mode = "ENFORCED" if res["enforced"] else "reported-only"
+        log(f"bench perf ledger [{mode}]: {res['points']} points, "
+            f"{res['compared_sections']} section(s) compared, "
+            f"{len(res['regressions'])} regression(s), "
+            f"{len(res['advisories'])} advisory(ies)"
+            + ("" if res["ok"] else " — PERF REGRESSION")
+            + ("" if res["compared_sections"] or not res["enforced"]
+               else " — VACUOUS (no comparable history)"))
+        for r in res["regressions"] + res["advisories"]:
+            log(f"bench perf ledger: {r['detail']}")
+        return res
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench perf ledger: unavailable ({type(e).__name__}: {e})")
+        return {"skipped": f"{type(e).__name__}: {e}"}
+
+
 def bench_smoke(n=24, dt=600.0, telemetry=""):
     """``--smoke``: C24, a handful of steps, NO accuracy gates.
 
@@ -2025,6 +2134,15 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
     # stamp trace-only; the compile-level checks run in
     # tests/test_analysis.py within the same gate.
     contract = bench_contract_check(smoke=True)
+    # Performance-observatory canary (round 19): the cost stamp +
+    # memory snapshot at C12 through the REAL bench_perf code path
+    # (classic rung on CPU — XLA sees every op, so the
+    # flops-vs-analytic band check runs; memory_stats degrades to the
+    # typed unavailable record on CPU), then the regression-ledger
+    # stamp over the recorded BENCH_r*.json history with THIS record
+    # as the (reported-only, CPU-smoke) candidate — both asserted by
+    # tests/test_bench_smoke.py.
+    perf = bench_perf(n=12, dt=dt)
     b1 = ens.get("B1", {})
     ok = isinstance(b1, dict) and b1.get("sim_days_per_sec", 0.0) > 0.0
     rec = {
@@ -2034,6 +2152,7 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
                  if isinstance(b1, dict) else 0.0,
         "unit": "sim-days/sec (B=1, smoke window — NOT a benchmark)",
         "ok": bool(ok),
+        "hardware": perf.get("hardware") or _platform(),
         "ensemble": ens,
         "io": io_sec,
         "serving": serving,
@@ -2042,8 +2161,10 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
         "assimilation": assimilation,
         "precision_report": prec,
         "contract_check": contract,
+        "perf": perf,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
+    rec["perf_ledger"] = bench_perf_ledger(rec)
     sink = _open_telemetry(telemetry)
     if sink is not None:
         for key in ("B1", "B2"):
@@ -2235,6 +2356,10 @@ def main():
     # Assimilation section (round 18): the EnKF cycle vs the free
     # ensemble on the Galewsky jet — the gated forecast claim.
     assimilation = bench_assimilation()
+    # Performance observatory (round 19): the headline stepper's cost
+    # stamp (footprint bytes, compile seconds, flops-vs-analytic
+    # cross-check on XLA-visible rungs) + live device-memory snapshot.
+    perf = bench_perf(n=384, dt=BENCH_DT)
     if isinstance(ensemble, dict) and "packed" in serving:
         msps = (ensemble.get("B16") or {}).get("member_steps_per_sec")
         if msps:
@@ -2352,13 +2477,14 @@ def main():
                 "meets_p99_floor":
                     serving_slo.get("meets_p99_floor")})
         sink.close()
-    print(json.dumps({
+    record = {
         "metric": "sim_days_per_sec_per_chip_TC5_C384",
         "value": round(value, 4),
         "unit": "sim-days/sec/chip",
         "vs_baseline": round(value / BASELINE_PER_CHIP, 4),
         "dt": BENCH_DT,
         "dt60_equivalent": dt60,
+        "hardware": perf.get("hardware") or _platform(),
         "roofline": (_roofline_json(value * 86400.0 / BENCH_DT, 384)
                      if value > 0 else None),
         "variants": variants,
@@ -2370,7 +2496,13 @@ def main():
         "io": io_section,
         "multichip": multichip,
         "contract_check": contract,
-    }))
+        "perf": perf,
+    }
+    # The regression-ledger stamp gates THIS record against the
+    # recorded BENCH_r*.json trajectory (enforced on accelerator
+    # hardware; the smoke path stamps reported-only).
+    record["perf_ledger"] = bench_perf_ledger(record)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -23,6 +23,13 @@ classes the checker exists for, and ``tests/test_analysis.py`` +
   describe.  ``verify_stamp`` must flag the mismatch; a stamp pass
   that stopped cross-checking would let an analytic plan and a
   compiled schedule diverge behind a green "verified" badge.
+* ``perf_regression`` (round 19) — a doctored bench history with a
+  30% throughput regression and a silently-grown footprint
+  (:func:`jaxstream.obs.perf.broken_bench_history`).  The perf
+  ledger's ``check`` must fail it; if someone widens the band or
+  breaks the comparable-point lookup, the fixture comes back clean
+  and CI catches the gate losing its teeth — the same pattern as the
+  schedule fixtures, applied to the round-19 regression ledger.
 """
 
 from __future__ import annotations
@@ -36,7 +43,7 @@ __all__ = ["FIXTURES", "broken_dropped_pair_perms",
            "broken_proof_stamp", "run_fixture"]
 
 FIXTURES = ("dropped_pair", "deep_depth", "illegal_plan",
-            "proof_fingerprint")
+            "proof_fingerprint", "perf_regression")
 
 
 def broken_dropped_pair_perms(stage: int = 2):
@@ -121,6 +128,23 @@ def run_fixture(name: str, n: int = 12, halo: int = 2) -> ContractReport:
         stamp = broken_proof_stamp()
         verify_stamp(stamp, _perms(), report,
                      subject="fixture:proof_fingerprint")
+    elif name == "perf_regression":
+        from ..obs.perf import (broken_bench_history, check_trajectory,
+                                parse_bench_point)
+
+        pts = [parse_bench_point(o, label=f"fixture:r{o['n']}")
+               for o in broken_bench_history()]
+        res = check_trajectory(pts)
+        for r in res["regressions"]:
+            report.fail("perf.ledger", "fixture:perf_regression",
+                        r["detail"])
+        if res["ok"]:
+            # The band lost its teeth: a clean report here exits 0,
+            # which the CLI/tier-1 assertions turn into a loud CI
+            # failure.
+            report.ok("perf.ledger", "fixture:perf_regression",
+                      "ACCEPTED a 30% regression + grown footprint — "
+                      "ledger broken")
     else:
         raise ValueError(
             f"unknown fixture {name!r}; valid: {FIXTURES}")

@@ -312,6 +312,20 @@ class ServeConfig:
     # Default off = the sink stream is byte-identical to the untraced
     # round-14 records (no span records, no trace fields).
     trace: bool = False
+    # Round 19 (performance observatory): poll device.memory_stats()
+    # at every segment boundary (the autoscale-tick cadence) into the
+    # per-chip jaxstream_device_memory_* gauges on /v1/metrics and
+    # typed 'memory' sink records.  Off = the watcher is never
+    # constructed — zero polling, sink byte-identical to round 18.
+    memory_watch: bool = False
+    # Round 19: measure every warm bucket's segment executable with
+    # XLA's cost/memory analysis (ahead-of-time compile) so its cost
+    # stamp carries real footprint bytes + the flops-vs-analytic
+    # ratio, and the bucket plan gains the advisory headroom_frac.
+    # COSTS one extra XLA compile per bucket at warmup (the measured
+    # compile IS the recorded compile_seconds); off = stamps carry
+    # the analytic half + warmup wall seconds only.
+    cost_stamps: bool = False
     # Round 12: orography (the TC5 mountain) rides the batch as a
     # traced per-member field (zeros for the flat families), so
     # tc2/tc5/tc6/galewsky requests pack into ONE bucket in strict

@@ -705,4 +705,12 @@ class Gateway:
             snap["placement"] = placement
         if self._autoscale is not None:
             snap["autoscale"] = self._autoscale.summary()
+        # Round 19 (performance observatory): per-bucket cost stamps
+        # (footprint bytes, flops-vs-analytic ratio, compile seconds,
+        # advisory headroom) and the live device-memory snapshot when
+        # serve.memory_watch is polling.
+        snap["bucket_costs"] = srv.bucket_costs()
+        memory = srv.memory_snapshot()
+        if memory is not None:
+            snap["memory"] = memory
         return snap

@@ -15,6 +15,8 @@ tests/test_obs.py).
 from .metrics import (METRICS, MetricSet, MetricSpec, build_metric_set,
                       default_metrics, fetch_buffer)
 from .monitor import GUARD_POLICIES, HealthError, HealthMonitor
+from .perf import (CostStamp, MemoryWatcher, build_cost,
+                   check_trajectory, load_bench_history, measure_cost)
 from .registry import MetricsRegistry, parse_exposition
 from .sink import (RECORD_KINDS, TelemetrySink, read_records,
                    validate_record)
@@ -25,6 +27,8 @@ __all__ = [
     "METRICS", "MetricSet", "MetricSpec", "build_metric_set",
     "default_metrics", "fetch_buffer",
     "GUARD_POLICIES", "HealthError", "HealthMonitor",
+    "CostStamp", "MemoryWatcher", "build_cost", "check_trajectory",
+    "load_bench_history", "measure_cost",
     "MetricsRegistry", "parse_exposition",
     "RECORD_KINDS", "TelemetrySink", "read_records", "validate_record",
     "RequestTrace", "span_coverage", "span_tree", "trace_id_for",

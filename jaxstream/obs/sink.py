@@ -93,6 +93,23 @@ RECORD_KINDS: Dict[str, tuple] = {
     # event 'spread_collapse' / 'filter_divergence' and a "cycle" key.
     "da": ("cycle", "step", "t", "mode", "spread", "rmse",
            "spread_post", "rmse_post", "innovation_rms"),
+    # One device-memory poll (round 19, jaxstream.obs.perf.
+    # MemoryWatcher — ``serve.memory_watch``): per-chip
+    # bytes-in-use / peak / limit lists at segment-boundary cadence.
+    # Backends with no allocator stats (CPU) emit ONE record with
+    # empty lists and an "unavailable" reason instead of spamming or
+    # vanishing.  The dashboard's memory panel and telemetry_report's
+    # memory section render these.
+    "memory": ("devices", "bytes_in_use", "peak_bytes", "limit_bytes"),
+    # One compiled executable's cost stamp (round 19, jaxstream.obs.
+    # perf.CostStamp — ``serve.cost_stamps``): the plan key it
+    # implements, wall-clock compile seconds, and the XLA
+    # memory_analysis byte dict (or its typed {"unavailable": reason}
+    # fallback).  Optional: "bucket"/"group", "analytic"/"xla" cost
+    # dicts, "flops_ratio"/"bytes_ratio"/"in_band" (the analytic
+    # cross-check), "headroom_frac" (advisory static-footprint-vs-HBM
+    # headroom of the bucket's placement).
+    "perf": ("plan", "compile_seconds", "memory"),
 }
 
 SCHEMA_VERSION = 1

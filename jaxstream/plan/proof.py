@@ -85,12 +85,19 @@ _CARRIED_ATTRS = ("steps_per_call", "ensemble")
 
 
 def attach_proof(step, plan) -> object:
-    """Attach ``step.proof = build_proof(plan)``; falls back to a
-    transparent wrapper for callables that refuse attributes (jitted
-    functions).  Returns the stamped callable."""
+    """Attach ``step.proof = build_proof(plan)`` AND its round-19
+    twin ``step.cost`` (the analytic half of the performance-
+    observatory cost stamp — :func:`jaxstream.obs.perf.build_cost`;
+    the measured half lands wherever a compile happens); falls back
+    to a transparent wrapper for callables that refuse attributes
+    (jitted functions).  Returns the stamped callable."""
+    from ..obs.perf import build_cost
+
     proof = build_proof(plan)
+    cost = build_cost(plan, plan_key=proof.plan_key)
     try:
         step.proof = proof
+        step.cost = cost
         return step
     except (AttributeError, TypeError):
         pass
@@ -101,6 +108,7 @@ def attach_proof(step, plan) -> object:
 
     stamped.__wrapped__ = orig
     stamped.proof = proof
+    stamped.cost = cost
     for name in _CARRIED_ATTRS:
         if hasattr(orig, name):
             setattr(stamped, name, getattr(orig, name))

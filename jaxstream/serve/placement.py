@@ -36,7 +36,7 @@ accounting feeds ``scripts/comm_probe.py --serve``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Sequence
+from typing import Dict, Optional, Sequence
 
 __all__ = ["PLACEMENT_MODES", "BucketPlan", "plan_bucket",
            "plan_placement", "plan_exchange_bytes_per_step",
@@ -66,10 +66,29 @@ class BucketPlan:
     panel_shards: int
     member_shards: int
     members_per_shard: int
+    #: Round 19 (advisory): 1 - per_device_footprint/per_device_HBM
+    #: for this bucket's measured segment executable (XLA's
+    #: memory_analysis already reports per-device bytes for sharded
+    #: executables) — recorded by the server when ``serve.cost_stamps``
+    #: + a memory-stats-capable backend give it both sides
+    #: (``jaxstream.obs.perf.headroom_fraction``), None otherwise.
+    #: Reported in ``placement_report``/telemetry only; NO admission
+    #: behavior change this round (docs/DESIGN.md "Performance
+    #: observatory").
+    headroom_frac: Optional[float] = None
 
     @property
     def sharded(self) -> bool:
         return self.num_devices > 1
+
+    def with_headroom(self, footprint_bytes, limit_bytes) -> "BucketPlan":
+        """This plan with the advisory headroom recorded (a new frozen
+        value; None inputs leave the field None).  ``footprint_bytes``
+        is per-device (memory_analysis of the sharded executable)."""
+        from ..obs.perf import headroom_fraction
+
+        return dataclasses.replace(self, headroom_frac=headroom_fraction(
+            footprint_bytes, limit_bytes))
 
 
 def _largest_divisor_leq(b: int, d: int) -> int:
@@ -175,6 +194,7 @@ def placement_report(buckets: Sequence[int], num_devices: int,
                 "members_per_shard": pl.members_per_shard,
                 "exchange_bytes_per_step": plan_exchange_bytes_per_step(
                     pl, n, halo, dtype_bytes),
+                "headroom_frac": pl.headroom_frac,
             })
         out["modes"][mode] = {"buckets": rows}
     return out

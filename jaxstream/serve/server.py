@@ -77,6 +77,7 @@ import jax.numpy as jnp
 from ..config import Config, load_config
 from ..geometry.cubed_sphere import build_grid
 from ..io.async_pipeline import BackgroundWriter, HostFetch
+from ..obs import perf as obs_perf
 from ..obs import trace as obs_trace
 from ..obs.monitor import HealthMonitor
 from ..obs.registry import (HOST_WAIT_BUCKETS_S, LATENCY_BUCKETS_S,
@@ -85,6 +86,7 @@ from ..obs.sink import TelemetrySink, run_manifest
 from ..parallel.mesh import available_devices, setup_ensemble_sharding
 from ..physics import initial_conditions as ics
 from ..stepping import SCHEMES, integrate_masked, vmap_ensemble
+from ..utils import jax_compat
 from ..utils.logging import get_logger
 from .placement import PLACEMENT_MODES, BucketPlan, plan_placement
 from ..plan import rules as _plan_rules
@@ -143,7 +145,8 @@ class _Bucket:
 
     def __init__(self, group: str, B: int, seg_fn, extract_fn, inject_fn,
                  axes, stack, member_carry, plan: BucketPlan,
-                 mesh=None, carry_sh=None, rep_sh=None, proof=None):
+                 mesh=None, carry_sh=None, rep_sh=None, proof=None,
+                 cost=None):
         self.group = group
         self.B = B
         self.seg = seg_fn
@@ -155,6 +158,11 @@ class _Bucket:
         #: Round 16: the bucket stepper's capability proof stamp
         #: (jaxstream.plan.proof) — surfaced in stats and telemetry.
         self.proof = proof
+        #: Round 19: the bucket's cost stamp (jaxstream.obs.perf) —
+        #: analytic per-step flops/bytes always; footprint bytes +
+        #: XLA-vs-analytic flop ratio under ``serve.cost_stamps``;
+        #: compile seconds from the warmup either way.
+        self.cost = cost
         self._carry_sh = carry_sh
         self._rep = rep_sh
         self._stack = stack
@@ -332,6 +340,22 @@ class EnsembleServer:
         #: gateway; rendered by ``GET /v1/metrics``.
         self.metrics = MetricsRegistry()
         self._init_metrics()
+        #: Round 19 (performance observatory): the per-bucket compile
+        #: counters' last-seen totals (jaxstream_compiles_total moves
+        #: when a bucket's jit cache grows — a steady-state recompile
+        #: shows up on the scrape, not only in tests) and, under
+        #: ``serve.memory_watch``, the device-memory watcher polled at
+        #: every segment boundary.  Both live on the serving thread
+        #: (the registry's one-writer-per-name rule).
+        self._compiles_seen: Dict[tuple, int] = {}
+        self._cost_stamps = bool(s.cost_stamps)
+        self.memory_watcher = None
+        if s.memory_watch:
+            self.memory_watcher = obs_perf.MemoryWatcher(
+                devices=(self._devices if self._devices is not None
+                         else jax.devices()[:1]),
+                registry=self.metrics,
+                sink_write=self._sink_write)
         self._sink = None
         if s.sink:
             manifest_cfg = {
@@ -351,6 +375,13 @@ class EnsembleServer:
                 # Only stamped when tracing is ON, so an untraced
                 # run's manifest stays byte-identical to round 14's.
                 manifest_cfg["trace"] = True
+            # Same contract for the round-19 observatory knobs: the
+            # manifest names them only when they are on, so a
+            # default-config run's sink stays byte-identical.
+            if s.memory_watch:
+                manifest_cfg["memory_watch"] = True
+            if s.cost_stamps:
+                manifest_cfg["cost_stamps"] = True
             self._sink = TelemetrySink(s.sink, run_manifest(
                 config=manifest_cfg))
         self._fault_fired = False
@@ -373,6 +404,9 @@ class EnsembleServer:
                   "member-steps of work advanced")
         m.counter("jaxstream_guard_events_total",
                   "health-guard trips (member evictions)")
+        m.counter("jaxstream_compiles_total",
+                  "compiled executables per plan key (warmup included; "
+                  "a moving counter at steady state is a recompile)")
         m.gauge("jaxstream_queue_depth", "request queue depth")
         m.gauge("jaxstream_queue_capacity", "request queue bound")
         m.gauge("jaxstream_active_bucket_cap",
@@ -711,7 +745,7 @@ class EnsembleServer:
                 "vmap_b": "classic", "panel": "face"}[impl]
         if plan.mode == "member":
             tier = "gspmd"
-        proof = build_proof(plan_normalize(CapabilityPlan(
+        splan = plan_normalize(CapabilityPlan(
             tier=tier, n=cfg.grid.n, halo=self.grid.halo,
             scheme=cfg.time.scheme, ensemble=B,
             overlap=(cfg.parallelization.overlap_exchange
@@ -722,7 +756,11 @@ class EnsembleServer:
             num_devices=plan.num_devices,
             backend=("pallas" if impl == "fused"
                      else cfg.model.backend),
-            covariant=True)))
+            covariant=True))
+        proof = build_proof(splan)
+        # Round 19: the cost stamp rides next to the proof stamp —
+        # analytic per-step flops/bytes now, measured fields at warmup.
+        cost = obs_perf.build_cost(splan, plan_key=proof.plan_key)
 
         donate = (0,) if cfg.serve.donate else ()
         if mesh is None:
@@ -742,7 +780,8 @@ class EnsembleServer:
                             out_shardings=carry_sh)
         return _Bucket(group, B, seg_j, ex_j, inj_j, axes, stack,
                        member_carry, plan, mesh=mesh,
-                       carry_sh=carry_sh, rep_sh=rep, proof=proof)
+                       carry_sh=carry_sh, rep_sh=rep, proof=proof,
+                       cost=cost)
 
     def _impls_for(self, group: str, plan: BucketPlan) -> List[str]:
         """Candidate stepper impls for one bucket, most preferred
@@ -777,7 +816,11 @@ class EnsembleServer:
         for impl in impls:
             try:
                 bk = self._build_bucket(group, B, impl)
+                t_warm = time.perf_counter()
                 self._warm_bucket(bk)
+                bk.cost.compile_seconds = round(
+                    time.perf_counter() - t_warm, 4)
+                self._stamp_bucket(bk)
                 self._impls[group] = impl
                 self._buckets[key] = bk
                 self.stats["warmup_compiles"] = self.compile_count()
@@ -817,6 +860,63 @@ class EnsembleServer:
         carry = bk.inject(carry, jnp.int32(0), bk.put_member(st))
         jax.block_until_ready((ex["h"], carry["h"]))
 
+    def _stamp_bucket(self, bk: _Bucket) -> None:
+        """Round 19: fill the bucket cost stamp's measured fields.
+
+        Under ``serve.cost_stamps`` the segment executable is compiled
+        ONCE MORE ahead-of-time — the timed compile becomes the
+        recorded ``compile_seconds`` (replacing the warmup wall, which
+        includes a probe execution), XLA's cost/memory analysis fills
+        the footprint bytes and the flops-vs-analytic ratio, and the
+        advisory ``headroom_frac`` lands on the bucket plan when the
+        memory watcher knows the per-chip capacity.  One typed 'perf'
+        sink record per stamped bucket.  Off = analytic half + warmup
+        wall only (zero extra compiles, sink untouched)."""
+        if not self._cost_stamps:
+            return
+        seg = self.config.serve.segment_steps
+        try:
+            st = self._warm_member_tree(bk.group)
+            carry = bk.stack([st] * bk.B)
+            rem = np.zeros(bk.B, np.int64)
+            rem[0] = seg
+            obs_perf.measure_cost(
+                bk.seg, carry, bk.put_rem(rem),
+                analytic=bk.cost.analytic, steps=seg,
+                xla_visible=bk.cost.xla_visible, stamp=bk.cost)
+        except Exception as e:
+            bk.cost.memory = {
+                "unavailable": f"measure failed "
+                               f"({type(e).__name__}: {e})"}
+            log.warning("serve: cost stamp for bucket (%s, B=%d) "
+                        "unavailable (%s: %s)", bk.group, bk.B,
+                        type(e).__name__, e)
+        limit = None
+        if self.memory_watcher is not None:
+            if self.memory_watcher.last is None:
+                self.memory_watcher.poll()
+            limit = self.memory_watcher.limit_bytes()
+        footprint = bk.cost.memory.get("total_bytes")
+        if footprint and limit:
+            bk.plan = bk.plan.with_headroom(footprint, limit)
+            # placement_summary reads the shared per-B plan table;
+            # buckets of different groups share a B entry — last
+            # stamped wins there, each bucket's own value stays in
+            # bucket_costs().
+            self._plans[bk.B] = bk.plan
+        if self._sink is not None:
+            self._sink_write({
+                "kind": "perf", "plan": bk.cost.plan_key,
+                "bucket": bk.B, "group": bk.group,
+                "compile_seconds": bk.cost.compile_seconds,
+                "memory": bk.cost.memory,
+                "analytic": bk.cost.analytic, "xla": bk.cost.xla,
+                "flops_ratio": bk.cost.flops_ratio,
+                "bytes_ratio": bk.cost.bytes_ratio,
+                "in_band": bk.cost.in_band,
+                "headroom_frac": bk.plan.headroom_frac,
+            })
+
     def warmup(self, groups=("flat",), buckets=None):
         """Pre-compile the bucket set so the first real traffic hits
         warm executables (steady-state = zero recompiles).  ``groups``:
@@ -830,19 +930,25 @@ class EnsembleServer:
                 g = "any"
             for B in (buckets or self.buckets):
                 self._bucket(g, B)
+        # Publish the warmup compiles on the scrape before any
+        # traffic (the serving thread has not started — sequential,
+        # so the one-writer-per-name rule holds).
+        self._observe_perf()
         return self.compile_count()
 
     def compile_count(self) -> int:
         """Total compiled executables across every bucket's jits — the
         zero-steady-state-recompile assertion surface (-1 when the jax
-        build exposes no cache-size introspection)."""
+        build exposes no cache-size introspection; the introspection
+        itself is the shared ``jax_compat.compile_count`` helper the
+        round-19 compile-event counters also read)."""
         total = 0
         for bk in self._buckets.values():
             for f in bk.jits():
-                cs = getattr(f, "_cache_size", None)
+                cs = jax_compat.compile_count(f)
                 if cs is None:
                     return -1
-                total += cs()
+                total += cs
         return total
 
     def placement_summary(self) -> Optional[dict]:
@@ -866,6 +972,28 @@ class EnsembleServer:
         return {f"{g}/B{B}": (bk.proof.to_json()
                               if bk.proof is not None else None)
                 for (g, B), bk in sorted(self._buckets.items())}
+
+    def bucket_costs(self) -> Dict[str, Optional[dict]]:
+        """Per warm bucket: the cost stamp of its compiled masked
+        segment (round 19) — analytic flops/bytes, footprint bytes (or
+        the typed unavailable reason), compile seconds, the
+        XLA-vs-analytic flop ratio, and the plan's advisory headroom.
+        Surfaced by ``/v1/stats`` and ``scripts/serve.py``."""
+        out: Dict[str, Optional[dict]] = {}
+        for (g, B), bk in sorted(self._buckets.items()):
+            if bk.cost is None:
+                out[f"{g}/B{B}"] = None
+                continue
+            d = bk.cost.to_json()
+            d["headroom_frac"] = bk.plan.headroom_frac
+            out[f"{g}/B{B}"] = d
+        return out
+
+    def memory_snapshot(self) -> Optional[dict]:
+        """The memory watcher's latest per-chip record (None when
+        ``serve.memory_watch`` is off or nothing polled yet)."""
+        return (self.memory_watcher.last
+                if self.memory_watcher is not None else None)
 
     # ------------------------------------------------------------ admission
     def refusal_reasons(self) -> List[str]:
@@ -1022,8 +1150,30 @@ class EnsembleServer:
                 max_pending=8, name=SERVE_WRITER_THREAD_NAME)
         return self._writer
 
+    def _observe_perf(self) -> None:
+        """Segment-boundary observability (round 19): the per-plan
+        compile-event counters and — under ``serve.memory_watch`` —
+        one device-memory poll.  Runs on the serving thread at the
+        same cadence as the autoscale tick; the counter pass is a few
+        dict/attribute reads when nothing compiled, and ZERO memory
+        polling happens when the watcher is off."""
+        for key, bk in self._buckets.items():
+            counts = [jax_compat.compile_count(f) for f in bk.jits()]
+            cur = sum(c for c in counts if c is not None)
+            prev = self._compiles_seen.get(key, 0)
+            if cur > prev:
+                self._compiles_seen[key] = cur
+                self.metrics.counter_inc(
+                    "jaxstream_compiles_total", cur - prev,
+                    plan=(bk.proof.plan_key if bk.proof is not None
+                          else f"{key[0]}/B{key[1]}"))
+        if self.memory_watcher is not None:
+            self.memory_watcher.poll()
+
     def _tick(self, tick) -> None:
-        """Run the autoscale hook; a policy bug must not kill serving."""
+        """Boundary observers + the autoscale hook; a policy bug must
+        not kill serving."""
+        self._observe_perf()
         if tick is None:
             return
         try:

@@ -17,10 +17,12 @@ import os
 
 import jax
 
-__all__ = ["LEGACY_SHARD_MAP", "copy_to_host_async", "enable_compile_cache",
-           "maybe_enable_compile_cache", "named_scope",
-           "profiler_available", "shard_map", "start_profiler_trace",
-           "stop_profiler_trace", "tpu_compiler_params"]
+__all__ = ["LEGACY_SHARD_MAP", "compile_count", "copy_to_host_async",
+           "device_memory_stats", "enable_compile_cache",
+           "maybe_enable_compile_cache", "memory_analysis",
+           "named_scope", "profiler_available", "shard_map",
+           "start_profiler_trace", "stop_profiler_trace",
+           "tpu_compiler_params"]
 
 #: True on the 0.4.x line.  Besides the spelling differences shimmed
 #: below, that line's XLA trips an hlo-verifier bug ("tile_assignment
@@ -117,6 +119,87 @@ def stop_profiler_trace() -> None:
     except Exception as e:
         raise RuntimeError(
             f"profiler trace could not stop: {type(e).__name__}: {e}")
+
+
+#: ``Compiled.memory_analysis()`` size attributes -> the short names the
+#: cost stamps carry (``jaxstream.obs.perf``).  ``alias_size_in_bytes``
+#: is excluded from ``total_bytes`` — aliased (donated) buffers are
+#: already counted once in the argument bytes.
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+)
+
+
+def memory_analysis(compiled) -> dict:
+    """XLA's static memory accounting of one compiled executable.
+
+    Returns ``{"argument_bytes", "output_bytes", "temp_bytes",
+    "generated_code_bytes", "alias_bytes", "total_bytes"}`` from
+    ``Compiled.memory_analysis()`` — the per-plan footprint the
+    performance observatory stamps on every measured stepper
+    (``jaxstream.obs.perf``).  Raises ``RuntimeError`` (the typed
+    "unavailable" the cost stamps record verbatim) on jax builds /
+    backends that expose no memory analysis — never AttributeError
+    soup, so a stamp on an exotic backend says *why* it has no bytes
+    instead of crashing the build path.
+    """
+    ma = getattr(compiled, "memory_analysis", None)
+    if ma is None:
+        raise RuntimeError(
+            "unavailable: this jax build exposes no "
+            "Compiled.memory_analysis()")
+    try:
+        st = ma()
+    except Exception as e:
+        raise RuntimeError(
+            f"unavailable: memory_analysis failed "
+            f"({type(e).__name__}: {e})")
+    out = {}
+    for attr, key in _MEMORY_FIELDS:
+        v = getattr(st, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if not out:
+        raise RuntimeError(
+            "unavailable: memory_analysis returned no size fields "
+            f"(got {type(st).__name__})")
+    out["total_bytes"] = sum(v for k, v in out.items()
+                             if k != "alias_bytes")
+    return out
+
+
+def compile_count(fn):
+    """Compiled-executable count of one jitted callable, or None.
+
+    The jit-cache introspection the serving stack's zero-steady-state-
+    recompile proofs use (``EnsembleServer.compile_count``), promoted
+    here (round 19) so the compile-event counters on the metrics
+    scrape and the test assertions read the SAME private surface —
+    ``fn._cache_size()`` on every supported jax line; None when the
+    build exposes no cache introspection (callers decide how loudly to
+    degrade).
+    """
+    cs = getattr(fn, "_cache_size", None)
+    return None if cs is None else int(cs())
+
+
+def device_memory_stats(device):
+    """``device.memory_stats()`` as a dict, or None when the backend
+    keeps no per-device allocator stats (CPU returns None; stripped
+    builds may omit the method).  The MemoryWatcher's one read — a
+    poll can never raise out of the serving loop.
+    """
+    ms = getattr(device, "memory_stats", None)
+    if ms is None:
+        return None
+    try:
+        return ms()
+    except Exception:
+        return None
 
 
 def copy_to_host_async(tree):

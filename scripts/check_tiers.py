@@ -119,6 +119,26 @@ every gate run self-checking):
     certifies on every run; drive ``scripts/assimilate.py`` through
     its importable ``main()``/``run()``.
 
+13. **Perf-observatory tests stay non-slow, in-process, and
+    CPU-honest; sink kinds stay rendered** (round-19 satellite).  Two
+    halves: (a) a test module importing the performance observatory
+    (``jaxstream.obs.perf`` or ``perf_ledger``) must carry NO
+    ``slow`` markers, must not launch subprocesses (drive
+    ``scripts/perf_ledger.py`` through its importable ``main()``),
+    and must not gate on accelerator-only surfaces (``skipif`` on
+    tpu/gpu platforms or ``jax.devices('tpu')`` probes) — the cost-
+    stamp shapes, the typed memory_analysis fallback, the
+    watcher-off byte-identity and the ledger's seeded-broken fixture
+    are tier-1 acceptance criteria and must run on CPU in every fast
+    gate; (b) every record kind registered in
+    ``jaxstream/obs/sink.py``'s ``RECORD_KINDS`` must appear in BOTH
+    ``scripts/telemetry_report.py``'s and
+    ``scripts/telemetry_dashboard.py``'s ``RENDERED_KINDS`` sets —
+    the loud unrendered-kinds footer contract only holds if a newly
+    registered kind is actually taught to both tools (a registered-
+    but-unrendered kind would scream "schema drift" on every
+    operator view).
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -190,6 +210,20 @@ _DA_IMPORT_RE = re.compile(
     r"^\s*(from\s+jaxstream\.da\b|import\s+jaxstream\.da\b"
     r"|from\s+jaxstream\s+import\s+(\w+\s*,\s*)*da\b)",
     re.MULTILINE)
+_PERF_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.obs\.perf\b"
+    r"|import\s+jaxstream\.obs\.perf\b"
+    r"|from\s+jaxstream\.obs\s+import\s+[^\n]*"
+    r"\b(perf|CostStamp|MemoryWatcher|measure_cost|build_cost"
+    r"|check_trajectory|load_bench_history)\b"
+    r"|import\s+perf_ledger\b|from\s+perf_ledger\s+import\b)",
+    re.MULTILINE)
+#: Accelerator-only gating a tier-1 perf-obs module must not carry:
+#: a platform skipif or an explicit tpu/gpu device probe would drop
+#: the observatory's acceptance criteria from every CPU CI gate.
+_ACCEL_ONLY_RE = re.compile(
+    r"skipif\([^)]*[\"'](tpu|gpu)[\"']"
+    r"|jax\.devices\(\s*[\"'](tpu|gpu)[\"']")
 #: Actual subprocess USAGE (an import or an attribute call), so a
 #: docstring merely mentioning the word does not trip rule 10b.
 _SUBPROC_USE_RE = re.compile(
@@ -245,6 +279,48 @@ def lint_config_docs(root: str):
                    f"``` config block showing a top-level '{name}:' "
                    f"key — every section the plan layer can reject "
                    f"must be documented where users write it")
+
+
+#: The RECORD_KINDS table in jaxstream/obs/sink.py and the
+#: RENDERED_KINDS sets in the two stdlib operator tools — parsed
+#: textually (this lint must stay import-light, no jax).
+_RECORD_KINDS_RE = re.compile(
+    r"^RECORD_KINDS[^=]*=\s*\{(.*?)^\}", re.MULTILINE | re.DOTALL)
+_KIND_KEY_RE = re.compile(r"^\s{4}\"(\w+)\":", re.MULTILINE)
+_RENDERED_RE = re.compile(
+    r"RENDERED_KINDS\s*=\s*frozenset\(\{(.*?)\}\)", re.DOTALL)
+_QUOTED_RE = re.compile(r"\"(\w+)\"")
+
+
+def lint_sink_kinds(root: str):
+    """Rule 13b: every registered sink kind is rendered by BOTH
+    operator tools (the loud unrendered-kinds footer contract)."""
+    sink_py = os.path.join(root, "jaxstream", "obs", "sink.py")
+    tools = [os.path.join(root, "scripts", name) for name in
+             ("telemetry_report.py", "telemetry_dashboard.py")]
+    if not os.path.exists(sink_py) or not all(
+            os.path.exists(t) for t in tools):
+        return                      # repo layouts without the trio
+    with open(sink_py) as fh:
+        m = _RECORD_KINDS_RE.search(fh.read())
+    if not m:
+        yield (f"{os.path.relpath(sink_py)}: could not locate the "
+               f"RECORD_KINDS table (rule 13b parses it textually — "
+               f"keep the literal dict form)")
+        return
+    kinds = set(_KIND_KEY_RE.findall(m.group(1)))
+    for tool in tools:
+        with open(tool) as fh:
+            mm = _RENDERED_RE.search(fh.read())
+        rendered = set(_QUOTED_RE.findall(mm.group(1))) if mm else set()
+        for kind in sorted(kinds - rendered):
+            yield (f"{os.path.relpath(tool)}: sink record kind "
+                   f"{kind!r} (RECORD_KINDS in jaxstream/obs/sink.py) "
+                   f"is not in this tool's RENDERED_KINDS — a "
+                   f"registered kind the operator view cannot render "
+                   f"lands in the loud unrendered-kinds footer as "
+                   f"false schema drift; teach the tool the kind (and "
+                   f"render it) when registering it")
 
 
 def registered_markers(pytest_ini: str) -> set:
@@ -385,6 +461,30 @@ def lint_file(path: str, allowed: set):
                    f"importable main()/run(); a subprocess rewrite "
                    f"would be forced slow by rule 2, dropping the "
                    f"forecast-loop proof from the fast gate)")
+    if _PERF_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports the performance observatory "
+                   f"(jaxstream.obs.perf / perf_ledger) but marks "
+                   f"tests slow — the cost-stamp shapes, the typed "
+                   f"memory_analysis fallback, the watcher-off byte "
+                   f"identity and the ledger's seeded-broken fixture "
+                   f"must run in every fast gate; move the slow test "
+                   f"to a module that does not import the observatory")
+        if _SUBPROC_USE_RE.search(src):
+            yield (f"{rel}: imports the performance observatory but "
+                   f"launches subprocesses — perf-obs tests must run "
+                   f"IN-PROCESS (drive scripts/perf_ledger.py through "
+                   f"its importable main(); a subprocess rewrite "
+                   f"would be forced slow by rule 2, dropping the "
+                   f"regression-ledger proof from the fast gate)")
+        if _ACCEL_ONLY_RE.search(src):
+            yield (f"{rel}: imports the performance observatory and "
+                   f"gates on accelerator-only surfaces (a tpu/gpu "
+                   f"skipif or device probe) — tier-1 runs on CPU, so "
+                   f"an accelerator-only assert silently drops the "
+                   f"observatory's acceptance criteria from every CI "
+                   f"gate; use injectable stats_fn fakes and the "
+                   f"typed unavailable fallbacks instead")
     if _ANALYSIS_IMPORT_RE.search(src):
         if "slow" in used:
             yield (f"{rel}: imports jaxstream.analysis but marks tests "
@@ -421,6 +521,7 @@ def main(repo_root: str = None) -> int:
         violations += list(lint_file(os.path.join(tests_dir, name),
                                      allowed))
     violations += list(lint_config_docs(root))
+    violations += list(lint_sink_kinds(root))
     for v in violations:
         print("check_tiers:", v)
     if not violations:

@@ -30,9 +30,29 @@ STAGE_KERNEL_US = 263.0   # sum of the 3 Pallas stage kernels per step,
                           # 0.527 s over 2 000 steps)
 GLUE_US = 35.0            # device while-loop total 298 us minus kernels:
                           # router matmul/gather/rev/copy XLA ops
-FLOPS_PER_STEP = 137 * 6 * 384 * 384 * 3        # analytic count (+-15%)
-BYTES_F32_PER_STEP = 27 * 6 * 384 * 384 * 4     # 27 field passes
-BYTES_HALVED_BY_BF16 = 13.5 * 6 * 384 * 384 * 4  # 27 passes -> 13.5
+
+
+def _analytic_constants(n=384):
+    """The step cost from the ONE analytic model (round-19 dedupe:
+    ``jaxstream.obs.perf.analytic_cost`` — this file previously
+    carried hand-expanded ``137 * 6 * 384 * 384`` constants, and its
+    bf16 line still billed ALL 27 field passes as halved
+    (``27 -> 13.5``), the stale pre-round-10 accounting: only the 24
+    carry passes halve, the orography re-read stays f32.  The
+    corrected saved-bytes figure shrinks the inferred exposed-DMA
+    sensitivity accordingly; the decomposition below now states the
+    model it actually uses.  Imported lazily (sys.path dance
+    included) so the no-argument prediction mode stays runnable —
+    though no longer jax-free — and fails with a clear import error
+    rather than at the top of the file."""
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from jaxstream.obs.perf import analytic_cost
+
+    f32 = analytic_cost(n)
+    c16 = analytic_cost(n, carry_bytes=2)
+    return (f32["flops"],             # analytic count (+-15%)
+            f32["bytes"],             # 27 field passes at 4 B
+            f32["bytes"] - c16["bytes"])  # bytes a 16-bit carry saves
 
 # ---- hardware ratios (v5p / v5e) ---------------------------------------
 V5E_HBM_GBPS = 819.0
@@ -44,12 +64,16 @@ DT_CFL = 75.0    # the round-4 CFL-matched default (bench.py bench_tc5)
 
 
 def model(step_f32_us=None, step_bf16_us=None):
+    FLOPS_PER_STEP, BYTES_F32_PER_STEP, BYTES_SAVED_BY_BF16 = \
+        _analytic_constants()
     step_f32_us = STEP_F32_US if step_f32_us is None else step_f32_us
     step_bf16_us = STEP_BF16_US if step_bf16_us is None else step_bf16_us
-    # E: exposed-DMA sensitivity from the bf16 experiment.  Halving
-    # BYTES_HALVED_BY_BF16 saved (step_f32_us - step_bf16_us), so the
-    # exposed fraction of raw DMA time is measured, not assumed.
-    d_bytes = BYTES_HALVED_BY_BF16 / 2.0
+    # E: exposed-DMA sensitivity from the bf16 experiment.  Saving
+    # BYTES_SAVED_BY_BF16 (the 24 carry passes at 2 B instead of 4 —
+    # corrected round-10/19 accounting; the orography re-read stays
+    # f32) bought (step_f32_us - step_bf16_us), so the exposed
+    # fraction of raw DMA time is measured, not assumed.
+    d_bytes = BYTES_SAVED_BY_BF16
     raw_us_per_byte = 1.0 / (V5E_HBM_GBPS * 1e3)   # us/byte at v5e HBM BW
     saved_us = step_f32_us - step_bf16_us
     exposure = saved_us / (d_bytes * raw_us_per_byte)

@@ -4,7 +4,7 @@ The measurement methodology of DESIGN.md ("Step-time methodology"): jit a
 ``fori_loop`` of the step, size the window for multi-second runs, time
 the second call.  Usage::
 
-    python scripts/perf_probe.py [n] [variant ...]
+    python scripts/perf_probe.py [n] [variant ...] [--stamp]
 
 Variants: ``mc`` / ``minmod`` / ``none`` / ``vanleer`` (limiter choice
 on the compact covariant stepper), ``bf16`` (bf16 carry, h stored as
@@ -12,6 +12,14 @@ anomaly), ``int16`` (int16 fixed-point carry, magic-constant rounding),
 ``mixed16`` (h int16 fixed-point + u bf16 — mass-neutral 16-bit),
 ``noseam`` (seam imposition ablated — measurement only, breaks
 conservation).  Default: ``mc``.
+
+Round 19: every variant line carries its roofline from the SAME cost
+accounting bench uses (``jaxstream.obs.perf.roofline_json`` — one
+definition; 16-bit carries billed at the corrected ``carry_bytes=2``
+model, not the old ``bytes * 0.5``).  ``--stamp`` additionally
+compiles each variant's step ahead-of-time and prints its full cost
+stamp (footprint bytes, compile seconds, XLA-vs-analytic flop ratio —
+``measure_cost``; one extra XLA compile per variant).
 """
 
 import sys
@@ -47,9 +55,13 @@ def measure(step, y, dt, k1=3000, k2=15000):
 
 def main():
     args = sys.argv[1:]
+    stamp = "--stamp" in args
+    args = [a for a in args if a != "--stamp"]
     n = int(args[0]) if args and args[0].isdigit() else 384
     variants = [a for a in args if not a.isdigit()] or ["mc"]
     dt = 60.0
+
+    from jaxstream.obs import perf as obs_perf
 
     grid = build_grid(n, halo=2, radius=EARTH_RADIUS, dtype=jnp.float32)
     h_ext, v_ext, b_ext = williamson_tc5(grid, EARTH_GRAVITY, EARTH_OMEGA)
@@ -83,6 +95,27 @@ def main():
         rate = measure(step, y, dt)
         print(f"C{n} {v:8s}: {rate:8.1f} steps/s  "
               f"({rate * dt / 86400.0:.3f} sim-days/s)")
+        # Round 19: the roofline from the ONE cost-accounting
+        # definition (obs.perf; bench's variant entries use the same
+        # helper) — 16-bit carries at the corrected carry_bytes=2.
+        carry_bytes = 2 if v in ("bf16", "int16", "mixed16") else None
+        try:
+            rl = obs_perf.roofline_json(rate, n,
+                                        carry_bytes=carry_bytes)
+            print(f"    roofline: {rl['achieved_tflops']} TFLOP/s "
+                  f"({rl['pct_of_compute_roof']}% of VPU roof), "
+                  f"{rl['achieved_gbps']} GB/s "
+                  f"({rl['pct_of_hbm']}% of HBM), AI {rl['ai']}")
+        except Exception as e:
+            print(f"    roofline unavailable ({type(e).__name__}: {e})")
+        if stamp:
+            st_cost = obs_perf.measure_cost(
+                step, y, jnp.float32(0.0),
+                plan_key=f"perf_probe:{v}_C{n}",
+                analytic=obs_perf.analytic_cost(
+                    n, carry_bytes=carry_bytes),
+                xla_visible=False)   # fused Pallas: XLA can't see it
+            print(f"    {st_cost}")
 
 
 if __name__ == "__main__":

@@ -10,7 +10,10 @@ Usage::
 normalized :class:`~jaxstream.plan.plan.CapabilityPlan` — tier, every
 composition knob, the capability key, the canonical schedule
 fingerprint (explicit-exchange tiers), the declared runtime parity
-budget, and the proof stamp the built stepper will carry.  An illegal
+budget, and the proof stamp the built stepper will carry, plus the
+analytic half of its round-19 cost stamp (flops/bytes/AI per step —
+the measured footprint/compile fields land where a compile happens).
+An illegal
 composition prints the rule pointers and exits 2 — the same messages,
 from the same table, the factories raise at build time, shown here
 *statically* before any trace.  ``--serve`` resolves the config as an
@@ -34,6 +37,7 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
 def _explain(source: str, serving: bool, as_json: bool) -> int:
+    from jaxstream.obs.perf import build_cost
     from jaxstream.plan import PlanError, build_proof, plan_for
 
     try:
@@ -50,9 +54,15 @@ def _explain(source: str, serving: bool, as_json: bool) -> int:
                 print(f"  [{v.rule}] {v.pointer}")
         return 2
     stamp = build_proof(plan)
+    # Round 19: the analytic half of the cost stamp the built stepper
+    # will carry — pure arithmetic, printed statically like the rest
+    # of explain (the measured half lands where a compile happens:
+    # serve warmup under serve.cost_stamps, the bench perf section).
+    cost = build_cost(plan, plan_key=stamp.plan_key)
     if as_json:
         print(json.dumps({"ok": True, "plan": plan.describe(),
-                          "proof": stamp.to_json()}))
+                          "proof": stamp.to_json(),
+                          "cost": cost.to_json()}))
         return 0
     d = plan.describe()
     print(f"plan: {d.pop('key')}   (rules v{d.pop('rules_version')})")
@@ -67,6 +77,16 @@ def _explain(source: str, serving: bool, as_json: bool) -> int:
               else f"<= {parity['budget']:g} rel")
     print(f"  parity           {budget} vs {ref}")
     print(f"proof: {stamp}")
+    ana = cost.analytic
+    if ana is not None:
+        print(f"cost:  analytic {ana['flops'] / 1e9:.4f} GFLOP/step, "
+              f"{ana['bytes'] / 1e6:.3f} MB/step, "
+              f"AI {ana['ai']:.3f} flops/byte ({ana['basis']})")
+    else:
+        print("cost:  analytic - (no covariant stencil model for "
+              "this tier)")
+    print("cost:  footprint/compile-seconds land when the plan "
+          "compiles (serve.cost_stamps, bench perf section)")
     return 0
 
 

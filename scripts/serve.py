@@ -125,6 +125,12 @@ def main(argv=None) -> int:
     # Round 16: each warm bucket's capability proof stamp (plan key,
     # schedule fingerprint, rules version, matrix-coverage verdict).
     summary["bucket_proofs"] = server.bucket_proofs()
+    # Round 19: each warm bucket's cost stamp (footprint bytes,
+    # flops-vs-analytic ratio, compile seconds, advisory headroom).
+    summary["bucket_costs"] = server.bucket_costs()
+    memory = server.memory_snapshot()
+    if memory is not None:
+        summary["memory"] = memory
     print(json.dumps(summary))
     return 0 if server.stats["evicted"] == 0 else 1
 

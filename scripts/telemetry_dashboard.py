@@ -21,7 +21,13 @@ them — and renders a live ANSI operator view:
     chip attribution) and ``autoscale`` (live bucket-cap resizes)
     records;
   * **per-chip occupancy/utilization** — the latest multi-chip
-    placement gauges.
+    placement gauges;
+  * **device memory** (round 19, ``serve.memory_watch``) — per-chip
+    in-use bars with peak watermarks against capacity, from
+    ``memory`` records; plus the **plan cost stamps** panel
+    (footprint / compile seconds / flops-vs-analytic band /
+    advisory headroom) from ``perf`` records
+    (``serve.cost_stamps``).
 
 ``--once`` renders one frame and exits; ``--json`` emits that frame as
 one machine-readable JSON object instead of ANSI (the form tests and
@@ -69,7 +75,7 @@ _PHASE_COLOR = {"ingress": 90, "queue": 33, "pack": 35, "compute": 32,
 #: loud ``unrendered kinds`` footer instead of vanishing.
 RENDERED_KINDS = frozenset({
     "manifest", "span", "serve", "segment", "guard", "autoscale",
-    "gateway", "loadgen", "bench", "da",
+    "gateway", "loadgen", "bench", "da", "memory", "perf",
 })
 
 SPARK = "▁▂▃▄▅▆▇█"
@@ -139,6 +145,10 @@ class Dashboard:
         self.da_cycles = []             # EnKF 'da' cycle records
         self.events = []                # guard + autoscale feed
         self.chips = None               # latest per-chip gauges
+        self.memory = None              # latest 'memory' poll
+        self.memory_peak = []           # per-chip peak watermarks
+        self.memory_unavailable = None  # typed no-allocator-stats note
+        self.perf_stamps = {}           # plan -> latest 'perf' stamp
         self.outcomes = {}              # kind -> status -> count
         self.unknown = {}               # kind -> count (loud footer)
         self.manifests = 0
@@ -178,6 +188,26 @@ class Dashboard:
                  max(drifts) if drifts else None))
         elif kind == "da":
             self.da_cycles.append(rec)
+        elif kind == "memory":
+            if rec.get("unavailable"):
+                self.memory_unavailable = rec["unavailable"]
+            if rec.get("bytes_in_use"):
+                self.memory = rec
+                peaks = rec.get("peak_bytes") or rec["bytes_in_use"]
+                for j, p in enumerate(peaks):
+                    if j >= len(self.memory_peak):
+                        self.memory_peak.append(p)
+                    else:
+                        self.memory_peak[j] = max(self.memory_peak[j],
+                                                  p)
+        elif kind == "perf":
+            # Group is part of the identity: two batching groups warm
+            # the same B with DIFFERENT executables (oro carries the
+            # orography field), and collapsing them would silently
+            # overwrite one bucket's stamp with the other's.
+            key = (f"{rec.get('plan')}/{rec.get('group')}"
+                   f"/B{rec.get('bucket')}")
+            self.perf_stamps[key] = rec
         elif kind in ("guard", "autoscale"):
             self.events.append(rec)
         elif kind in ("gateway", "loadgen"):
@@ -248,6 +278,16 @@ class Dashboard:
                                for c in self.da_cycles][-64:],
             } if self.da_cycles else None,
             "chips": self.chips,
+            "memory": ({
+                "bytes_in_use": self.memory["bytes_in_use"],
+                "limit_bytes": self.memory.get("limit_bytes", []),
+                "peak_bytes": list(self.memory_peak),
+            } if self.memory is not None else
+                ({"unavailable": self.memory_unavailable}
+                 if self.memory_unavailable else None)),
+            "perf": ([self.perf_stamps[k]
+                      for k in sorted(self.perf_stamps)]
+                     if self.perf_stamps else None),
             "outcomes": self.outcomes,
             "unrendered_kinds": dict(sorted(self.unknown.items())),
         }
@@ -273,6 +313,28 @@ def phase_bar(phases, latency_s, width=28, color=True):
         if n > 0:
             out.append(_c(_PHASE_CH[ph] * n, _PHASE_COLOR[ph], color))
     return "".join(out)
+
+
+def _fmt_bytes(v):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return (f"{v:.0f}{unit}" if unit == "B"
+                    else f"{v:.1f}{unit}")
+        v /= 1024.0
+
+
+def memory_bar(used, peak, limit, width=24):
+    """One chip's memory as a bar: filled cells = in use, ``|`` = the
+    peak watermark, dots = free capacity (unknown capacity renders
+    the used/peak numbers alone)."""
+    if not limit:
+        return ""
+    fill = min(width, int(round(width * used / limit)))
+    mark = min(width - 1, int(round(width * peak / limit)))
+    cells = ["█"] * fill + ["·"] * (width - fill)
+    if mark >= fill:
+        cells[mark] = "|"
+    return "".join(cells)
 
 
 def render(frame, color=True):
@@ -331,6 +393,44 @@ def render(frame, color=True):
         parts = " ".join(f"{k}={v}" for k, v in sorted(by.items()))
         lines.append(f"  {kind + ' outcomes':<15} {parts}")
     lines.append("")
+
+    if frame.get("memory"):
+        mem = frame["memory"]
+        lines.append(_c("device memory (peak watermark |):", 4, color))
+        if mem.get("unavailable"):
+            lines.append(f"  {mem['unavailable']}")
+        for j, used in enumerate(mem.get("bytes_in_use", [])):
+            limits = mem.get("limit_bytes", [])
+            peaks = mem.get("peak_bytes", [])
+            limit = limits[j] if j < len(limits) else 0
+            peak = peaks[j] if j < len(peaks) else used
+            bar = memory_bar(used, peak, limit)
+            tail = (f"{_fmt_bytes(used)} used, peak "
+                    f"{_fmt_bytes(peak)}"
+                    + (f" / {_fmt_bytes(limit)}" if limit else ""))
+            lines.append(f"  chip {j}: {bar}  {tail}")
+        lines.append("")
+
+    if frame.get("perf"):
+        lines.append(_c("plan cost stamps:", 4, color))
+        for p in frame["perf"]:
+            mem_p = p.get("memory") or {}
+            foot = (_fmt_bytes(mem_p["total_bytes"])
+                    if mem_p.get("total_bytes") is not None
+                    else "footprint n/a")
+            ratio = p.get("flops_ratio")
+            band = ("" if p.get("in_band") is None
+                    else (" [in band]" if p["in_band"]
+                          else " [OUT OF BAND]"))
+            hr = p.get("headroom_frac")
+            grp = f"/{p['group']}" if p.get("group") else ""
+            lines.append(
+                f"  {p.get('plan')}{grp}/B{p.get('bucket')}: {foot}, "
+                f"compile {p.get('compile_seconds')}s"
+                + (f", flops x{ratio}" if ratio is not None else "")
+                + band
+                + (f", headroom {hr:.1%}" if hr is not None else ""))
+        lines.append("")
 
     if frame.get("assimilation"):
         da = frame["assimilation"]

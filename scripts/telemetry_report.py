@@ -19,6 +19,12 @@ Prints, from the run's manifest + segment/guard/bench records:
     (queue vs compute vs host_wait vs egress ...) when the sinks carry
     ``span`` records (``serve.trace: true``);
   * guard events (NaN / CFL breaches with their last-good step);
+  * the performance-observatory sections (round 19): per-chip device
+    memory (last / peak watermark / capacity, from ``memory`` records
+    under ``serve.memory_watch``) and the plan cost-stamp table
+    (footprint bytes, compile seconds, flops-vs-analytic ratio,
+    advisory headroom, from ``perf`` records under
+    ``serve.cost_stamps``);
   * bench records, if the file came from ``bench.py --telemetry``.
 
 ``--trace REQUEST_ID`` renders one request's span tree instead —
@@ -65,7 +71,7 @@ PHASES = ("ingress", "queue", "pack", "compute", "host_wait",
 #: ``unrendered_kinds`` footer instead of vanishing.
 RENDERED_KINDS = frozenset({
     "manifest", "segment", "guard", "bench", "serve", "gateway",
-    "loadgen", "autoscale", "span", "da",
+    "loadgen", "autoscale", "span", "da", "memory", "perf",
 })
 
 
@@ -241,6 +247,8 @@ def summarize(records):
     loadgens = [r for r in records if r.get("kind") == "loadgen"]
     autoscales = [r for r in records if r.get("kind") == "autoscale"]
     das = [r for r in records if r.get("kind") == "da"]
+    memories = [r for r in records if r.get("kind") == "memory"]
+    perfs = [r for r in records if r.get("kind") == "perf"]
     unrendered = {}
     for r in records:
         kind = r.get("kind")
@@ -368,6 +376,46 @@ def summarize(records):
                  "innovation_rms": d["innovation_rms"]}
                 for d in das],
         }
+    # Round 19: the performance observatory's columns.  'memory'
+    # records (serve.memory_watch) aggregate into per-chip last /
+    # peak-watermark / capacity; 'perf' records (serve.cost_stamps)
+    # are one row per compiled plan — footprint bytes, compile
+    # seconds, the flops-vs-analytic ratio and the advisory headroom.
+    memory = None
+    polls = [m for m in memories if m.get("bytes_in_use")]
+    if memories:
+        unavailable = next((m["unavailable"] for m in memories
+                            if m.get("unavailable")), None)
+        memory = {"polls": len(polls), "unavailable": unavailable}
+        if polls:
+            width = max(len(m["bytes_in_use"]) for m in polls)
+            last = polls[-1]
+
+            def col(key, j):
+                vals = [m[key][j] for m in polls if j < len(m[key])]
+                return vals
+
+            memory.update({
+                "devices": width,
+                "last_bytes_in_use": last["bytes_in_use"],
+                "peak_bytes": [max(col("peak_bytes", j) or [0])
+                               for j in range(width)],
+                "limit_bytes": last["limit_bytes"],
+            })
+    perf = None
+    if perfs:
+        perf = {"stamps": [
+            {"plan": p.get("plan"), "bucket": p.get("bucket"),
+             "group": p.get("group"),
+             "compile_seconds": p.get("compile_seconds"),
+             "footprint_bytes": (p.get("memory") or {}).get(
+                 "total_bytes"),
+             "memory_unavailable": (p.get("memory") or {}).get(
+                 "unavailable"),
+             "flops_ratio": p.get("flops_ratio"),
+             "in_band": p.get("in_band"),
+             "headroom_frac": p.get("headroom_frac")}
+            for p in perfs]}
     # Round 17: the per-phase latency decomposition over span trees
     # (serve.trace).  Grown into the serving section when one exists
     # (the spans came from the serve sink); standalone otherwise (a
@@ -381,6 +429,7 @@ def summarize(records):
             "gateway": gateway, "loadgen": loadgen,
             "autoscale": autoscale, "spans": spans,
             "assimilation": assimilation,
+            "memory": memory, "perf": perf,
             "unrendered_kinds": dict(sorted(unrendered.items())),
             "n_segments": len(segments)}
 
@@ -487,6 +536,46 @@ def print_report(s):
               f"{da['final_rmse']:.4f} (post-analysis "
               f"{da['final_rmse_post']:.4f}), final spread "
               f"{da['final_spread']:.4f}")
+
+    if s.get("memory"):
+        mem = s["memory"]
+        print(f"\ndevice memory ({mem['polls']} polls):")
+        if mem.get("unavailable"):
+            print(f"  unavailable: {mem['unavailable']}")
+        if mem.get("last_bytes_in_use"):
+            print(f"  {'chip':>4} {'in use':>14} {'peak':>14} "
+                  f"{'limit':>14} {'peak/limit':>10}")
+            for j, used in enumerate(mem["last_bytes_in_use"]):
+                peak = mem["peak_bytes"][j]
+                limit = (mem["limit_bytes"][j]
+                         if j < len(mem["limit_bytes"]) else 0)
+                frac = (f"{peak / limit:>10.1%}" if limit
+                        else f"{'?':>10}")
+                print(f"  {j:>4} {used:>14} {peak:>14} "
+                      f"{limit:>14} {frac}")
+
+    if s.get("perf"):
+        print("\nplan cost stamps:")
+        print(f"  {'plan':<28} {'bucket':>6} {'compile s':>10} "
+              f"{'footprint':>12} {'fl ratio':>8} {'band':>5} "
+              f"{'headroom':>9}")
+        for p in s["perf"]["stamps"]:
+            foot = (p["footprint_bytes"]
+                    if p["footprint_bytes"] is not None
+                    else (p.get("memory_unavailable") or "-")[:12])
+            band = ("ok" if p["in_band"]
+                    else "OUT" if p["in_band"] is False else "-")
+            hr = (f"{p['headroom_frac']:>9.3f}"
+                  if p.get("headroom_frac") is not None
+                  else f"{'-':>9}")
+            cs = (f"{p['compile_seconds']:>10.3f}"
+                  if p.get("compile_seconds") is not None
+                  else f"{'-':>10}")
+            print(f"  {str(p['plan']):<28.28} "
+                  f"{'' if p['bucket'] is None else p['bucket']:>6} "
+                  f"{cs} {foot:>12} "
+                  f"{'-' if p['flops_ratio'] is None else format(p['flops_ratio'], '>8.3f')} "
+                  f"{band:>5} {hr}")
 
     for name in ("gateway", "loadgen"):
         sec = s.get(name)

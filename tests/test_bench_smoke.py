@@ -241,6 +241,43 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert space["rules_version"] == RULES_VERSION
     assert set(space["keys"]) <= set(facts["variants"])
 
+    # The performance-observatory section (round 19): the smoke runs
+    # the REAL bench_perf code path on the classic rung — XLA sees
+    # every op, so the cost stamp carries real footprint bytes, a
+    # positive compile time, and an in-band flops-vs-analytic ratio —
+    # and device memory degrades to the typed unavailable record on
+    # this CPU image (TPU/GPU fill the per-chip lists).
+    assert rec["hardware"] == "cpu"
+    perf = rec["perf"]
+    assert "skipped" not in perf, perf
+    assert perf["hardware"] == "cpu"
+    assert perf["rung"] == "classic"
+    cost = perf["cost"]
+    assert cost["compile_seconds"] > 0
+    assert cost["memory"]["total_bytes"] > 0
+    assert cost["xla"]["flops"] > 0
+    assert cost["in_band"] is True, cost
+    mem = perf["memory"]
+    assert mem["kind"] == "memory"
+    assert mem["bytes_in_use"] == [] and "unavailable" in mem
+
+    # ...and the regression-ledger stamp (round 19): the recorded
+    # BENCH_r*.json trajectory parses, this CPU-smoke record lands as
+    # a reported-only candidate (never gated — the enforced
+    # trajectory is the accelerator one), and the check comes back
+    # clean.  The seeded-broken fixture keeping the gate's teeth is
+    # asserted in tests/test_perf_obs.py.
+    pl = rec["perf_ledger"]
+    assert "skipped" not in pl, pl
+    assert pl["ok"] is True
+    assert pl["enforced"] is False          # CPU smoke: reported-only
+    assert pl["hardware_class"] == "cpu"
+    assert pl["points"] >= 6                # r01..r05 + this candidate
+    # With BENCH_r06 (cpu) recorded, the CPU-smoke candidate has at
+    # least one comparable section — the check is not vacuous.
+    assert pl["compared_sections"] >= 1
+    assert pl["regressions"] == []
+
     # --telemetry writes a schema-valid obs-sink file alongside the
     # stdout JSON (round-8 satellite: bench rides the structured sink).
     from jaxstream.obs.sink import read_records
