@@ -30,6 +30,15 @@ classes the checker exists for, and ``tests/test_analysis.py`` +
   breaks the comparable-point lookup, the fixture comes back clean
   and CI catches the gate losing its teeth — the same pattern as the
   schedule fixtures, applied to the round-19 regression ledger.
+* ``torn_bundle`` (round 20) — a real flight-recorder crash bundle,
+  committed then truncated mid-events-file: exactly what a SIGKILL
+  between the events write and the manifest ``os.replace`` leaves
+  behind when the replace DID land but the events bytes did not.
+  ``flight.read_bundle`` must raise ``TornBundleError`` (the sha256
+  re-verification); if the reader stops re-hashing, a half-written
+  black box would be summarized as evidence — the worst possible
+  forensics failure.  ``scripts/postmortem.py`` rejects the same
+  corpus with exit 2 through its stdlib mirror.
 """
 
 from __future__ import annotations
@@ -40,10 +49,10 @@ from .schedule import verify_deep_program, verify_stage_perms
 
 __all__ = ["FIXTURES", "broken_dropped_pair_perms",
            "broken_deep_program", "broken_plan",
-           "broken_proof_stamp", "run_fixture"]
+           "broken_proof_stamp", "broken_torn_bundle", "run_fixture"]
 
 FIXTURES = ("dropped_pair", "deep_depth", "illegal_plan",
-            "proof_fingerprint", "perf_regression")
+            "proof_fingerprint", "perf_regression", "torn_bundle")
 
 
 def broken_dropped_pair_perms(stage: int = 2):
@@ -90,6 +99,30 @@ def broken_proof_stamp():
                                        use_shard_map=True))
     return dataclasses.replace(
         stamp, schedule_fingerprint="deadbeefdeadbeef")
+
+
+def broken_torn_bundle(root: str) -> str:
+    """Build a REAL committed crash bundle under ``root``, then tear
+    it: truncate the events file after commit (the manifest's sha256
+    and line count now promise bytes that are gone).  Returns the
+    bundle directory."""
+    import os
+
+    from ..obs import flight
+
+    rec = flight.FlightRecorder()
+    for i in range(8):
+        rec.record("queue.admit", id=f"r{i}", depth=i + 1)
+    w = flight.BundleWriter(root, bundle_id="fb-torn-fixture",
+                            recorder=rec)
+    manifest = w.commit("fixture", open_requests={
+        "queued": [], "in_flight": [{"id": "r7", "trace_id": "x"}]})
+    epath = os.path.join(w.path, manifest["events_file"])
+    with open(epath, "rb") as fh:
+        payload = fh.read()
+    with open(epath, "wb") as fh:
+        fh.write(payload[:len(payload) // 2])
+    return w.path
 
 
 def run_fixture(name: str, n: int = 12, halo: int = 2) -> ContractReport:
@@ -145,6 +178,25 @@ def run_fixture(name: str, n: int = 12, halo: int = 2) -> ContractReport:
             report.ok("perf.ledger", "fixture:perf_regression",
                       "ACCEPTED a 30% regression + grown footprint — "
                       "ledger broken")
+    elif name == "torn_bundle":
+        import tempfile
+
+        from ..obs import flight
+
+        with tempfile.TemporaryDirectory() as root:
+            bdir = broken_torn_bundle(root)
+            try:
+                flight.read_bundle(bdir)
+            except flight.TornBundleError as e:
+                report.fail("flight.read_bundle",
+                            "fixture:torn_bundle", str(e))
+            else:
+                # The reader lost its teeth: a clean report here exits
+                # 0, which the CLI/tier-1 assertions turn into a loud
+                # CI failure.
+                report.ok("flight.read_bundle", "fixture:torn_bundle",
+                          "ACCEPTED a truncated crash bundle — digest "
+                          "re-verification broken")
     else:
         raise ValueError(
             f"unknown fixture {name!r}; valid: {FIXTURES}")

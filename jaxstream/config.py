@@ -208,6 +208,16 @@ class ObservabilityConfig:
     # Testing hook: inject NaN into the metric STREAM (never the state)
     # at this global step (must be a sampled step); -1 = disabled.
     fault_step: int = -1
+    # Round 20: where crash-forensics bundles land (jaxstream.obs.
+    # flight).  The in-memory flight recorder is ALWAYS on (bounded
+    # ring, zero sink writes in steady state); a non-empty directory
+    # here additionally flushes an atomic crash bundle on HealthError /
+    # unhandled exception (and the serving stack keeps a live bundle
+    # re-committed at segment boundaries, so a SIGKILL still leaves a
+    # readable one).  '' = no bundle dumping — byte-identical on-disk
+    # behavior to round 19.  scripts/serve.py and scripts/gateway.py
+    # derive a default next to their sinks (--flight-dir overrides).
+    flight_dir: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -52,6 +52,7 @@ import time
 from typing import Dict, Optional
 
 from ..config import Config, load_config
+from ..obs import flight
 from ..obs import trace as obs_trace
 from ..obs.registry import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ..obs.sink import TelemetrySink, run_manifest
@@ -337,6 +338,7 @@ class Gateway:
               started_at: Optional[float] = None) -> dict:
         key = protocol.SHED_STATUS.get(code)
         if key is not None:
+            flight.record("gateway.shed", id=req_id, code=code)
             self.stats[key] += 1
             self.metrics.counter_inc("jaxstream_requests_shed_total",
                                      status=key)

@@ -51,6 +51,7 @@ import numpy as np
 
 import jax
 
+from ..obs import flight
 from ..utils.jax_compat import copy_to_host_async
 from ..utils.logging import get_logger
 
@@ -128,6 +129,8 @@ class BackgroundWriter:
                     fn(*args, **kwargs)
             except BaseException as e:      # noqa: BLE001 — must survive
                 self._exc = e
+                flight.record("io.writer_failed",
+                              error=type(e).__name__, detail=str(e))
                 log.warning("background writer task failed (%s: %s); "
                             "skipping the remaining queue",
                             type(e).__name__, e)
@@ -166,6 +169,10 @@ class BackgroundWriter:
         if self._closed:
             raise RuntimeError("BackgroundWriter is closed")
         self._raise_pending()
+        if self._q.full():
+            # The run loop is about to stall on host I/O — exactly the
+            # condition a postmortem wants on its timeline.
+            flight.record("io.backpressure", pending=self._q.qsize())
         self._q.put((fn, args, kwargs))
 
     def flush(self) -> None:

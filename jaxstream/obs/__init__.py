@@ -12,6 +12,8 @@ enabling it must not perturb the state carry, asserted bitwise in
 tests/test_obs.py).
 """
 
+from .flight import (RECORDER, BundleWriter, FlightRecorder,
+                     TornBundleError, latest_bundle, read_bundle)
 from .metrics import (METRICS, MetricSet, MetricSpec, build_metric_set,
                       default_metrics, fetch_buffer)
 from .monitor import GUARD_POLICIES, HealthError, HealthMonitor
@@ -26,6 +28,8 @@ from .trace import (RequestTrace, span_coverage, span_tree,
 __all__ = [
     "METRICS", "MetricSet", "MetricSpec", "build_metric_set",
     "default_metrics", "fetch_buffer",
+    "RECORDER", "BundleWriter", "FlightRecorder", "TornBundleError",
+    "latest_bundle", "read_bundle",
     "GUARD_POLICIES", "HealthError", "HealthMonitor",
     "CostStamp", "MemoryWatcher", "build_cost", "check_trajectory",
     "load_bench_history", "measure_cost",

@@ -41,6 +41,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from . import flight
 from ..utils.logging import get_logger
 
 __all__ = ["GUARD_POLICIES", "HealthError", "HealthMonitor"]
@@ -166,6 +167,8 @@ class HealthMonitor:
                 event["member"] = member
             new_events.append(event)
             self.events.append(event)
+            flight.record("guard", event=kind, step=int(steps[j]),
+                          value=value, member=member)
             if self.policy == "warn":
                 log.warning(
                     "health guard: %s%s at step %d (value %g; last good "
@@ -224,6 +227,8 @@ class HealthMonitor:
                 event["chip"] = int(chips[m])
             new_events.append(event)
             self.events.append(event)
+            flight.record("guard", event="nan", step=int(steps[m]),
+                          value=float(c), member=m)
             log.warning(
                 "health guard: nonfinite state in member %d at its step "
                 "%d (count %g)", m, int(steps[m]), float(c))

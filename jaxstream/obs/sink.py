@@ -110,6 +110,26 @@ RECORD_KINDS: Dict[str, tuple] = {
     # cross-check), "headroom_frac" (advisory static-footprint-vs-HBM
     # headroom of the bucket's placement).
     "perf": ("plan", "compile_seconds", "memory"),
+    # The flight recorder's ring-dump summary (round 20, jaxstream.obs.
+    # flight): written ONLY at crash-bundle dump time — never in steady
+    # state, which is what keeps every pre-round-20 sink byte-identical
+    # with the recorder always on.  Counts of the merged ring: events
+    # dumped, threads that contributed sub-rings, events the bounded
+    # rings dropped (a truncated timeline says so loudly).
+    "flight": ("events", "threads", "dropped"),
+    # One crash-bundle announcement (round 20): the bundle id, the
+    # bundle directory on disk, and the dump reason (signal name /
+    # 'health_error' / the unhandled exception's type).  The pointer
+    # scripts/postmortem.py follows from a sink file to the bundle.
+    "crash": ("bundle", "path", "reason"),
+    # One resume-lineage stamp (round 20): a Simulation/server that
+    # restarted from a checkpoint AND found a committed crash bundle
+    # records which bundle it descends from and the checkpoint step it
+    # resumed at — the lineage postmortem --diff cross-checks when it
+    # byte-compares a resumed run against an uninterrupted one.  Only
+    # written when a bundle exists, so bundle-less runs stay
+    # byte-identical to round 19.
+    "resume": ("bundle", "checkpoint_step", "step"),
 }
 
 SCHEMA_VERSION = 1

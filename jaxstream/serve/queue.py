@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Optional
+from typing import List, Optional
 
+from ..obs import flight
 from .request import ScenarioRequest
 
 __all__ = ["AdmissionRefused", "QueueFull", "RequestQueue",
@@ -86,6 +87,8 @@ class RequestQueue:
                         f"request queue still at capacity "
                         f"{self.capacity} after {timeout}s")
             self._q.append(req)
+            depth = len(self._q)
+        flight.record("queue.admit", id=req.id, depth=depth)
 
     def pop(self, group: Optional[str] = None) -> Optional[ScenarioRequest]:
         """Oldest request, or None when empty.
@@ -102,8 +105,12 @@ class RequestQueue:
                 if group is None or req.group == group:
                     del self._q[i]
                     self._not_full.notify()
-                    return req
-            return None
+                    popped = req
+                    break
+            else:
+                return None
+        flight.record("queue.pop", id=popped.id)
+        return popped
 
     def pop_group(self, group: str) -> Optional[ScenarioRequest]:
         """``pop(group=group)`` — kept as the round-11 spelling."""
@@ -131,6 +138,16 @@ class RequestQueue:
         for refill prep.  May exceed ``capacity`` transiently (these
         requests were already admitted once; dropping them on a guard
         trip would lose accepted traffic)."""
+        reqs = list(reqs)
         with self._not_full:
-            for req in reversed(list(reqs)):
+            for req in reversed(reqs):
                 self._q.appendleft(req)
+        for req in reqs:
+            flight.record("queue.requeue", id=req.id)
+
+    def snapshot(self) -> List[str]:
+        """Queued request ids in FIFO order, under the lock — the
+        crash bundle's 'admitted but not yet packed' half of the
+        open-request manifest (round 20)."""
+        with self._lock:
+            return [r.id for r in self._q]

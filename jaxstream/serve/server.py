@@ -77,6 +77,7 @@ import jax.numpy as jnp
 from ..config import Config, load_config
 from ..geometry.cubed_sphere import build_grid
 from ..io.async_pipeline import BackgroundWriter, HostFetch
+from ..obs import flight
 from ..obs import perf as obs_perf
 from ..obs import trace as obs_trace
 from ..obs.monitor import HealthMonitor
@@ -386,6 +387,82 @@ class EnsembleServer:
                 config=manifest_cfg))
         self._fault_fired = False
         self._closed = False
+        #: Round 20 (flight recorder): the serving blackbox.  SIGKILL
+        #: cannot be trapped, so when a flight dir is configured the
+        #: server keeps a LIVE crash bundle — atomically re-committed
+        #: at segment boundaries (throttled) and forced on every admit
+        #: — whose open-request manifest always names every admitted-
+        #: but-unfinished request.  ``self._resident`` mirrors the
+        #: batch loop's local resident list so the bundle can see what
+        #: is packed, not just what is queued.
+        self._resident: List[str] = []
+        self._blackbox: Optional[flight.BundleWriter] = None
+        self._flight_last = 0.0
+        self._flight_min_interval = 0.25
+        #: Latched by flight_dump: once a terminal reason (signal,
+        #: HealthError, ...) has been committed, the live re-commits
+        #: that keep running through a graceful drain must not revert
+        #: the bundle's reason to "live".
+        self._flight_reason = "live"
+        fdir = flight.resolve_flight_dir(cfg)
+        if fdir:
+            self._blackbox = flight.BundleWriter(fdir)
+
+    # --------------------------------------------------- flight recorder
+    def _open_requests(self) -> dict:
+        """Queued + in-flight request ids with trace ids — the crash
+        bundle's admitted-but-unfinished manifest."""
+        return flight.open_request_manifest(self.queue.snapshot(),
+                                            list(self._resident))
+
+    def flight_commit(self, force: bool = False,
+                      reason: str = "live") -> None:
+        """(Re-)commit the live crash bundle.  Throttled unless forced;
+        never raises out of the serving loop."""
+        bb = self._blackbox
+        if bb is None:
+            return
+        if reason != "live":
+            self._flight_reason = reason
+        reason = self._flight_reason
+        now = time.perf_counter()
+        if not force and now - self._flight_last < self._flight_min_interval:
+            return
+        self._flight_last = now
+        try:
+            bb.commit(
+                reason,
+                config={"serving": True, "grid_n": self.config.grid.n,
+                        "buckets": list(self.buckets),
+                        "segment_steps": self.config.serve.segment_steps},
+                proofs=self.bucket_proofs(),
+                cost_stamps=self.bucket_costs(),
+                device_memory=self.memory_snapshot(),
+                open_requests=self._open_requests(),
+                extra={"stats": {k: v for k, v in self.stats.items()
+                                 if isinstance(v, int)}})
+        except Exception as e:     # forensics must never kill serving
+            log.warning("flight bundle commit failed (%s: %s)",
+                        type(e).__name__, e)
+
+    def flight_dump(self, reason: str) -> None:
+        """Force one bundle commit (crash/signal path) and announce it
+        in the serve sink as typed ``flight`` + ``crash`` records."""
+        if self._blackbox is None:
+            return
+        self.flight_commit(force=True, reason=reason)
+        try:
+            events, threads, dropped = flight.RECORDER.dump()
+            self._sink_write({"kind": "flight", "events": len(events),
+                              "threads": len(threads),
+                              "dropped": dropped})
+            self._sink_write({"kind": "crash",
+                              "bundle": self._blackbox.bundle_id,
+                              "path": self._blackbox.path,
+                              "reason": reason})
+        except Exception as e:
+            log.warning("flight dump sink records failed (%s: %s)",
+                        type(e).__name__, e)
 
     def _init_metrics(self):
         """Declare the scrape surface up front (names, types, bucket
@@ -467,6 +544,7 @@ class EnsembleServer:
         final step (:meth:`serve_forever` exits once the queue is
         empty).  Nothing is re-queued or dropped."""
         self._draining = True
+        flight.record("serve.drain", queue_depth=len(self.queue))
 
     # ------------------------------------------------------- live resize
     @property
@@ -504,6 +582,9 @@ class EnsembleServer:
             self.stats["resizes"] += 1
             log.info("serve: resized active bucket cap %d -> %d%s",
                      old, max_bucket, f" ({reason})" if reason else "")
+        flight.record("serve.resize", from_bucket=old,
+                      to_bucket=int(max_bucket),
+                      reason=reason or "manual")
         if self._sink is not None:
             self._sink_write({
                 "kind": "autoscale", "from_bucket": old,
@@ -1068,6 +1149,11 @@ class EnsembleServer:
                 "admission — the request was withdrawn, not stranded")
         self.stats["submitted"] += 1
         self.metrics.counter_inc("jaxstream_requests_submitted_total")
+        # Forced (unthrottled) bundle re-commit on EVERY admission: the
+        # last committed bundle must name every admitted-but-unfinished
+        # request, so a SIGKILL at any instant leaves a manifest whose
+        # open-request set includes this one.
+        self.flight_commit(force=True)
 
     # -------------------------------------------------------------- serving
     def serve(self):
@@ -1079,6 +1165,12 @@ class EnsembleServer:
                 if req is None:
                     break
                 self._run_batch(req)
+        except BaseException as e:
+            # Crash forensics (round 20): commit the black box and
+            # stamp the sink BEFORE the writer flush below — a second
+            # failure during flush must not cost us the bundle.
+            self.flight_dump(reason=type(e).__name__)
+            raise
         finally:
             if self._writer is not None:
                 self._writer.flush()
@@ -1092,7 +1184,9 @@ class EnsembleServer:
         between empty polls.  After :meth:`begin_drain`, exits once the
         queue is empty and every admitted request reached its final
         state (the writer is flushed on the way out, so results are
-        delivered when this returns).
+        delivered when this returns).  An escaping exception dumps the
+        flight ring (crash bundle + ``flight``/``crash`` sink records)
+        before propagating.
 
         ``tick``, when given, is called as ``tick(self)`` at every
         SEGMENT boundary — the autoscale hook: it observes queue depth
@@ -1139,6 +1233,9 @@ class EnsembleServer:
                 last_idle_tick = float("-inf")
                 if self._writer is not None:
                     self._writer.flush()
+        except BaseException as e:
+            self.flight_dump(reason=type(e).__name__)
+            raise
         finally:
             if self._writer is not None:
                 self._writer.flush()
@@ -1167,8 +1264,16 @@ class EnsembleServer:
                     "jaxstream_compiles_total", cur - prev,
                     plan=(bk.proof.plan_key if bk.proof is not None
                           else f"{key[0]}/B{key[1]}"))
+                flight.record(
+                    "compile", delta=cur - prev,
+                    plan=(bk.proof.plan_key if bk.proof is not None
+                          else f"{key[0]}/B{key[1]}"))
         if self.memory_watcher is not None:
-            self.memory_watcher.poll()
+            rec = self.memory_watcher.poll()
+            if rec is not None and rec.get("bytes_in_use"):
+                flight.record("memory.watermark",
+                              bytes_in_use=max(rec["bytes_in_use"]),
+                              peak_bytes=max(rec["peak_bytes"] or [0]))
 
     def _tick(self, tick) -> None:
         """Boundary observers + the autoscale hook; a policy bug must
@@ -1241,6 +1346,11 @@ class EnsembleServer:
             active_before = sum(active_mask)
             resident = [(i, sl.req.id) for i, sl in enumerate(slots)
                         if sl is not None]
+            # The black box's in-flight view: updated BEFORE the
+            # segment dispatches, so the crash bundle committed at
+            # this boundary names exactly the members a kill during
+            # the segment would strand.
+            self._resident = [rid for _, rid in resident]
             carry, _, nf = bk.seg(carry, bk.put_rem(rem))
             # The health stream rides a HostFetch: its d2h copy chases
             # the segment's compute while the host does the boundary
@@ -1452,10 +1562,21 @@ class EnsembleServer:
                             / (per_shard * seg), 4)
                         for j in range(m_shards)]
                 self._sink_write(rec)
+            flight.record("serve.boundary", bucket=B,
+                          active=active_before, completed=completed,
+                          evicted=evicted, refilled=refilled,
+                          queue_depth=len(self.queue))
             # Autoscale hook, once per segment boundary — queue depth
             # and last_occupancy are fresh here.  A resize ends this
             # batch's refill (see cap0 note above).
             self._tick(tick)
+            # Post-boundary resident set (completions/evictions above
+            # freed slots; refill re-occupied some) before the live
+            # bundle re-commit — throttled, so a fast segment cadence
+            # costs at most ~4 commits/second.
+            self._resident = [sl.req.id for sl in slots
+                              if sl is not None]
+            self.flight_commit()
             if allow_refill and self._active_max != cap0:
                 allow_refill = False
                 log.info("serve: active cap resized %d -> %d mid-"

@@ -42,6 +42,7 @@ from .io.history import HistoryWriter, save_geometry
 from .models.advection import TracerAdvection
 from .models.diffusion import ThermalDiffusion
 from .models.shallow_water import ShallowWater
+from .obs import flight
 from .obs import metrics as obs_metrics
 from .obs.monitor import HealthMonitor
 from .obs.sink import TelemetrySink, run_manifest
@@ -347,9 +348,17 @@ class Simulation:
                        "members": self.members},
                 tt_rank=hist_rank,
             )
+        # Crash forensics (round 20): dump-once latch + resume lineage.
+        # Lineage is recorded only when this run actually resumed from
+        # a checkpoint AND a committed crash bundle exists in the
+        # configured flight dir — the prior incarnation's black box.
+        self._flight_dumped = False
+        self._resume_lineage: Optional[dict] = None
         if io.checkpoint_stride > 0:
             self.checkpoints = CheckpointManager(io.checkpoint_path)
             self._maybe_resume()
+            if self.step_count > 0:
+                self._resume_lineage = self._find_lineage()
         # Telemetry last: the metric reference must see the post-resume
         # state, and the guard's postmortem callback needs the
         # checkpoint manager.
@@ -447,6 +456,21 @@ class Simulation:
                               if self.proof is not None else None),
                 })
             sink = TelemetrySink(o.sink, manifest)
+            if self._resume_lineage is not None:
+                # Typed lineage stamp (round 20): this run descends
+                # from the named crash bundle's incident; the
+                # postmortem CLI joins the two files on it.  Only
+                # written when a resume really happened AND a committed
+                # bundle exists — otherwise the sink stays
+                # byte-identical to round 19.
+                sink.write({
+                    "kind": "resume",
+                    "bundle": self._resume_lineage["bundle"],
+                    "checkpoint_step":
+                        self._resume_lineage["checkpoint_step"],
+                    "step": self.step_count,
+                    "path": self._resume_lineage["path"],
+                })
         # Step-0 reference for the drift columns: one eager evaluation
         # of the metric vector on the initial (or resumed) state.
         ref = np.asarray(jax.device_get(jax.jit(ms.values)(ex)))
@@ -560,6 +584,72 @@ class Simulation:
         log.warning("guard breach: postmortem checkpoint saved at step "
                     "%d%s", self.step_count,
                     f" (member {member})" if member is not None else "")
+
+    # ------------------------------------------------- crash forensics
+    def _find_lineage(self) -> Optional[dict]:
+        """The latest committed crash bundle in the configured flight
+        dir, verified readable — the prior incarnation this resumed
+        run descends from.  None when no flight dir is configured, no
+        bundle exists, or the newest bundle is torn (a torn black box
+        must not block the restart; the postmortem CLI reports it)."""
+        fdir = flight.resolve_flight_dir(self.config)
+        bdir = flight.latest_bundle(fdir) if fdir else None
+        if bdir is None:
+            return None
+        try:
+            manifest, _ = flight.read_bundle(bdir)
+        except flight.TornBundleError as e:
+            log.warning("resume: latest crash bundle %s is torn (%s); "
+                        "resuming without lineage", bdir, e)
+            return None
+        return {"bundle": manifest["bundle_id"], "path": bdir,
+                "checkpoint_step": self.step_count}
+
+    def _flight_dump(self, reason: str) -> None:
+        """Flush the flight ring as an atomic crash bundle + typed
+        ``flight``/``crash`` sink records.  Once per incident (the
+        first failure's evidence must not be overwritten by unwind
+        noise); no-op without ``observability.flight_dir``; never
+        raises (forensics must not mask the in-flight exception)."""
+        if self._flight_dumped:
+            return
+        fdir = flight.resolve_flight_dir(self.config)
+        if not fdir:
+            return
+        self._flight_dumped = True
+        try:
+            from .utils import jax_compat
+
+            cfg = self.config
+            ckpt = None
+            if self.checkpoints is not None:
+                step = self.checkpoints.latest_step()
+                if step is not None:
+                    ckpt = {"step": step, "path": self.checkpoints.path}
+            writer = flight.BundleWriter(fdir)
+            writer.commit(
+                reason,
+                config={"grid_n": cfg.grid.n, "dt": cfg.time.dt,
+                        "members": self.members,
+                        "step": self.step_count,
+                        "guards": cfg.observability.guards},
+                proofs={"run": (self.proof.to_json()
+                                if self.proof is not None else None)},
+                device_memory=jax_compat.device_memory_stats(
+                    jax.devices()[0]),
+                checkpoint=ckpt)
+            obs = self._obs
+            if obs is not None and obs.sink is not None:
+                events, threads, dropped = flight.RECORDER.dump()
+                obs.sink.write({
+                    "kind": "flight", "events": len(events),
+                    "threads": len(threads), "dropped": dropped})
+                obs.sink.write({
+                    "kind": "crash", "bundle": writer.bundle_id,
+                    "path": writer.path, "reason": reason})
+        except Exception as e:
+            log.warning("flight bundle dump failed (%s: %s)",
+                        type(e).__name__, e)
 
     def _ensure_writer(self) -> BackgroundWriter:
         if self._writer is None or not self._writer.alive:
@@ -1328,26 +1418,36 @@ class Simulation:
             })
             obs.wrote_initial = True
         wall0 = time.perf_counter()
-        if io.async_pipeline.enabled:
-            self._run_loop_async(total, seg, io)
-        else:
-            while self.step_count < total:
-                k = (min(seg, total - self.step_count) if seg
-                     else total - self.step_count)
-                self._run_segment(k)
-                if (io.history_stride
-                        and self.step_count % io.history_stride == 0):
-                    w0 = time.perf_counter()
-                    self._emit()
-                    self._host_wait += time.perf_counter() - w0
-                if (
-                    self.checkpoints is not None
-                    and self.step_count % io.checkpoint_stride == 0
-                ):
-                    w0 = time.perf_counter()
-                    self.checkpoints.save(self.step_count, self.state,
-                                          self.t)
-                    self._host_wait += time.perf_counter() - w0
+        try:
+            if io.async_pipeline.enabled:
+                self._run_loop_async(total, seg, io)
+            else:
+                while self.step_count < total:
+                    k = (min(seg, total - self.step_count) if seg
+                         else total - self.step_count)
+                    self._run_segment(k)
+                    flight.record("segment", step=self.step_count, k=k)
+                    if (io.history_stride
+                            and self.step_count % io.history_stride == 0):
+                        w0 = time.perf_counter()
+                        self._emit()
+                        self._host_wait += time.perf_counter() - w0
+                    if (
+                        self.checkpoints is not None
+                        and self.step_count % io.checkpoint_stride == 0
+                    ):
+                        w0 = time.perf_counter()
+                        self.checkpoints.save(self.step_count, self.state,
+                                              self.t)
+                        flight.record("checkpoint",
+                                      step=self.step_count)
+                        self._host_wait += time.perf_counter() - w0
+        except BaseException as e:
+            # HealthError / unhandled exception: flush the black box
+            # BEFORE unwinding (the sink records ride the same open
+            # sink; the bundle commit is atomic on its own).
+            self._flight_dump(type(e).__name__)
+            raise
         jax.block_until_ready(self.state)
         wall = time.perf_counter() - wall0
         ran = self.step_count - start
@@ -1493,6 +1593,8 @@ class Simulation:
         now = time.perf_counter()
         wall = now - self._seg_anchor
         self._seg_anchor = now
+        flight.record("segment", step=b["step_end"], k=b["k"],
+                      wall_s=round(wall, 6))
         host_state = (b["state"].resolve() if b["state"] is not None
                       else None)
         tasks = []
@@ -1510,6 +1612,7 @@ class Simulation:
             if b["hist"]:
                 tasks.append((self.history.append, (host_state, t_host)))
             if b["ckpt"]:
+                flight.record("checkpoint", step=b["step_end"])
                 tasks.append((self.checkpoints.save,
                               (b["step_end"], host_state, t_host)))
         finally:

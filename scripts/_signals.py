@@ -1,0 +1,60 @@
+"""Shared CLI shutdown plumbing (round 20).
+
+One signal-drain helper for ``scripts/serve.py`` and
+``scripts/gateway.py``: both CLIs must react identically to SIGTERM
+*and* SIGINT — dump the flight ring as an atomic crash bundle, enter
+the graceful drain, and still print their one-line JSON summary on the
+way out.  Before this module each CLI grew its own handler (gateway
+had one, serve had none), which is exactly how the two drift apart.
+
+The drain hook runs IN the signal handler (CPython runs handlers
+between bytecodes on the main thread).  That is safe here because the
+hook only flips the server's draining flag and commits the flight
+bundle — small, bounded work — and it is the only way the bundle gets
+written when the main thread is parked deep inside a blocking serve
+loop that a mere ``stop.set()`` cannot interrupt mid-batch.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Callable, Optional
+
+
+def log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def install_drain_handlers(stop: threading.Event,
+                           on_drain: Optional[Callable[[str], None]] = None,
+                           name: str = "serve") -> Callable:
+    """Install SIGTERM + SIGINT handlers that set ``stop`` and invoke
+    ``on_drain(signame)`` exactly once (later signals only re-set the
+    event, so a second Ctrl-C during the drain cannot double-dump the
+    bundle or re-enter the hook).  A hook failure is logged, never
+    raised — a broken forensics path must not turn a clean drain into
+    a crash.  Returns the installed handler (tests invoke it
+    directly)."""
+    fired = threading.Event()
+
+    def on_signal(signum, frame):
+        del frame
+        try:
+            signame = signal.Signals(signum).name
+        except ValueError:
+            signame = f"signal {signum}"
+        log(f"{name}: received {signame}; draining")
+        if on_drain is not None and not fired.is_set():
+            fired.set()
+            try:
+                on_drain(signame)
+            except Exception as e:
+                log(f"{name}: drain hook failed "
+                    f"({type(e).__name__}: {e})")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    return on_signal

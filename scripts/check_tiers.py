@@ -139,6 +139,22 @@ every gate run self-checking):
     but-unrendered kind would scream "schema drift" on every
     operator view).
 
+14. **Flight-recorder/postmortem tests stay non-slow and in-process;
+    kill tests stay slow** (round-20 black-box satellite).  Two
+    halves: (a) a test module importing the flight recorder
+    (``jaxstream.obs.flight``) or the postmortem reconstructor
+    (``scripts/postmortem.py`` via ``import postmortem``) must carry
+    NO ``slow`` markers and must not launch subprocesses — the ring
+    semantics, the atomic-bundle round trip, the torn-bundle
+    rejection, the sink byte-identity claim and the resume-lineage
+    proof are tier-1 acceptance criteria (drive the postmortem CLI
+    through its importable ``main()``); (b) any test module that
+    launches subprocesses AND references a hard kill
+    (``SIGKILL``/``.kill(``) must carry ``pytest.mark.slow`` — the
+    SIGKILL crash-forensics capstone spawns a real serving process
+    and waits on it, which is exactly the cost profile the fast
+    tier's budget excludes.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -224,6 +240,18 @@ _PERF_IMPORT_RE = re.compile(
 _ACCEL_ONLY_RE = re.compile(
     r"skipif\([^)]*[\"'](tpu|gpu)[\"']"
     r"|jax\.devices\(\s*[\"'](tpu|gpu)[\"']")
+_FLIGHT_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.obs\.flight\b"
+    r"|import\s+jaxstream\.obs\.flight\b"
+    r"|from\s+jaxstream\.obs\s+import\s+[^\n]*"
+    r"\b(flight|FlightRecorder|BundleWriter|read_bundle"
+    r"|latest_bundle|TornBundleError)\b"
+    r"|import\s+postmortem\b|from\s+postmortem\s+import\b)",
+    re.MULTILINE)
+#: A hard-kill reference next to subprocess usage marks the SIGKILL
+#: crash-forensics capstone (and anything shaped like it) — those
+#: must ride the slow tier.
+_HARD_KILL_RE = re.compile(r"\bSIGKILL\b|\.kill\(")
 #: Actual subprocess USAGE (an import or an attribute call), so a
 #: docstring merely mentioning the word does not trip rule 10b.
 _SUBPROC_USE_RE = re.compile(
@@ -485,6 +513,32 @@ def lint_file(path: str, allowed: set):
                    f"observatory's acceptance criteria from every CI "
                    f"gate; use injectable stats_fn fakes and the "
                    f"typed unavailable fallbacks instead")
+    if _FLIGHT_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports the flight recorder/postmortem "
+                   f"surface (jaxstream.obs.flight or postmortem) but "
+                   f"marks tests slow — the ring semantics, the "
+                   f"atomic-bundle round trip, the torn-bundle "
+                   f"rejection, the sink byte-identity claim and the "
+                   f"resume-lineage proof are tier-1 acceptance "
+                   f"criteria and must run in every fast gate; move "
+                   f"the slow test to a module that does not import "
+                   f"the flight surface")
+        if _SUBPROC_USE_RE.search(src):
+            yield (f"{rel}: imports the flight recorder/postmortem "
+                   f"surface but launches subprocesses — flight/"
+                   f"postmortem tests must run IN-PROCESS (drive "
+                   f"scripts/postmortem.py through its importable "
+                   f"main(); the subprocess SIGKILL capstone lives in "
+                   f"a module that reads the bundle JSON directly "
+                   f"without importing the surface)")
+    if _SUBPROC_USE_RE.search(src) and _HARD_KILL_RE.search(src) \
+            and "slow" not in used:
+        yield (f"{rel}: launches subprocesses and references a hard "
+               f"kill (SIGKILL/.kill() ) but carries no "
+               f"pytest.mark.slow — process-kill forensics tests "
+               f"spawn and wait on real serving processes, which the "
+               f"fast tier's time budget excludes")
     if _ANALYSIS_IMPORT_RE.search(src):
         if "slow" in used:
             yield (f"{rel}: imports jaxstream.analysis but marks tests "

@@ -33,17 +33,17 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
 import sys
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import _signals  # noqa: E402 — shared CLI signal-drain helper
 
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+log = _signals.log
 
 
 def build_autoscale(args):
@@ -87,22 +87,39 @@ def main(argv=None) -> int:
                     help="enable POST /v1/profile: on-demand "
                          "jax.profiler captures land here (empty = "
                          "endpoint answers a typed 501)")
+    ap.add_argument("--flight-dir", default="",
+                    help="flight-recorder crash-bundle directory "
+                         "(default: '<--sink>.flight' when --sink is "
+                         "given, else off)")
     args = ap.parse_args(argv)
 
+    import dataclasses
+
+    from jaxstream.config import load_config
     from jaxstream.gateway import Gateway
+
+    cfg = load_config(args.config)
+    flight_dir = args.flight_dir or (
+        args.sink + ".flight" if args.sink else "")
+    if flight_dir:
+        cfg = dataclasses.replace(
+            cfg, observability=dataclasses.replace(
+                cfg.observability, flight_dir=flight_dir))
+
+    gw = Gateway(cfg, host=args.host, port=args.port,
+                 autoscale=build_autoscale(args), sink=args.sink,
+                 profile_dir=args.profile_dir)
 
     stop = threading.Event()
 
-    def on_signal(signum, frame):
-        log(f"gateway: received signal {signum}; draining")
-        stop.set()
+    def _drain(signame: str) -> None:
+        # Commit the black box FIRST (gw.close's drain may take a
+        # while; the bundle must exist even if the drain is cut short
+        # by a second, harder signal).
+        gw.server.flight_dump(reason=f"signal:{signame}")
 
-    signal.signal(signal.SIGTERM, on_signal)
-    signal.signal(signal.SIGINT, on_signal)
+    _signals.install_drain_handlers(stop, _drain, name="gateway")
 
-    gw = Gateway(args.config, host=args.host, port=args.port,
-                 autoscale=build_autoscale(args), sink=args.sink,
-                 profile_dir=args.profile_dir)
     gw.start()
     log(f"gateway: serving on {gw.url} "
         f"(buckets {list(gw.server.buckets)}, warm "
@@ -128,6 +145,8 @@ def main(argv=None) -> int:
             "url": gw.url,
             "wall_s": round(time.perf_counter() - t0, 3),
         }
+        if flight_dir:
+            summary["flight_dir"] = flight_dir
         if snap is not None:
             summary.update({
                 "gateway": snap["gateway"],

@@ -1,0 +1,407 @@
+"""Postmortem timeline reconstructor for flight-recorder crash bundles.
+
+Usage::
+
+    python scripts/postmortem.py BUNDLE_DIR [--sink FILE ...] [--json]
+        [--last N]
+    python scripts/postmortem.py --diff DIR_A DIR_B
+
+The forensic half of the round-20 black box (``jaxstream/obs/
+flight.py``): given one committed crash bundle — and optionally the
+deployment's ordinary sink files — it
+
+* **verifies the bundle** exactly as ``flight.read_bundle`` does
+  (manifest present and parseable, required keys, events file present,
+  sha256 and line count match, every event line JSON) and exits ``2``
+  on a torn bundle: truncation is evidence of a kill mid-commit and
+  must never be silently summarized;
+* **reconstructs the incident timeline** — the merged per-thread ring
+  events in global sequence order, rendered with offsets relative to
+  the last event (the moment of death);
+* **renders what was in flight at death** — the manifest's
+  open-request section: every admitted-but-unfinished request id with
+  its deterministic trace id, split queued vs in-flight;
+* **cross-checks the sink's trace spans** (when ``--sink`` files carry
+  ``span`` records): each completed span tree's leaf sum must tile its
+  root duration within the trace contract's epsilon — a root/leaf
+  mismatch in the dying run's telemetry is itself a finding;
+* summarizes the sinks' incident records (``guard``/``crash``/
+  ``resume``/``autoscale``) around the bundle.
+
+``--diff A B`` compares a RESUMED run's output directory against an
+uninterrupted reference to the round-5 standard: every non-JSONL file
+byte-for-byte, every ``.jsonl`` record-for-record with the wall-clock
+fields masked — and with the lineage kinds (``resume``/``crash``/
+``flight``) excluded, since only the resumed run legitimately carries
+them.  Exit 1 on any difference.
+
+Like the other operator tools this is stdlib-only: it must run on a
+box with neither jaxstream nor JAX installed.  The bundle-format
+constants and the trace epsilons are literal copies of the source
+(``jaxstream.obs.flight`` / ``jaxstream.obs.trace``); tests assert the
+copies stay identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+#: Literal copy of ``jaxstream.obs.flight.BUNDLE_MANIFEST``.
+BUNDLE_MANIFEST = "bundle.json"
+
+#: Literal copies of ``jaxstream.obs.trace`` span-contract epsilons.
+EPSILON_ABS_S = 0.05
+EPSILON_FRAC = 0.05
+
+#: Wall-clock fields masked by ``--diff`` (superset of the async-
+#: pipeline parity test's volatile list: span/latency stamps differ
+#: run-to-run too).
+VOLATILE_FIELDS = ("wall_s", "steps_per_sec", "sim_days_per_sec_per_chip",
+                   "host_wait_s", "created_unix", "latency_s",
+                   "start_s", "duration_s", "queue_depth")
+
+#: Record kinds only a resumed/crashed run carries — excluded from
+#: ``--diff`` so lineage stamps don't fail the parity they document.
+LINEAGE_KINDS = frozenset({"resume", "crash", "flight"})
+
+#: Exit code for a torn bundle (distinct from a plain mismatch).
+EXIT_TORN = 2
+
+
+class Torn(SystemExit):
+    """Torn-bundle rejection: SystemExit with the forensic message."""
+
+    def __init__(self, message: str):
+        print(f"TORN BUNDLE: {message}", file=sys.stderr)
+        super().__init__(EXIT_TORN)
+
+
+# ------------------------------------------------------------ verification
+def read_bundle(bundle_dir):
+    """Stdlib mirror of ``jaxstream.obs.flight.read_bundle`` — same
+    checks, same order; raises :class:`Torn` (exit 2) instead of
+    TornBundleError."""
+    mpath = os.path.join(bundle_dir, BUNDLE_MANIFEST)
+    if not os.path.exists(mpath):
+        raise Torn(f"{bundle_dir}: no {BUNDLE_MANIFEST} — the bundle "
+                   "was never committed (killed before the os.replace "
+                   "commit point?)")
+    try:
+        with open(mpath, "rb") as fh:
+            manifest = json.loads(fh.read().decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise Torn(f"{mpath}: manifest is not JSON ({e})")
+    for key in ("bundle_id", "events_file", "n_events", "events_sha256"):
+        if key not in manifest:
+            raise Torn(f"{mpath}: manifest is missing {key!r}")
+    epath = os.path.join(bundle_dir, manifest["events_file"])
+    if not os.path.exists(epath):
+        raise Torn(f"{bundle_dir}: manifest names "
+                   f"{manifest['events_file']} but the file is gone")
+    with open(epath, "rb") as fh:
+        payload = fh.read()
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != manifest["events_sha256"]:
+        raise Torn(f"{epath}: sha256 {digest[:12]}… does not match the "
+                   f"manifest's {manifest['events_sha256'][:12]}… — "
+                   "the events file is torn or tampered")
+    lines = [ln for ln in payload.decode("utf-8").split("\n") if ln]
+    if len(lines) != manifest["n_events"]:
+        raise Torn(f"{epath}: {len(lines)} events on disk, manifest "
+                   f"promises {manifest['n_events']}")
+    events = []
+    for i, ln in enumerate(lines):
+        try:
+            events.append(json.loads(ln))
+        except ValueError as e:
+            raise Torn(f"{epath}:{i + 1}: event is not JSON ({e})")
+    return manifest, events
+
+
+def load_sinks(paths):
+    records = []
+    for path in paths:
+        with open(path) as fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise SystemExit(f"{path}:{i + 1}: not JSON ({e})")
+    return records
+
+
+# ------------------------------------------------------------- cross-check
+def span_check(records):
+    """Root-vs-leaf-sum verification over every completed span tree:
+    ``{checked, ok, mismatches: [...]}`` or None when the sinks carry
+    no spans.  The contract is the trace module's: |root - leaf_sum|
+    <= max(EPSILON_ABS_S, EPSILON_FRAC * root)."""
+    by_id = {}
+    for rec in records:
+        if rec.get("kind") == "span":
+            by_id.setdefault(rec["id"], []).append(rec)
+    if not by_id:
+        return None
+    checked = ok = 0
+    mismatches = []
+    for rid, spans in sorted(by_id.items()):
+        root = next((s for s in spans if s.get("parent_id") is None),
+                    None)
+        leaves = [s for s in spans if s.get("parent_id") is not None]
+        if root is None or not leaves:
+            continue                 # shed terminal / incomplete tree
+        checked += 1
+        root_s = float(root.get("duration_s", 0.0))
+        leaf_sum = sum(float(s.get("duration_s", 0.0)) for s in leaves)
+        tol = max(EPSILON_ABS_S, EPSILON_FRAC * root_s)
+        if abs(root_s - leaf_sum) <= tol:
+            ok += 1
+        else:
+            mismatches.append({
+                "id": rid, "trace_id": root.get("trace_id"),
+                "root_s": round(root_s, 6),
+                "leaf_sum_s": round(leaf_sum, 6),
+                "tolerance_s": round(tol, 6),
+            })
+    return {"checked": checked, "ok": ok, "mismatches": mismatches}
+
+
+# --------------------------------------------------------------- timeline
+def build_report(manifest, events, sink_records, last=40):
+    t_death = manifest.get("wall_time") or (
+        events[-1]["t"] if events else 0.0)
+    open_reqs = manifest.get("open_requests") or {}
+    incidents = [r for r in sink_records
+                 if r.get("kind") in ("guard", "crash", "resume",
+                                      "autoscale")]
+    by_type = {}
+    for e in events:
+        by_type[e.get("type", "?")] = by_type.get(e.get("type", "?"),
+                                                  0) + 1
+    return {
+        "bundle_id": manifest["bundle_id"],
+        "reason": manifest.get("reason"),
+        "wall_time": manifest.get("wall_time"),
+        "commit": manifest.get("commit"),
+        "n_events": manifest["n_events"],
+        "dropped_events": manifest.get("dropped_events", 0),
+        "threads": manifest.get("threads") or {},
+        "events_by_type": by_type,
+        "checkpoint": manifest.get("checkpoint"),
+        "device_memory": manifest.get("device_memory"),
+        "open_requests": open_reqs,
+        "n_open": (len(open_reqs.get("queued", []))
+                   + len(open_reqs.get("in_flight", []))),
+        "timeline": [
+            dict(e, dt_s=round(e["t"] - t_death, 3))
+            for e in events[-last:]],
+        "incidents": incidents,
+        "span_check": span_check(sink_records),
+    }
+
+
+def print_report(r):
+    when = (time.strftime("%Y-%m-%d %H:%M:%S",
+                          time.localtime(r["wall_time"]))
+            if r.get("wall_time") else "?")
+    print(f"crash bundle {r['bundle_id']}  (commit {r['commit']}, "
+          f"{when})")
+    print(f"  reason: {r['reason']}")
+    print(f"  ring: {r['n_events']} events across "
+          f"{len(r['threads'])} thread(s)"
+          + (f", {r['dropped_events']} DROPPED (ring wrapped)"
+             if r["dropped_events"] else ""))
+    for thread, n in sorted(r["threads"].items()):
+        print(f"    {thread}: {n} appended")
+    if r["events_by_type"]:
+        tops = sorted(r["events_by_type"].items(),
+                      key=lambda kv: -kv[1])
+        print("  event mix: " + ", ".join(
+            f"{t} x{n}" for t, n in tops))
+    ck = r.get("checkpoint")
+    print(f"  last checkpoint: step {ck['step']} at {ck['path']}"
+          if ck else "  last checkpoint: none")
+    mem = r.get("device_memory")
+    if mem:
+        print(f"  device memory: {mem}")
+
+    print(f"\nin flight at death ({r['n_open']} open request(s)):")
+    oreq = r["open_requests"]
+    for section in ("in_flight", "queued"):
+        rows = oreq.get(section, [])
+        print(f"  {section} ({len(rows)}):")
+        for row in rows:
+            print(f"    {row['id']:<24} trace {row['trace_id']}")
+    if not r["n_open"]:
+        print("  (none — the process died idle)")
+
+    print(f"\ntimeline (last {len(r['timeline'])} events, "
+          "dt relative to death):")
+    for e in r["timeline"]:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("seq", "t", "thread", "type", "dt_s")}
+        detail = (" " + " ".join(f"{k}={v}"
+                                 for k, v in sorted(extra.items()))
+                  if extra else "")
+        print(f"  {e['dt_s']:>9.3f}s  [{e['thread']}] "
+              f"{e['type']}{detail}")
+
+    if r["incidents"]:
+        print(f"\nsink incident records ({len(r['incidents'])}):")
+        for rec in r["incidents"]:
+            kind = rec.get("kind")
+            if kind == "guard":
+                print(f"  guard: {rec.get('event')} at step "
+                      f"{rec.get('step')} (value {rec.get('value')})")
+            elif kind == "crash":
+                print(f"  crash: bundle {rec.get('bundle')} "
+                      f"({rec.get('reason')}) at {rec.get('path')}")
+            elif kind == "resume":
+                print(f"  resume: from bundle {rec.get('bundle')} at "
+                      f"checkpoint step {rec.get('checkpoint_step')}")
+            else:
+                print(f"  autoscale: {rec.get('from_bucket')} -> "
+                      f"{rec.get('to_bucket')} "
+                      f"({rec.get('reason')})")
+
+    sc = r.get("span_check")
+    if sc is not None:
+        print(f"\ntrace cross-check: {sc['ok']}/{sc['checked']} span "
+              "trees tile their root latency")
+        for m in sc["mismatches"]:
+            print(f"  !! {m['id']}: root {m['root_s']}s vs leaf sum "
+                  f"{m['leaf_sum_s']}s (tol {m['tolerance_s']}s)")
+
+
+# ------------------------------------------------------------------- diff
+def _masked_records(path):
+    out = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i + 1}: not JSON ({e})")
+            if rec.get("kind") in LINEAGE_KINDS:
+                continue
+            out.append({k: v for k, v in rec.items()
+                        if k not in VOLATILE_FIELDS})
+    return out
+
+
+def _walk(root):
+    out = {}
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            p = os.path.join(dirpath, name)
+            out[os.path.relpath(p, root)] = p
+    return out
+
+
+def diff_runs(dir_a, dir_b) -> int:
+    """Round-5-standard comparison of two run output directories;
+    prints each difference, returns the number found."""
+    fa, fb = _walk(dir_a), _walk(dir_b)
+    problems = 0
+    for rel in sorted(set(fa) | set(fb)):
+        if rel not in fa or rel not in fb:
+            print(f"DIFF {rel}: only in "
+                  f"{dir_a if rel in fa else dir_b} (missing from "
+                  f"{dir_b if rel in fa else dir_a})")
+            problems += 1
+            continue
+        if rel.endswith(".jsonl"):
+            ra, rb = _masked_records(fa[rel]), _masked_records(fb[rel])
+            if ra != rb:
+                n = min(len(ra), len(rb))
+                at = next((i for i in range(n) if ra[i] != rb[i]), n)
+                print(f"DIFF {rel}: record {at} differs "
+                      f"({len(ra)} vs {len(rb)} records after "
+                      "masking)")
+                problems += 1
+        else:
+            with open(fa[rel], "rb") as f1, open(fb[rel], "rb") as f2:
+                if f1.read() != f2.read():
+                    print(f"DIFF {rel}: bytes differ")
+                    problems += 1
+    if not problems:
+        print(f"OK: {len(fa)} files equal to the round-5 standard "
+              "(bytes; JSONL modulo wall-clock fields and lineage "
+              "records)")
+    return problems
+
+
+# ------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Reconstruct an incident timeline from a flight-"
+                    "recorder crash bundle (+ sink files), or --diff "
+                    "two run directories.")
+    ap.add_argument("bundle", nargs="?", default="",
+                    help="crash-bundle directory (or a flight dir — "
+                         "the newest committed bundle inside is used)")
+    ap.add_argument("--sink", action="append", default=[],
+                    help="telemetry JSONL to merge into the postmortem "
+                         "(repeatable: serve + gateway + simulation "
+                         "sinks)")
+    ap.add_argument("--last", type=int, default=40,
+                    help="timeline events to render (default 40)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--diff", nargs=2, metavar=("DIR_A", "DIR_B"),
+                    help="compare a resumed run's output directory "
+                         "against an uninterrupted reference")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        return 1 if diff_runs(*args.diff) else 0
+    if not args.bundle:
+        ap.error("BUNDLE_DIR required (or --diff DIR_A DIR_B)")
+
+    bdir = args.bundle
+    if not os.path.exists(os.path.join(bdir, BUNDLE_MANIFEST)):
+        # Maybe a flight dir full of bundles: take the newest committed
+        # one — matching flight.latest_bundle's wall_time ordering.
+        best, best_key = None, None
+        if os.path.isdir(bdir):
+            for name in sorted(os.listdir(bdir)):
+                mpath = os.path.join(bdir, name, BUNDLE_MANIFEST)
+                if not os.path.isfile(mpath):
+                    continue
+                try:
+                    with open(mpath) as fh:
+                        m = json.load(fh)
+                except (OSError, ValueError):
+                    continue
+                key = (m.get("wall_time", 0.0), m.get("commit", 0))
+                if best_key is None or key > best_key:
+                    best, best_key = os.path.join(bdir, name), key
+        if best is None:
+            raise Torn(f"{bdir}: no committed bundle found")
+        bdir = best
+
+    manifest, events = read_bundle(bdir)
+    sink_records = load_sinks(args.sink)
+    report = build_report(manifest, events, sink_records,
+                          last=args.last)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print_report(report)
+    sc = report["span_check"]
+    return 1 if (sc is not None and sc["mismatches"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,10 +30,14 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _signals  # noqa: E402 — shared CLI signal-drain helper
 
 
 def load_requests(path: str):
@@ -69,25 +73,76 @@ def main(argv=None) -> int:
                     help="comma-separated batching groups to pre-"
                          "compile before admitting traffic "
                          "(e.g. 'flat,oro')")
+    ap.add_argument("--flight-dir", default="",
+                    help="flight-recorder crash-bundle directory "
+                         "(default: '<serve.sink>.flight' when a sink "
+                         "is configured, else off)")
     args = ap.parse_args(argv)
+
+    import dataclasses
 
     import numpy as np
 
     from jaxstream.config import load_config
-    from jaxstream.serve import serve_requests
+    from jaxstream.serve import EnsembleServer
+    from jaxstream.serve.queue import QueueFull, ServerDraining
 
     cfg = load_config(args.config)
     if args.output_dir:
-        import dataclasses
-
         cfg = dataclasses.replace(
             cfg, serve=dataclasses.replace(cfg.serve,
                                            output_dir=args.output_dir))
+    # The black box: explicit --flight-dir wins; with a serve sink
+    # configured the bundle lands next to it, so crash forensics are
+    # on whenever telemetry is.
+    flight_dir = args.flight_dir or (
+        cfg.serve.sink + ".flight" if cfg.serve.sink else "")
+    if flight_dir:
+        cfg = dataclasses.replace(
+            cfg, observability=dataclasses.replace(
+                cfg.observability, flight_dir=flight_dir))
     reqs = load_requests(args.requests)
     warm = tuple(g.strip() for g in args.warm.split(",") if g.strip())
 
+    # The server is built HERE (not via serve_requests) so the signal
+    # handler can reach it: SIGTERM/SIGINT dump the flight bundle and
+    # begin the graceful drain, and the summary still prints.
+    stop = threading.Event()
+    server = EnsembleServer(cfg)
+
+    def _drain(signame: str) -> None:
+        server.flight_dump(reason=f"signal:{signame}")
+        server.begin_drain()
+
+    _signals.install_drain_handlers(stop, _drain, name="serve")
+
     wall0 = time.perf_counter()
-    server = serve_requests(cfg, reqs, warm_groups=warm or None)
+    unsubmitted = 0
+    try:
+        if warm:
+            server.warmup(groups=warm)
+        pending = list(reqs)
+        while pending and not stop.is_set():
+            # Admit what fits, serve a batch, repeat — producer-side
+            # backpressure without a second thread (the serve_requests
+            # loop, inlined for signal access).
+            while pending:
+                try:
+                    server.submit(pending[0])
+                except QueueFull:
+                    break
+                except ServerDraining:
+                    unsubmitted = len(pending)
+                    pending = []
+                    break
+                pending.pop(0)
+            req = server.queue.pop()
+            if req is not None:
+                server._run_batch(req)
+        unsubmitted += len(pending)
+        server.serve()
+    finally:
+        server.close()
     wall = time.perf_counter() - wall0
 
     lat = server.latencies()
@@ -131,6 +186,10 @@ def main(argv=None) -> int:
     memory = server.memory_snapshot()
     if memory is not None:
         summary["memory"] = memory
+    if flight_dir:
+        summary["flight_dir"] = flight_dir
+    if unsubmitted:
+        summary["unsubmitted"] = unsubmitted
     print(json.dumps(summary))
     return 0 if server.stats["evicted"] == 0 else 1
 

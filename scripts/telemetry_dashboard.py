@@ -76,6 +76,7 @@ _PHASE_COLOR = {"ingress": 90, "queue": 33, "pack": 35, "compute": 32,
 RENDERED_KINDS = frozenset({
     "manifest", "span", "serve", "segment", "guard", "autoscale",
     "gateway", "loadgen", "bench", "da", "memory", "perf",
+    "flight", "crash", "resume",
 })
 
 SPARK = "▁▂▃▄▅▆▇█"
@@ -150,6 +151,7 @@ class Dashboard:
         self.memory_unavailable = None  # typed no-allocator-stats note
         self.perf_stamps = {}           # plan -> latest 'perf' stamp
         self.outcomes = {}              # kind -> status -> count
+        self.incidents = []             # flight/crash/resume records
         self.unknown = {}               # kind -> count (loud footer)
         self.manifests = 0
 
@@ -216,6 +218,10 @@ class Dashboard:
             by[st] = by.get(st, 0) + 1
         elif kind == "manifest":
             self.manifests += 1
+        elif kind in ("flight", "crash", "resume"):
+            # Crash forensics (round 20): bundle dumps, crash stamps
+            # and resume-lineage records feed the incident panel.
+            self.incidents.append(rec)
         elif kind == "bench":
             pass                        # identity lines; not a panel
         else:
@@ -289,6 +295,7 @@ class Dashboard:
                       for k in sorted(self.perf_stamps)]
                      if self.perf_stamps else None),
             "outcomes": self.outcomes,
+            "incidents": self.incidents[-self.rows:],
             "unrendered_kinds": dict(sorted(self.unknown.items())),
         }
 
@@ -470,6 +477,30 @@ def render(frame, color=True):
                     f"{ev.get('queue_depth')}, {ev.get('reason')})")
     else:
         lines.append("  none")
+
+    if frame.get("incidents"):
+        lines.append("")
+        lines.append(_c("incidents (flight recorder / crash "
+                        "forensics):", 4, color))
+        for inc in frame["incidents"]:
+            kind = inc.get("kind")
+            if kind == "crash":
+                lines.append(_c(
+                    f"  CRASH bundle {inc.get('bundle')} "
+                    f"({inc.get('reason')}) -> {inc.get('path')}",
+                    31, color))
+            elif kind == "resume":
+                lines.append(_c(
+                    f"  resume from bundle {inc.get('bundle')} "
+                    f"@ checkpoint step {inc.get('checkpoint_step')} "
+                    f"(now at step {inc.get('step')})", 33, color))
+            else:                       # "flight" dump stamp
+                lines.append(
+                    f"  flight dump: {inc.get('events')} events, "
+                    f"{inc.get('threads')} thread ring(s), "
+                    f"{inc.get('dropped')} dropped")
+        lines.append(_c("  (reconstruct: python scripts/postmortem.py "
+                        "<bundle-dir> --sink <sink.jsonl>)", 90, color))
 
     if frame["unrendered_kinds"]:
         parts = ", ".join(f"{k} x{v}" for k, v in

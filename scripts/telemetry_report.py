@@ -72,6 +72,7 @@ PHASES = ("ingress", "queue", "pack", "compute", "host_wait",
 RENDERED_KINDS = frozenset({
     "manifest", "segment", "guard", "bench", "serve", "gateway",
     "loadgen", "autoscale", "span", "da", "memory", "perf",
+    "flight", "crash", "resume",
 })
 
 
@@ -423,13 +424,35 @@ def summarize(records):
     spans = phase_decomposition(spans_by_request(records))
     if serving is not None and spans is not None:
         serving["phase_latency"] = spans
+    # Round 20: crash forensics.  'crash' records point at the flight-
+    # recorder bundle a dying run committed, 'flight' records carry
+    # the ring-dump accounting, 'resume' records stamp the lineage a
+    # restarted run descends from — together they answer "did this
+    # deployment die, where is the black box, and who restarted from
+    # it" without leaving the report.
+    forensics = None
+    crashes = [r for r in records if r.get("kind") == "crash"]
+    flights = [r for r in records if r.get("kind") == "flight"]
+    resumes = [r for r in records if r.get("kind") == "resume"]
+    if crashes or flights or resumes:
+        forensics = {
+            "crashes": [{"bundle": c.get("bundle"),
+                         "path": c.get("path"),
+                         "reason": c.get("reason")} for c in crashes],
+            "dumps": [{"events": f.get("events"),
+                       "threads": f.get("threads"),
+                       "dropped": f.get("dropped")} for f in flights],
+            "resumes": [{"bundle": r.get("bundle"),
+                         "checkpoint_step": r.get("checkpoint_step"),
+                         "step": r.get("step")} for r in resumes],
+        }
     return {"manifest": manifest, "drift": drift, "timeline": timeline,
             "host_wait_total_s": host_wait_total,
             "guards": guards, "bench": benches, "serving": serving,
             "gateway": gateway, "loadgen": loadgen,
             "autoscale": autoscale, "spans": spans,
             "assimilation": assimilation,
-            "memory": memory, "perf": perf,
+            "memory": memory, "perf": perf, "forensics": forensics,
             "unrendered_kinds": dict(sorted(unrendered.items())),
             "n_segments": len(segments)}
 
@@ -599,6 +622,22 @@ def print_report(s):
             print(f"  bucket {ev['from_bucket']} -> {ev['to_bucket']} "
                   f"(queue {ev['queue_depth']}, occupancy "
                   f"{ev['occupancy']:.3f}, {ev['reason']})")
+
+    if s.get("forensics"):
+        fo = s["forensics"]
+        print("\ncrash forensics:")
+        for c in fo["crashes"]:
+            print(f"  crash: {c['reason']} -> bundle {c['bundle']} "
+                  f"at {c['path']}")
+        for f in fo["dumps"]:
+            print(f"  flight ring dumped: {f['events']} events, "
+                  f"{f['threads']} thread(s), {f['dropped']} dropped")
+        for r in fo["resumes"]:
+            print(f"  resume: step {r['step']} from checkpoint step "
+                  f"{r['checkpoint_step']} (lineage bundle "
+                  f"{r['bundle']})")
+        print("  postmortem: python scripts/postmortem.py <bundle> "
+              "--sink <this file>")
 
     if s["guards"]:
         print("\nguard events:")

@@ -466,6 +466,63 @@ def test_perf_obs_module_rules_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 0
 
 
+def test_flight_module_rules_detected(tmp_path):
+    """Rule 14 (round-20 satellite): flight-recorder/postmortem tests
+    stay non-slow and in-process, while hard-kill forensics tests must
+    ride the slow tier — a module importing jaxstream.obs.flight or
+    postmortem may not carry slow markers or launch subprocesses, and
+    a module that spawns subprocesses AND references a hard kill must
+    carry pytest.mark.slow."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Slow-marked flight module trips the lint (14a).
+    (tests / "test_f.py").write_text(
+        "import pytest\n"
+        "from jaxstream.obs.flight import FlightRecorder\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Subprocess USAGE in a postmortem-importing module trips it too.
+    (tests / "test_f.py").write_text(
+        "import subprocess\n"
+        "import postmortem\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', 'scripts/postmortem.py'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Unmarked, in-process flight module is clean — including the
+    # from-obs import form.
+    (tests / "test_f.py").write_text(
+        "from jaxstream.obs import flight\n"
+        "def test_a():\n    flight.RECORDER.dump()\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # The hard-kill half (14b): subprocess + SIGKILL without slow
+    # trips (concatenated so THIS module's own marker set is not
+    # what keeps it clean).
+    (tests / "test_k.py").write_text(
+        "import signal, subprocess, sys\n"
+        "def test_a():\n"
+        "    p = subprocess.Popen([sys.executable, 'scripts/serve.py'])\n"
+        "    p.send_signal(signal.SIGK" + "ILL)\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # ...and the .kill( spelling is caught too.
+    (tests / "test_k.py").write_text(
+        "import subprocess, sys\n"
+        "def test_a():\n"
+        "    p = subprocess.Popen([sys.executable, 'scripts/serve.py'])\n"
+        "    p.ki" + "ll()\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module slow-marked is clean.
+    (tests / "test_k.py").write_text(
+        "import pytest, signal, subprocess, sys\n"
+        "pytestmark = pytest." + "mark.slow\n"
+        "def test_a():\n"
+        "    p = subprocess.Popen([sys.executable, 'scripts/serve.py'])\n"
+        "    p.send_signal(signal.SIGK" + "ILL)\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_sink_kind_rendering_drift_detected(tmp_path):
     """Rule 13b: a sink kind registered in RECORD_KINDS but missing
     from either operator tool's RENDERED_KINDS fails the gate (the

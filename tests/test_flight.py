@@ -1,0 +1,281 @@
+"""Flight recorder + atomic crash bundles (round-20 tentpole).
+
+The black-box acceptance criteria, as tests:
+  * the bounded per-thread rings merge into ONE sequence-ordered
+    timeline at dump time, and ring overflow is counted loudly;
+  * a committed bundle round-trips through ``read_bundle``; a live
+    re-commit replaces the (events, manifest) pair atomically and
+    unlinks the stale events file;
+  * every torn-bundle shape — truncated events, missing manifest,
+    non-JSON manifest, missing events file — raises
+    ``TornBundleError`` (the seeded ``torn_bundle`` fixture keeps the
+    reader's teeth, same pattern as the schedule fixtures);
+  * the recorder is ALWAYS ON and writes nothing to any sink in
+    steady state: a recorder-on run's history files are byte-identical
+    to a recorder-off run's, telemetry equal modulo wall-clock fields;
+  * a ``HealthError`` under a configured ``observability.flight_dir``
+    commits a readable bundle with the postmortem checkpoint pointer
+    and stamps typed ``flight``/``crash`` sink records.
+
+This module imports ``jaxstream.obs.flight`` and therefore must stay
+tier-1 and in-process (scripts/check_tiers.py rule 14): no slow
+markers, no child processes here (the SIGKILL capstone lives in
+tests/test_flight_kill.py, which reads the bundle JSON directly).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from jaxstream.analysis import fixtures
+from jaxstream.obs import flight
+from jaxstream.obs.monitor import HealthError
+from jaxstream.obs.sink import RECORD_KINDS, read_records
+from jaxstream.simulation import Simulation
+
+#: Telemetry fields that legitimately differ run-to-run (wall clock).
+_VOLATILE = ("wall_s", "steps_per_sec", "sim_days_per_sec_per_chip",
+             "host_wait_s", "created_unix")
+
+
+# ------------------------------------------------------------------ ring
+def test_ring_merges_threads_in_sequence_order():
+    rec = flight.FlightRecorder()
+    rec.record("segment", step=2, k=2)
+
+    def worker():
+        rec.record("queue.admit", id="r0", depth=1)
+
+    t = threading.Thread(target=worker, name="other")
+    t.start()
+    t.join()
+    rec.record("segment", step=4, k=2)
+    events, appended, dropped = rec.dump()
+    assert [e["seq"] for e in events] == sorted(e["seq"] for e in events)
+    assert [e["type"] for e in events] == ["segment", "queue.admit",
+                                           "segment"]
+    assert events[1]["thread"] == "other"
+    assert events[1]["id"] == "r0"
+    assert sum(appended.values()) == 3 and dropped == 0
+
+
+def test_ring_overflow_counts_drops():
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("tick", i=i)
+    events, appended, dropped = rec.dump()
+    assert len(events) == 4
+    assert [e["i"] for e in events] == [6, 7, 8, 9]   # oldest fell off
+    assert appended[threading.current_thread().name] == 10
+    assert dropped == 6
+
+
+def test_disabled_context_and_clear():
+    rec = flight.FlightRecorder()
+    rec.record("a")
+    with rec.disabled():
+        rec.record("b")
+    rec.record("c")
+    events, _, _ = rec.dump()
+    assert [e["type"] for e in events] == ["a", "c"]
+    rec.clear()
+    assert rec.dump() == ([], {threading.current_thread().name: 0}, 0)
+
+
+# --------------------------------------------------------------- bundles
+def test_bundle_roundtrip_and_atomic_recommit(tmp_path):
+    rec = flight.FlightRecorder()
+    rec.record("queue.admit", id="r0", depth=1)
+    w = flight.BundleWriter(str(tmp_path), bundle_id="fb-test",
+                            recorder=rec)
+    m1 = w.commit("unit", config={"grid_n": 8},
+                  open_requests=flight.open_request_manifest(
+                      ["r1"], ["r0"]),
+                  checkpoint={"step": 4, "path": "/ckpt"})
+    manifest, events = flight.read_bundle(w.path)
+    assert manifest["bundle_id"] == "fb-test"
+    assert manifest["commit"] == 1 and manifest["n_events"] == 1
+    assert events[0]["type"] == "queue.admit" and events[0]["id"] == "r0"
+    assert manifest["config"] == {"grid_n": 8}
+    assert manifest["checkpoint"] == {"step": 4, "path": "/ckpt"}
+    # The deterministic trace ids ride the open-request manifest even
+    # with tracing off (pure digest of the request id).
+    from jaxstream.obs.trace import trace_id_for
+
+    assert manifest["open_requests"]["in_flight"] == [
+        {"id": "r0", "trace_id": trace_id_for("r0")}]
+    assert manifest["open_requests"]["queued"][0]["id"] == "r1"
+
+    # Live re-commit: new events file, manifest repointed, stale file
+    # unlinked — the on-disk pair is always consistent.
+    rec.record("serve.boundary", bucket=2)
+    m2 = w.commit("unit")
+    assert m2["commit"] == 2 and m2["events_file"] != m1["events_file"]
+    manifest, events = flight.read_bundle(w.path)
+    assert manifest["n_events"] == 2
+    assert [e["type"] for e in events] == ["queue.admit",
+                                           "serve.boundary"]
+    names = [n for n in os.listdir(w.path) if n.startswith("events-")]
+    assert names == [m2["events_file"]]
+
+
+def test_torn_bundle_shapes_all_rejected(tmp_path):
+    rec = flight.FlightRecorder()
+    rec.record("tick")
+    w = flight.BundleWriter(str(tmp_path), bundle_id="fb-torn",
+                            recorder=rec)
+    m = w.commit("unit")
+    epath = os.path.join(w.path, m["events_file"])
+    mpath = os.path.join(w.path, flight.BUNDLE_MANIFEST)
+
+    # Truncated events file: digest mismatch.
+    payload = open(epath, "rb").read()
+    with open(epath, "wb") as fh:
+        fh.write(payload[: len(payload) // 2])
+    with pytest.raises(flight.TornBundleError, match="sha256"):
+        flight.read_bundle(w.path)
+    with open(epath, "wb") as fh:
+        fh.write(payload)
+    flight.read_bundle(w.path)               # restored: reads clean
+
+    # Missing events file.
+    os.unlink(epath)
+    with pytest.raises(flight.TornBundleError, match="gone"):
+        flight.read_bundle(w.path)
+    with open(epath, "wb") as fh:
+        fh.write(payload)
+
+    # Manifest not JSON (killed mid-write would never land this — the
+    # tmp+replace makes it old-or-new — but tampering must still fail).
+    with open(mpath, "wb") as fh:
+        fh.write(b"{not json")
+    with pytest.raises(flight.TornBundleError, match="not JSON"):
+        flight.read_bundle(w.path)
+
+    # No manifest at all: never committed.
+    os.unlink(mpath)
+    with pytest.raises(flight.TornBundleError, match="never"):
+        flight.read_bundle(w.path)
+    assert flight.latest_bundle(str(tmp_path)) is None
+
+
+def test_latest_bundle_orders_by_manifest_stamp(tmp_path):
+    rec = flight.FlightRecorder()
+    a = flight.BundleWriter(str(tmp_path), "fb-a", recorder=rec)
+    b = flight.BundleWriter(str(tmp_path), "fb-b", recorder=rec)
+    a.commit("unit")
+    b.commit("unit")
+    # Ordering is by the manifests' own wall_time stamps, not dir names
+    # (directory mtimes lie across copies) — pin them explicitly.
+    for bdir, wall in ((a.path, 200.0), (b.path, 100.0)):
+        mpath = os.path.join(bdir, flight.BUNDLE_MANIFEST)
+        m = json.load(open(mpath))
+        m["wall_time"] = wall
+        with open(mpath, "w") as fh:
+            json.dump(m, fh)
+    assert flight.latest_bundle(str(tmp_path)) == a.path
+    assert flight.latest_bundle(str(tmp_path / "nope")) is None
+
+
+def test_fixture_torn_bundle_fails_loudly():
+    """The seeded-broken fixture (satellite): the reader MUST reject
+    the truncated bundle; a clean report means the sha256
+    re-verification lost its teeth (the CLI --fixture loop in
+    tests/test_analysis.py asserts exit 1 on the same corpus)."""
+    assert "torn_bundle" in fixtures.FIXTURES
+    rep = fixtures.run_fixture("torn_bundle")
+    assert not rep.passed
+    assert {v.check for v in rep.violations} == {"flight.read_bundle"}
+    assert any("sha256" in v.detail for v in rep.violations)
+
+
+# ------------------------------------------- sink byte-identity (always-on)
+def _sim_cfg(d, **obs_over):
+    obs = {"interval": 1, "sink": str(d / "telemetry.jsonl"),
+           "guards": "warn"}
+    obs.update(obs_over)
+    return {
+        "grid": {"n": 12, "halo": 2, "dtype": "float64"},
+        "model": {"initial_condition": "tc2"},
+        "time": {"dt": 600.0, "nsteps": 6},
+        "parallelization": {"num_devices": 1},
+        "io": {"history_path": str(d / "hist"), "history_stride": 2,
+               "checkpoint_path": str(d / "ckpt"),
+               "checkpoint_stride": 3},
+        "observability": obs,
+    }
+
+
+def test_recorder_on_leaves_sinks_byte_identical(tmp_path):
+    """The always-on claim: with no flight_dir configured the recorder
+    rides every run and changes NOTHING on disk — history stores are
+    byte-for-byte identical and telemetry records equal modulo the
+    wall-clock fields, recorder-on vs flight.disabled()."""
+    don, doff = tmp_path / "on", tmp_path / "off"
+    don.mkdir(), doff.mkdir()
+    flight.RECORDER.clear()
+    with Simulation(_sim_cfg(don)) as sim:
+        sim.run()
+    events, _, _ = flight.RECORDER.dump()
+    assert any(e["type"] == "segment" for e in events)      # it recorded
+    with flight.disabled():
+        with Simulation(_sim_cfg(doff)) as sim:
+            sim.run()
+
+    hist_on, hist_off = {}, {}
+    for root, out in ((don, hist_on), (doff, hist_off)):
+        for dirpath, _, names in os.walk(str(root / "hist")):
+            for f in names:
+                p = os.path.join(dirpath, f)
+                out[os.path.relpath(p, str(root))] = open(p, "rb").read()
+    assert hist_on and set(hist_on) == set(hist_off)
+    for rel in hist_on:
+        assert hist_on[rel] == hist_off[rel], f"{rel} differs"
+
+    def masked(d):
+        return [{k: v for k, v in r.items() if k not in _VOLATILE}
+                for r in read_records(str(d / "telemetry.jsonl"))]
+
+    recs_on = masked(don)
+    assert recs_on == masked(doff)
+    # ...and no forensic kinds leaked into a healthy run's sink.
+    assert not [r for r in recs_on
+                if r["kind"] in ("flight", "crash", "resume")]
+
+
+def test_healtherror_commits_bundle_and_sink_stamps(tmp_path):
+    """HealthError -> atomic bundle under observability.flight_dir
+    with the postmortem checkpoint pointer, plus typed flight/crash
+    records in the ordinary sink (both registered kinds)."""
+    assert {"flight", "crash", "resume"} <= set(RECORD_KINDS)
+    fdir = str(tmp_path / "black")
+    cfg = _sim_cfg(tmp_path, guards="checkpoint_and_raise",
+                   fault_step=4, flight_dir=fdir)
+    sim = Simulation(cfg)
+    with pytest.raises(HealthError):
+        sim.run()
+    sim.close()
+    bdir = flight.latest_bundle(fdir)
+    assert bdir is not None
+    manifest, events = flight.read_bundle(bdir)
+    assert manifest["reason"] == "HealthError"
+    assert manifest["config"]["grid_n"] == 12
+    assert manifest["config"]["guards"] == "checkpoint_and_raise"
+    # The postmortem checkpoint: valid state (the fault poisons only
+    # the metric stream), at or past the breach step.
+    assert manifest["checkpoint"]["step"] >= 3
+    assert any(e["type"] == "guard" and e["event"] == "nan"
+               for e in events)
+    recs = read_records(str(tmp_path / "telemetry.jsonl"))
+    crash = [r for r in recs if r["kind"] == "crash"]
+    assert len(crash) == 1
+    assert crash[0]["bundle"] == manifest["bundle_id"]
+    assert crash[0]["path"] == bdir
+    assert crash[0]["reason"] == "HealthError"
+    fl = [r for r in recs if r["kind"] == "flight"]
+    assert len(fl) == 1 and fl[0]["events"] >= len(events)
+    # The state the checkpoint froze really is finite.
+    assert np.all(np.isfinite(np.asarray(sim.state["h"])))
