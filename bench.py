@@ -2130,6 +2130,117 @@ def bench_flight_overhead(n=12, dt=600.0, k=4, windows=12, repeats=9):
         return {"skipped": f"{type(e).__name__}: {e}"}
 
 
+def bench_cold_start(n=8, dt=600.0, buckets="1,2", seg=2, gates=True):
+    """Round-21 warm-pool satellite: the compile tax, measured.
+
+    Three arms over one tiny serving config (C{n}, buckets {buckets}):
+    a COLD server (no pool — every bucket pays jit), an untimed
+    POPULATE pass (pool on, fresh dir — pays the saves), then a WARM
+    server restarted against the populated pool.  Stamps server
+    cold-start-to-first-result and resize-to-new-bucket wall seconds
+    for the cold and warm arms plus their ratios — the numbers the
+    perf ledger tracks as ``cold_start:warm_speedup`` /
+    ``cold_start:resize_speedup``.
+
+    Gates (acceptance criteria, enforced on every image incl. smoke —
+    the margins are ~5x on CPU): both speedups >= 3x, the warm path
+    performs ZERO XLA compiles (``compile_count``), and the
+    warm-loaded first-segment result byte-equals the fresh-compiled
+    one.  Never raises (returns ``{"skipped": ...}``).
+    """
+    import shutil
+    import tempfile
+
+    try:
+        import jax
+
+        from jaxstream.serve import EnsembleServer, ScenarioRequest
+
+        blist = sorted({int(b) for b in str(buckets).split(",")})
+        b_hi = blist[-1]
+        base = {"grid": {"n": n}, "time": {"dt": dt},
+                "model": {"name": "shallow_water_cov"},
+                "serve": {"buckets": buckets, "segment_steps": seg}}
+
+        def arm(pool_dir):
+            cfg = json.loads(json.dumps(base))
+            if pool_dir:
+                cfg["serve"]["warm_pool"] = pool_dir
+            # Each arm starts from an empty jit cache: the cold arm
+            # must actually compile even though earlier bench sections
+            # warmed similar programs in this process.
+            jax.clear_caches()
+            t0 = time.perf_counter()
+            srv = EnsembleServer(cfg)
+            srv.submit(ScenarioRequest(id="r0", ic="tc2", nsteps=seg))
+            res = srv.serve()
+            first_s = time.perf_counter() - t0
+            h = np.asarray(res["r0"].fields["h"])
+            t0 = time.perf_counter()
+            srv._bucket("any", b_hi)
+            resize_s = time.perf_counter() - t0
+            out = (first_s, resize_s, h, srv.compile_count(),
+                   srv.warmpool_summary())
+            srv.close()
+            return out
+
+        pdir = tempfile.mkdtemp(prefix="jaxstream_warmpool_")
+        try:
+            cold_first, cold_resize, h_cold, _, _ = arm(None)
+            arm(pdir)                      # populate (untimed)
+            (warm_first, warm_resize, h_warm, warm_compiles,
+             pool) = arm(pdir)
+        finally:
+            shutil.rmtree(pdir, ignore_errors=True)
+
+        warm_speedup = cold_first / warm_first if warm_first else 0.0
+        resize_speedup = (cold_resize / warm_resize
+                          if warm_resize else 0.0)
+        byte_equal = h_cold.tobytes() == h_warm.tobytes()
+        failures = []
+        if gates:
+            if warm_speedup < 3.0:
+                failures.append(
+                    f"cold-start speedup {warm_speedup:.2f}x < 3x")
+            if resize_speedup < 3.0:
+                failures.append(
+                    f"resize speedup {resize_speedup:.2f}x < 3x")
+            if warm_compiles != 0:
+                failures.append(
+                    f"warm path performed {warm_compiles} XLA "
+                    "compiles (expected 0)")
+            if not byte_equal:
+                failures.append(
+                    "warm-loaded first segment != fresh-compiled")
+        out = {
+            "cold_first_result_s": round(cold_first, 3),
+            "warm_first_result_s": round(warm_first, 3),
+            "warm_speedup": round(warm_speedup, 2),
+            "cold_resize_s": round(cold_resize, 3),
+            "warm_resize_s": round(warm_resize, 3),
+            "resize_speedup": round(resize_speedup, 2),
+            "warm_compiles": warm_compiles,
+            "byte_equal": bool(byte_equal),
+            "hits": pool["hits"] if pool else 0,
+            "misses": pool["misses"] if pool else 0,
+            "rungs": pool["rungs"] if pool else {},
+            "n": n, "buckets": buckets, "segment_steps": seg,
+            "ok": not failures,
+        }
+        if failures:
+            out["failures"] = failures
+        log(f"bench cold start: first result {cold_first:.2f}s cold / "
+            f"{warm_first:.2f}s warm ({warm_speedup:.1f}x), resize "
+            f"{cold_resize:.2f}s cold / {warm_resize:.2f}s warm "
+            f"({resize_speedup:.1f}x), warm compiles {warm_compiles}, "
+            f"byte_equal {byte_equal}"
+            + (f" — FAILED: {'; '.join(failures)}" if failures else ""))
+        return out
+    except Exception as e:  # never fail the headline metric on this
+        log(f"bench cold start: unavailable ({type(e).__name__}: {e})")
+        return {"skipped": f"{type(e).__name__}: {e}"}
+
+
 def bench_smoke(n=24, dt=600.0, telemetry=""):
     """``--smoke``: C24, a handful of steps, NO accuracy gates.
 
@@ -2238,6 +2349,16 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
     # behind the always-on claim (< 3%, asserted by
     # tests/test_bench_smoke.py).
     flight_overhead = bench_flight_overhead(n=12, dt=dt)
+    # Warm-pool cold-start canary (round 21): cold vs populated-pool
+    # server start and resize-to-new-bucket through the REAL
+    # bench_cold_start code path at C8.  The >= 3x speedup, the
+    # zero-warm-compiles proof and the byte-equality parity gate ARE
+    # enforced (the margins are ~5x even on CPU); asserted by
+    # tests/test_bench_smoke.py.  Runs LAST among the jax sections:
+    # its arms call jax.clear_caches(), which must not cool any other
+    # section's warm executables.
+    cold_start = bench_cold_start(n=8, dt=dt, buckets="1,2", seg=2,
+                                  gates=True)
     b1 = ens.get("B1", {})
     ok = isinstance(b1, dict) and b1.get("sim_days_per_sec", 0.0) > 0.0
     rec = {
@@ -2258,6 +2379,7 @@ def bench_smoke(n=24, dt=600.0, telemetry=""):
         "contract_check": contract,
         "perf": perf,
         "flight_overhead": flight_overhead,
+        "cold_start": cold_start,
         "wall_s": round(time.perf_counter() - t0, 1),
     }
     rec["perf_ledger"] = bench_perf_ledger(rec)
@@ -2507,6 +2629,13 @@ def main():
     # emit it top-level, with the dt=60-equivalent rate adjacent, so
     # cross-round comparisons of `value` are self-describing.
     dt60 = variants.pop("dt60_equivalent", round(value * 60.0 / BENCH_DT, 4))
+    # Warm-pool cold start (round 21): cold vs populated-pool server
+    # start-to-first-result and resize-to-new-bucket, with the >= 3x
+    # speedup / zero-warm-compiles / byte-equality gates enforced.
+    # Runs LAST among the jax sections: its arms clear the jit caches,
+    # which must not cool any timed executable above.
+    cold_start = bench_cold_start(n=8, dt=600.0, buckets="1,2", seg=2,
+                                  gates=True)
     sink = _open_telemetry(telemetry)
     if sink is not None:
         sink.write({"kind": "bench",
@@ -2572,6 +2701,13 @@ def main():
                     serving_slo.get("meets_goodput_floor"),
                 "meets_p99_floor":
                     serving_slo.get("meets_p99_floor")})
+        if isinstance(cold_start, dict) and "warm_speedup" in cold_start:
+            sink.write({"kind": "bench", "metric": "cold_start",
+                        "value": cold_start["warm_speedup"],
+                        "unit": "warm-over-cold start speedup (x)",
+                        "resize_speedup": cold_start["resize_speedup"],
+                        "warm_compiles": cold_start["warm_compiles"],
+                        "byte_equal": cold_start["byte_equal"]})
         sink.close()
     record = {
         "metric": "sim_days_per_sec_per_chip_TC5_C384",
@@ -2593,6 +2729,7 @@ def main():
         "multichip": multichip,
         "contract_check": contract,
         "perf": perf,
+        "cold_start": cold_start,
     }
     # The regression-ledger stamp gates THIS record against the
     # recorded BENCH_r*.json trajectory (enforced on accelerator

@@ -346,6 +346,37 @@ class ServeConfig:
     # and required by placement mode 'panel' (the shard_map stepper
     # bakes orography per device).
     group_by_orography: bool = False
+    # Round 21 (warm pools): directory of disk-backed serialized bucket
+    # executables (jaxstream.serve.warmpool).  A restarted or freshly
+    # spawned server LOADS its masked-segment executables from here
+    # instead of recompiling — the degradation ladder is full AOT
+    # executable -> serialized StableHLO -> persistent compile cache ->
+    # cold compile, every rung a typed 'warmpool' sink record.  '' =
+    # off (byte-identical warmup to round 20).
+    warm_pool: str = ""
+    # Round 21: jax persistent-compilation-cache directory, the warm
+    # pool's third rung.  Gated behind a SUBPROCESS feature probe:
+    # this image's jaxlib 0.4.37 is documented to segfault when a
+    # different process deserializes CPU cache entries (the jax_compat
+    # quarantine note), so the rung only engages after a child-process
+    # write+read probe exits clean.  '' = rung disabled.
+    compile_cache: str = ""
+    # Round 21: background speculative compilation of ADJACENT plans
+    # (the next configured bucket up/down from the active cap) on a
+    # worker thread, nudged by resize()/autoscale — a later resize to
+    # a not-yet-warm bucket stops paying jit at a segment boundary.
+    # Requires warm_pool (the speculated executables persist there).
+    speculate: bool = False
+    # Round 21: the first CONSUMER of the round-19 advisory
+    # headroom_frac — resize() and speculative compilation REFUSE a
+    # bucket whose stamped per-chip footprint would leave less than
+    # this headroom fraction (HeadroomRefused + a typed 'headroom'
+    # sink record).  The default 0.0 refuses only footprints that
+    # exceed per-chip capacity outright; advisory stays advisory for
+    # request admission.  Enforcement needs a stamped plan
+    # (serve.cost_stamps + serve.memory_watch) — unstamped plans are
+    # never refused.
+    min_headroom_frac: float = 0.0
     # Multi-chip placement sub-block (round 12; default mode 'off' =
     # the single-chip path, byte-for-byte).
     placement: PlacementConfig = PlacementConfig()

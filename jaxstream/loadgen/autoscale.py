@@ -157,16 +157,35 @@ class AutoscaleController:
                       reason="autoscale_attach")
 
     def __call__(self, server) -> Optional[int]:
+        from ..serve.warmpool import HeadroomRefused
+
         queue_depth = len(server.queue)
         occupancy = float(server.stats.get("last_occupancy", 0.0))
         self.observations += 1
+        prev = self.state
         self.state, target = decide(self.policy, self.state,
                                     queue_depth, occupancy)
         if target is None:
             return None
-        old = server.resize(target, reason="autoscale",
-                            queue_depth=queue_depth,
-                            occupancy=occupancy)
+        try:
+            old = server.resize(target, reason="autoscale",
+                                queue_depth=queue_depth,
+                                occupancy=occupancy)
+        except HeadroomRefused:
+            # Round 21: the server refused the scale-up on stamped
+            # memory headroom (it already wrote the typed record).
+            # Revert the LEVEL so the ladder stays truthful, but keep
+            # the fresh cooldown — without it the policy would hammer
+            # the refused level every observation.
+            self.state = dataclasses.replace(self.state,
+                                             level=prev.level)
+            self.events.append({
+                "observation": self.observations,
+                "from_bucket": self.policy.levels[prev.level],
+                "to_bucket": target, "queue_depth": queue_depth,
+                "occupancy": round(occupancy, 4), "refused": True,
+            })
+            return None
         self.events.append({
             "observation": self.observations, "from_bucket": old,
             "to_bucket": target, "queue_depth": queue_depth,

@@ -529,6 +529,18 @@ def parse_bench_point(obj: dict, label: str = "?") -> dict:
                            (int, float))):
         secs["serving:packed"] = float(
             packed["agg_sim_days_per_sec_per_chip"])
+    # Round 21 (warm pools): the cold_start bench section lands as
+    # warm-over-cold SPEEDUP ratios (higher is better, like every
+    # other section), so future rounds gate scale-up latency the way
+    # throughput is gated today.
+    cold = parsed.get("cold_start") or {}
+    if isinstance(cold, dict):
+        for src, name in (("warm_speedup", "cold_start:warm_speedup"),
+                          ("resize_speedup",
+                           "cold_start:resize_speedup")):
+            val = cold.get(src)
+            if isinstance(val, (int, float)) and val > 0:
+                secs[name] = float(val)
     perf = parsed.get("perf") or {}
     cost = perf.get("cost") or {}
     mem = cost.get("memory") or {}

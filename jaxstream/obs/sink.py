@@ -122,6 +122,25 @@ RECORD_KINDS: Dict[str, tuple] = {
     # 'health_error' / the unhandled exception's type).  The pointer
     # scripts/postmortem.py follows from a sink file to the bundle.
     "crash": ("bundle", "path", "reason"),
+    # One warm-pool event (round 21, jaxstream.serve.warmpool —
+    # ``serve.warm_pool``): every rung decision the degradation ladder
+    # takes is typed, never silent.  "event" is 'hit' / 'miss' /
+    # 'save' / 'corrupt' (torn entry detected, deleted, recompiled) /
+    # 'probe' (a cross-process rung feature-probe verdict) /
+    # 'fallback' (a rung refused — carries "reason"); "rung" is
+    # 'aot' / 'stablehlo' / 'compile_cache' / 'cold'; "plan" is the
+    # bucket's plan key (null for pool-level events like probes).
+    # Optional: "key" (the entry digest), "reason", "bytes", "ok",
+    # "detail", "cached".
+    "warmpool": ("event", "rung", "plan"),
+    # One headroom enforcement decision (round 21): resize() or the
+    # speculative compiler refused a bucket whose stamped per-chip
+    # footprint breaches ``serve.min_headroom_frac`` — the first
+    # consumer of the round-19 advisory headroom_frac ("action" is
+    # 'resize_refused' / 'speculate_refused').  Advisory stays
+    # advisory for request admission; only scale-up enforces.
+    "headroom": ("action", "bucket", "headroom_frac",
+                 "min_headroom_frac"),
     # One resume-lineage stamp (round 20): a Simulation/server that
     # restarted from a checkpoint AND found a committed crash bundle
     # records which bundle it descends from and the checkpoint step it

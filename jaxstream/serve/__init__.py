@@ -24,6 +24,13 @@ refusals while in-flight members finish), ``resize`` (live bucket-cap
 scaling among warm executables), and ``on_segment`` progress events —
 the surface :mod:`jaxstream.gateway` and :mod:`jaxstream.loadgen`
 build on.
+
+Round 21 adds the warm-pool subsystem (:mod:`jaxstream.serve.
+warmpool`): disk-backed serialized executables keyed by plan + proof +
+toolchain so a restarted server loads instead of recompiling, a
+probe-gated persistent compile cache, speculative compilation of
+adjacent buckets, and :class:`HeadroomRefused` — the first enforcement
+consumer of the round-19 advisory ``headroom_frac``.
 """
 
 from .placement import BucketPlan, plan_placement, placement_report
@@ -31,16 +38,22 @@ from .queue import (AdmissionRefused, QueueFull, RequestQueue,
                     ServerDraining)
 from .request import ScenarioRequest, RequestResult
 from .server import EnsembleServer, serve_requests
+from .warmpool import (HeadroomRefused, SpeculativeCompiler,
+                       WarmExecutable, WarmPool)
 
 __all__ = [
     "AdmissionRefused",
     "BucketPlan",
     "EnsembleServer",
+    "HeadroomRefused",
     "QueueFull",
     "RequestQueue",
     "RequestResult",
     "ScenarioRequest",
     "ServerDraining",
+    "SpeculativeCompiler",
+    "WarmExecutable",
+    "WarmPool",
     "placement_report",
     "plan_placement",
     "serve_requests",

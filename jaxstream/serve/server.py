@@ -90,6 +90,8 @@ from ..stepping import SCHEMES, integrate_masked, vmap_ensemble
 from ..utils import jax_compat
 from ..utils.logging import get_logger
 from .placement import PLACEMENT_MODES, BucketPlan, plan_placement
+from . import warmpool as _warmpool
+from .warmpool import HeadroomRefused
 from ..plan import rules as _plan_rules
 from ..plan.rules import RULES_VERSION as _PLAN_RULES_VERSION
 from .queue import (AdmissionRefused, QueueFull, RequestQueue,
@@ -407,6 +409,37 @@ class EnsembleServer:
         fdir = flight.resolve_flight_dir(cfg)
         if fdir:
             self._blackbox = flight.BundleWriter(fdir)
+        #: Round 21 (warm pools): the disk-backed executable pool
+        #: (``serve.warm_pool``), the probe-gated persistent compile
+        #: cache (``serve.compile_cache``), and the speculative
+        #: compiler (``serve.speculate``).  The build lock serializes
+        #: first-use bucket builds between the serving thread and the
+        #: speculator thread; the deployment digest folds the config
+        #: fields the plan key does NOT carry (dt, segment steps, nu4,
+        #: dtype, donation, ...) into every pool entry key.
+        self._deploy_digest = _warmpool.deployment_digest(cfg)
+        self._build_lock = threading.RLock()
+        self._warmpool: Optional[_warmpool.WarmPool] = None
+        self._speculator = None
+        #: Entries only persist when ``serve.warm_pool`` names a
+        #: directory; a compile-cache-only deployment still gets a pool
+        #: object (it owns the probe verdicts) but load/save stay off.
+        self._pool_entries = bool(s.warm_pool)
+        if s.warm_pool or s.compile_cache:
+            pool_dir = s.warm_pool or s.compile_cache + ".pool"
+            self._warmpool = _warmpool.WarmPool(
+                pool_dir, compile_cache=s.compile_cache,
+                sink_write=self._sink_write,
+                counter_inc=self.metrics.counter_inc)
+            if s.compile_cache:
+                self._warmpool.enable_compile_cache()
+        if s.speculate:
+            if not self._pool_entries:
+                raise ValueError(
+                    "serve.speculate requires serve.warm_pool — a "
+                    "speculative compile is only worth its thread when "
+                    "the executable persists for the next process too")
+            self._speculator = _warmpool.SpeculativeCompiler(self)
 
     # --------------------------------------------------- flight recorder
     def _open_requests(self) -> dict:
@@ -484,6 +517,12 @@ class EnsembleServer:
         m.counter("jaxstream_compiles_total",
                   "compiled executables per plan key (warmup included; "
                   "a moving counter at steady state is a recompile)")
+        m.counter("jaxstream_warmpool_hits_total",
+                  "warm-pool entry loads, by rung")
+        m.counter("jaxstream_warmpool_misses_total",
+                  "warm-pool misses, by reason")
+        m.counter("jaxstream_warmpool_saves_total",
+                  "warm-pool entries persisted, by rung")
         m.gauge("jaxstream_queue_depth", "request queue depth")
         m.gauge("jaxstream_queue_capacity", "request queue bound")
         m.gauge("jaxstream_active_bucket_cap",
@@ -521,6 +560,9 @@ class EnsembleServer:
         if self._closed:
             return
         self._closed = True
+        if self._speculator is not None:
+            sp, self._speculator = self._speculator, None
+            sp.close()
         if self._writer is not None:
             w, self._writer = self._writer, None
             w.close()
@@ -575,6 +617,21 @@ class EnsembleServer:
                 f"resize target {max_bucket} is not a configured "
                 f"bucket {list(self.buckets)} — resizes must land on "
                 "warm executables (add the size to serve.buckets)")
+        if max_bucket > self._active_max:
+            # Round 21 headroom enforcement (the first consumer of the
+            # round-19 advisory): a scale-UP to a bucket whose stamped
+            # footprint breaches serve.min_headroom_frac is refused
+            # with a typed record.  Scale-downs free memory and are
+            # never refused; unstamped plans are never refused.
+            refusal = self.headroom_refusal(max_bucket)
+            if refusal is not None:
+                self.record_headroom_refusal(refusal,
+                                             action="resize_refused")
+                raise HeadroomRefused(
+                    f"resize target {max_bucket} refused: stamped "
+                    f"headroom {refusal['headroom_frac']:.4f} < "
+                    f"serve.min_headroom_frac "
+                    f"{refusal['min_headroom_frac']:.4f}")
         old, self._active_max = self._active_max, int(max_bucket)
         self.metrics.gauge_set("jaxstream_active_bucket_cap",
                                self._active_max)
@@ -596,6 +653,8 @@ class EnsembleServer:
                     else float(occupancy), 4),
                 "reason": reason or "manual",
             })
+        if self._speculator is not None:
+            self._speculator.nudge(self._active_max)
         return old
 
     # ------------------------------------------------------------- building
@@ -886,39 +945,50 @@ class EnsembleServer:
 
     def _bucket(self, group: str, B: int) -> _Bucket:
         """The warm (group, B) runtime — built, compiled and probed on
-        first use (the probe run IS the warmup)."""
+        first use (the probe run IS the warmup).  Under a configured
+        ``serve.warm_pool`` the three executables route through the
+        disk pool first (round 21): on a full-AOT hit the probe run
+        below executes pre-loaded executables — ZERO XLA compiles.
+        The build lock serializes first-use builds between the serving
+        thread and the speculative compiler (dict reads stay lock-free
+        for the warm steady state)."""
         key = (group, B)
         bk = self._buckets.get(key)
         if bk is not None:
             return bk
-        plan = self._plans[B]
-        impls = self._impls_for(group, plan)
-        err = None
-        for impl in impls:
-            try:
-                bk = self._build_bucket(group, B, impl)
-                t_warm = time.perf_counter()
-                self._warm_bucket(bk)
-                bk.cost.compile_seconds = round(
-                    time.perf_counter() - t_warm, 4)
-                self._stamp_bucket(bk)
-                self._impls[group] = impl
-                self._buckets[key] = bk
-                self.stats["warmup_compiles"] = self.compile_count()
-                log.info("serve: bucket (%s, B=%d) warm (%s stepper, "
-                         "placement %s x%d)", group, B, impl,
-                         plan.mode, plan.num_devices)
+        with self._build_lock:
+            bk = self._buckets.get(key)
+            if bk is not None:      # raced the speculator; it won
                 return bk
-            except Exception as e:
-                err = e
-                if impl != impls[-1]:
-                    log.warning(
-                        "serve: %s stepper unavailable for bucket "
-                        "(%s, B=%d) (%s: %s); falling back",
-                        impl, group, B, type(e).__name__, e)
-        raise RuntimeError(
-            f"serve: no stepper builds for bucket ({group}, B={B})"
-        ) from err
+            plan = self._plans[B]
+            impls = self._impls_for(group, plan)
+            err = None
+            for impl in impls:
+                try:
+                    bk = self._build_bucket(group, B, impl)
+                    t_warm = time.perf_counter()
+                    self._warm_via_pool(bk)
+                    self._warm_bucket(bk)
+                    bk.cost.compile_seconds = round(
+                        time.perf_counter() - t_warm, 4)
+                    self._stamp_bucket(bk)
+                    self._impls[group] = impl
+                    self._buckets[key] = bk
+                    self.stats["warmup_compiles"] = self.compile_count()
+                    log.info("serve: bucket (%s, B=%d) warm (%s "
+                             "stepper, placement %s x%d)", group, B,
+                             impl, plan.mode, plan.num_devices)
+                    return bk
+                except Exception as e:
+                    err = e
+                    if impl != impls[-1]:
+                        log.warning(
+                            "serve: %s stepper unavailable for bucket "
+                            "(%s, B=%d) (%s: %s); falling back",
+                            impl, group, B, type(e).__name__, e)
+            raise RuntimeError(
+                f"serve: no stepper builds for bucket ({group}, B={B})"
+            ) from err
 
     def _warm_member_tree(self, group: str):
         family = "tc5" if group == "oro" else "tc2"
@@ -941,6 +1011,76 @@ class EnsembleServer:
         carry = bk.inject(carry, jnp.int32(0), bk.put_member(st))
         jax.block_until_ready((ex["h"], carry["h"]))
 
+    def _warm_via_pool(self, bk: _Bucket) -> Optional[str]:
+        """Route the bucket's three executables through the warm pool
+        (round 21).  On a hit the jits are REPLACED by the pool-loaded
+        executables before the warmup probe runs — zero XLA compiles on
+        the full-AOT rung; on a miss each is compiled ahead-of-time
+        exactly once (the AOT ``Compiled`` becomes the bucket's
+        callable, so the warmup probe never compiles again) and
+        persisted.  Sharded buckets are a typed miss this round — a
+        serialized executable is bound to one device assignment, and
+        revalidating that across processes is future work.  Returns
+        the rung of the SEGMENT executable (the expensive one), or
+        None (pool off / sharded)."""
+        pool = self._warmpool
+        if pool is None or not self._pool_entries:
+            return None
+        plan_key = bk.proof.plan_key if bk.proof is not None else None
+        if bk.mesh is not None:
+            pool._record("miss", "cold", plan_key,
+                         reason="sharded_unsupported")
+            return None
+        st = self._warm_member_tree(bk.group)
+        carry = bk.stack([st] * bk.B)
+        rem = np.zeros(bk.B, np.int64)
+        rem[0] = self.config.serve.segment_steps
+        donate = (0,) if self.config.serve.donate else ()
+        specs = (
+            ("seg", bk.seg, (carry, bk.put_rem(rem)), donate),
+            ("extract", bk.extract, (carry, jnp.int32(0)), ()),
+            ("inject", bk.inject,
+             (carry, jnp.int32(0), bk.put_member(st)), ()),
+        )
+        fingerprint = (bk.proof.schedule_fingerprint
+                       if bk.proof is not None else None)
+        rules_version = (bk.proof.rules_version
+                         if bk.proof is not None
+                         else _PLAN_RULES_VERSION)
+        # The proof's plan key names the STRATEGY (tier, scheme,
+        # placement) but not which bucket or batching group compiled
+        # under it — and every bucket/group pair is a different
+        # program (different B in every shape, oro groups carry the
+        # orography field).  Fold both in or B=2 stale-hits B=1's
+        # entry and dies on the shape check.
+        ident = f"{plan_key or 'unplanned'}/{bk.group}/B{bk.B}"
+        seg_rung = None
+        loaded = {}
+        for name, jitted, args, dn in specs:
+            ekey = _warmpool.entry_key(
+                ident, fingerprint,
+                rules_version, self._deploy_digest, name)
+            warm = pool.load(ekey, ident)
+            if warm is None:
+                # Lowering never consumes donated buffers — donation
+                # only matters at execution, so the example args stay
+                # valid for every spec.
+                compiled = jitted.lower(*args).compile()
+                rung = pool.save(ekey, jitted, compiled, args,
+                                 plan_key=ident, donate=dn)
+                warm = _warmpool.WarmExecutable(
+                    compiled, rung or "fresh", compiles=1)
+            # The original jit surface rides along so the round-19
+            # cost stamp can still lower+measure (measure_cost needs
+            # .lower; an AOT Compiled has none).
+            warm._jitted = jitted
+            loaded[name] = warm
+            if name == "seg":
+                seg_rung = warm.rung
+        bk.seg, bk.extract, bk.inject = (
+            loaded["seg"], loaded["extract"], loaded["inject"])
+        return seg_rung
+
     def _stamp_bucket(self, bk: _Bucket) -> None:
         """Round 19: fill the bucket cost stamp's measured fields.
 
@@ -962,7 +1102,12 @@ class EnsembleServer:
             rem = np.zeros(bk.B, np.int64)
             rem[0] = seg
             obs_perf.measure_cost(
-                bk.seg, carry, bk.put_rem(rem),
+                # Under the warm pool bk.seg is a WarmExecutable; the
+                # stamp lowers through the original jit surface it
+                # carries (stamping is the documented one-extra-compile
+                # opt-in either way).
+                getattr(bk.seg, "_jitted", bk.seg), carry,
+                bk.put_rem(rem),
                 analytic=bk.cost.analytic, steps=seg,
                 xla_visible=bk.cost.xla_visible, stamp=bk.cost)
         except Exception as e:
@@ -1075,6 +1220,59 @@ class EnsembleServer:
         ``serve.memory_watch`` is off or nothing polled yet)."""
         return (self.memory_watcher.last
                 if self.memory_watcher is not None else None)
+
+    # ------------------------------------------------- warm pool (round 21)
+    def warm_groups(self) -> tuple:
+        """Batching groups the speculative compiler should warm: the
+        groups that already have buckets (a live server speculates
+        along the traffic it has seen), else the deployment's default
+        group."""
+        groups = {g for (g, _B) in self._buckets}
+        if not groups:
+            groups = {"any" if not self._grouping else "flat"}
+        return tuple(sorted(groups))
+
+    def headroom_refusal(self, B: int) -> Optional[dict]:
+        """The typed refusal record for scaling to bucket ``B``, or
+        None (= allowed).  Refuses ONLY when the bucket's plan carries
+        a stamped ``headroom_frac`` (round 19 cost stamps) below
+        ``serve.min_headroom_frac`` — an unstamped plan is never
+        refused (enforcement needs evidence), and the default threshold
+        0.0 only refuses footprints that already exceed capacity."""
+        plan = self._plans.get(int(B))
+        hf = getattr(plan, "headroom_frac", None)
+        if hf is None:
+            return None
+        mn = self.config.serve.min_headroom_frac
+        if hf >= mn:
+            return None
+        return {"kind": "headroom", "action": "", "bucket": int(B),
+                "headroom_frac": round(float(hf), 4),
+                "min_headroom_frac": float(mn)}
+
+    def record_headroom_refusal(self, refusal: dict,
+                                action: str) -> None:
+        """Write one headroom refusal as a typed sink record + flight
+        event (``action``: 'resize_refused' / 'speculate_refused')."""
+        rec = dict(refusal)
+        rec["action"] = action
+        self._sink_write(rec)
+        flight.record("serve.headroom_refused", bucket=rec["bucket"],
+                      action=action,
+                      headroom_frac=rec["headroom_frac"])
+
+    def warmpool_summary(self) -> Optional[dict]:
+        """The warm pool's ``/v1/stats`` surface (None = pool off):
+        hit/miss/save/corrupt counters, per-rung hit counts, probe
+        verdicts, and what the speculative compiler built/skipped."""
+        if self._warmpool is None:
+            return None
+        out = self._warmpool.summary()
+        if self._speculator is not None:
+            out["speculative_built"] = [
+                list(t) for t in self._speculator.built]
+            out["speculative_skipped"] = len(self._speculator.skipped)
+        return out
 
     # ------------------------------------------------------------ admission
     def refusal_reasons(self) -> List[str]:
@@ -1254,7 +1452,9 @@ class EnsembleServer:
         same cadence as the autoscale tick; the counter pass is a few
         dict/attribute reads when nothing compiled, and ZERO memory
         polling happens when the watcher is off."""
-        for key, bk in self._buckets.items():
+        # list(): the speculative compiler may insert a bucket
+        # mid-iteration (round 21).
+        for key, bk in list(self._buckets.items()):
             counts = [jax_compat.compile_count(f) for f in bk.jits()]
             cur = sum(c for c in counts if c is not None)
             prev = self._compiles_seen.get(key, 0)

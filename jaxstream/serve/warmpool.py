@@ -1,0 +1,648 @@
+"""Disk-backed warm pools: kill the compile tax (round 21).
+
+Every scale-up event used to pay a multi-second jit compile — PR 5
+measured 22.4 s cold vs 5.7 s warm, and the round-19 ``compile_seconds``
+cost stamps measure it per plan.  This module converts that telemetry
+into millisecond scale-up: each bucket's compiled masked-segment
+executable (plus its extract/inject companions) is persisted to disk so
+a restarted — or freshly spawned — :class:`~.server.EnsembleServer`
+*loads* its warm pool instead of recompiling.
+
+**Cache key.**  An entry is only reusable when the program AND the
+environment match, so the key digests all of:
+
+* the bucket's capability **plan key** (grid, tier, scheme, B,
+  placement — ``jaxstream.plan``) and **proof fingerprint** (the
+  canonical exchange-schedule digest; ``None`` hashes as such),
+* the **rules version** the proof was minted against — a rule-table
+  bump voids every stamp, so it must void every cached executable too,
+* a **deployment digest** over the config fields the plan key does NOT
+  carry (dt, segment steps, nu4, gravity, dtype, donation, grouping —
+  a stale hit across any of these would be silently wrong *results*,
+  not just a slow path),
+* **jax + jaxlib version strings, backend platform, device count** —
+  a serialized executable is an artifact of one exact toolchain.
+
+**Degradation ladder** (:meth:`WarmPool.load` / :meth:`WarmPool.save`),
+each rung a typed sink record, never a silent fallback:
+
+1. ``aot`` — full compiled-executable serialization
+   (``jax_compat.serialize_executable``): a load performs ZERO XLA
+   compiles (the parity gate's ``compile_count`` proof).
+2. ``stablehlo`` — ``jax.export`` StableHLO bytes: a load re-runs the
+   backend compile but skips trace + lower.
+3. ``compile_cache`` — jax's persistent compilation cache pointed at
+   ``serve.compile_cache``.  This image's jaxlib (0.4.37) is
+   *documented* to segfault when a different process deserializes CPU
+   cache entries (the ``jax_compat.enable_compile_cache`` quarantine
+   note), so the rung is gated behind a SUBPROCESS feature probe: a
+   child process populates a scratch cache, a second child reloads
+   from it, and only a clean double-exit unlocks the rung in the
+   server process.  The verdict is cached per (jaxlib, backend) so the
+   probe's ~seconds are paid once per pool directory.
+4. ``cold`` — plain jit compile (today's behavior).
+
+**Atomicity.**  Entries commit in the PR-20 flight-recorder style:
+payload bytes land via tmp + ``os.replace``; the small meta JSON —
+naming the payload sha256 and byte length — is written LAST, so a
+reader either sees a complete entry or no entry.  A meta that points
+at missing/short/digest-mismatched payload bytes is a TORN entry:
+detected, deleted, recorded (``event: "corrupt"``), recompiled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..utils import jax_compat
+from ..utils.logging import get_logger
+
+__all__ = ["WarmPool", "WarmExecutable", "HeadroomRefused",
+           "entry_key", "deployment_digest", "probe_rung",
+           "SpeculativeCompiler", "RUNGS"]
+
+log = get_logger(__name__)
+
+#: The degradation ladder, best rung first.  ``cold`` is implicit —
+#: the pool returning None IS the cold rung.
+RUNGS = ("aot", "stablehlo", "compile_cache")
+
+
+class HeadroomRefused(ValueError):
+    """A resize/speculation target whose stamped per-chip footprint
+    would breach ``serve.min_headroom_frac`` (the first CONSUMER of the
+    round-19 advisory ``headroom_frac`` — advisory stays advisory for
+    admission; only scale-up decisions enforce it)."""
+
+
+# ------------------------------------------------------------- cache key
+def deployment_digest(config) -> str:
+    """Digest of the config fields the plan key does NOT carry.
+
+    The plan key names grid size, tier, scheme, bucket and placement —
+    but not dt, segment steps, hyperdiffusion, gravity, limiter or the
+    carry dtype.  Two deployments differing in any of those compile
+    DIFFERENT programs under the SAME plan key, so the warm-pool key
+    must fold them in: a stale hit here would be wrong physics, not a
+    slow path.
+    """
+    cfg = config
+    ident = {
+        "grid": {"n": cfg.grid.n, "halo": cfg.grid.halo,
+                 "radius": cfg.grid.radius, "dtype": cfg.grid.dtype,
+                 "metrics": cfg.grid.metrics},
+        "time": {"dt": cfg.time.dt, "scheme": cfg.time.scheme},
+        "physics": {"gravity": cfg.physics.gravity,
+                    "omega": cfg.physics.omega,
+                    "nu4": cfg.physics.hyperdiffusion,
+                    "d2": cfg.physics.divergence_damping},
+        "model": {"scheme": cfg.model.scheme,
+                  "limiter": cfg.model.limiter,
+                  "backend": cfg.model.backend,
+                  "nu4_mode": cfg.model.nu4_mode,
+                  "ic_angle": cfg.model.ic_angle},
+        "precision": {"stage": cfg.precision.stage,
+                      "strips": cfg.precision.strips,
+                      "carry": cfg.precision.carry},
+        "serve": {"segment_steps": cfg.serve.segment_steps,
+                  "donate": cfg.serve.donate,
+                  "group_by_orography": cfg.serve.group_by_orography},
+    }
+    blob = json.dumps(ident, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _environment_fields() -> dict:
+    """The toolchain identity a serialized executable depends on."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = jaxlib.__version__
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = "unknown"
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+    }
+
+
+def entry_key(plan_key: str, proof_fingerprint: Optional[str],
+              rules_version: int, deploy_digest: str, fn: str,
+              environment: Optional[dict] = None) -> str:
+    """One warm-pool entry's content-addressed key (hex digest).
+
+    ``fn`` names which of the bucket's executables the entry holds
+    ('seg' / 'extract' / 'inject').  ``environment`` is injectable so
+    the tier-1 invalidation tests can prove a jaxlib version-string
+    change MISSES without installing a second jaxlib.
+    """
+    env = environment if environment is not None else _environment_fields()
+    ident = {
+        "plan_key": plan_key,
+        "proof_fingerprint": proof_fingerprint,
+        "rules_version": int(rules_version),
+        "deploy": deploy_digest,
+        "fn": fn,
+        "env": {k: env.get(k) for k in
+                ("jax", "jaxlib", "backend", "device_count")},
+    }
+    blob = json.dumps(ident, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+# -------------------------------------------------------- warm callables
+class WarmExecutable:
+    """A pool-managed callable that keeps ``compile_count`` honest.
+
+    The server's zero-steady-state-recompile proofs read
+    ``fn._cache_size()`` through ``jax_compat.compile_count``; an AOT
+    ``Compiled`` has no jit cache, so the wrapper reports the number of
+    XLA compiles its construction actually performed: 0 for a
+    pool-loaded executable (the warm path's zero-compile gate), 1 for
+    a freshly AOT-compiled one.  ``stablehlo``-rung loads delegate to
+    the inner jit's real cache (its first call IS one backend
+    compile).
+    """
+
+    def __init__(self, call: Callable, rung: str,
+                 compiles: Optional[int] = None):
+        self._call = call
+        self.rung = rung
+        self._compiles = compiles
+
+    def __call__(self, *args, **kwargs):
+        return self._call(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        if self._compiles is not None:
+            return self._compiles
+        inner = jax_compat.compile_count(self._call)
+        return 0 if inner is None else inner
+
+
+# ------------------------------------------------------ subprocess probe
+#: Child script of one probe arm.  argv: [rung, scratch_dir, phase]
+#: phase 'write' populates (compile + serialize/cache-fill), phase
+#: 'read' consumes what a DIFFERENT process wrote — the exact pattern
+#: the jaxlib-0.4.37 quarantine note says can segfault, which is why
+#: this runs in a child: a SIGSEGV costs an exit code, not the server.
+_PROBE_SCRIPT = r"""
+import os, sys
+rung, scratch, phase = sys.argv[1], sys.argv[2], sys.argv[3]
+os.environ.setdefault("JAX_PLATFORMS", os.environ.get(
+    "JAXSTREAM_PROBE_PLATFORM", "cpu"))
+import jax, jax.numpy as jnp
+from jaxstream.utils import jax_compat
+fn = jax.jit(lambda x: x * 2.0 + 1.0)
+x = jnp.arange(8.0)
+payload_path = os.path.join(scratch, "probe.bin")
+if rung == "aot":
+    if phase == "write":
+        blob = jax_compat.serialize_executable(
+            fn.lower(x).compile())
+        with open(payload_path, "wb") as fh:
+            fh.write(blob)
+    else:
+        with open(payload_path, "rb") as fh:
+            blob = fh.read()
+        loaded = jax_compat.deserialize_executable(blob)
+        out = loaded(x)
+        assert float(out[1]) == 3.0, out
+elif rung == "compile_cache":
+    jax_compat.enable_compile_cache(os.path.join(scratch, "cache"))
+    out = fn(x)
+    jax.block_until_ready(out)
+    assert float(out[1]) == 3.0, out
+    if phase == "write":
+        entries = os.listdir(os.path.join(scratch, "cache"))
+        assert entries, "compile cache stayed empty"
+else:
+    raise SystemExit(f"unknown probe rung {rung!r}")
+"""
+
+
+def probe_rung(rung: str, scratch_dir: str,
+               timeout: float = 120.0) -> dict:
+    """Cross-process feature probe of one warm-pool rung.
+
+    Runs TWO child processes: a writer that compiles and persists (a
+    serialized executable, or a populated compile cache), then a
+    reader that consumes the writer's on-disk artifact — the
+    cross-process deserialization this image's jaxlib is documented to
+    segfault on for CPU compile-cache entries.  Returns a verdict dict
+    ``{"rung", "ok", "detail"}``; a crash (any nonzero exit, including
+    a signal) is a typed ``ok: False``, never an exception — the pool
+    records the verdict and degrades a rung.
+    """
+    import subprocess
+
+    if rung not in ("aot", "compile_cache"):
+        raise ValueError(f"unprobed rung {rung!r}; probe covers "
+                         "('aot', 'compile_cache')")
+    os.makedirs(scratch_dir, exist_ok=True)
+    env = dict(os.environ)
+    # The probe must see the same platform the server runs, but never
+    # inherit a live compile-cache env var that would alias scratch.
+    env.pop("JAXSTREAM_COMPILE_CACHE", None)
+    for phase in ("write", "read"):
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", _PROBE_SCRIPT, rung,
+                 scratch_dir, phase],
+                capture_output=True, text=True, timeout=timeout,
+                env=env)
+        except subprocess.TimeoutExpired:
+            return {"rung": rung, "ok": False,
+                    "detail": f"{phase} probe timed out at {timeout}s"}
+        if res.returncode != 0:
+            tail = (res.stderr or res.stdout or "").strip()[-300:]
+            return {"rung": rung, "ok": False,
+                    "detail": (f"{phase} probe exited "
+                               f"{res.returncode}: {tail}")}
+    return {"rung": rung, "ok": True,
+            "detail": "cross-process write+read probes exited clean"}
+
+
+# --------------------------------------------------------------- the pool
+@dataclasses.dataclass
+class _Entry:
+    """On-disk layout of one committed entry (meta side)."""
+    key: str
+    rung: str
+    sha256: str
+    length: int
+    plan_key: str
+    donate: tuple
+
+
+class WarmPool:
+    """One directory of serialized bucket executables + rung probes.
+
+    ``sink_write`` receives the typed ``warmpool`` records (hit / miss
+    / save / corrupt / probe / fallback — never a silent rung change);
+    ``counter_inc`` is the metrics hook (``jaxstream_warmpool_*`` on
+    ``/v1/metrics``).  Thread-safe: the speculative compiler and the
+    serving thread share one pool under ``self._lock``.
+    """
+
+    def __init__(self, path: str, compile_cache: str = "",
+                 sink_write: Optional[Callable] = None,
+                 counter_inc: Optional[Callable] = None,
+                 environment: Optional[dict] = None,
+                 probe: Optional[Callable] = None):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.compile_cache = compile_cache
+        self._sink_write = sink_write
+        self._counter_inc = counter_inc
+        self._environment = environment
+        self._probe = probe if probe is not None else probe_rung
+        self._lock = threading.Lock()
+        self._verdicts: Dict[str, dict] = {}
+        self.stats = {"hits": 0, "misses": 0, "saves": 0,
+                      "corrupt": 0, "rungs": {}}
+        self._cache_enabled = False
+
+    # ------------------------------------------------------------ records
+    def _record(self, event: str, rung: str, plan: Optional[str],
+                **extra) -> None:
+        rec = {"kind": "warmpool", "event": event, "rung": rung,
+               "plan": plan}
+        rec.update(extra)
+        if self._sink_write is not None:
+            try:
+                self._sink_write(rec)
+            except Exception as e:  # telemetry must never kill serving
+                log.warning("warmpool sink record failed (%s: %s)",
+                            type(e).__name__, e)
+        if self._counter_inc is not None:
+            try:
+                if event == "hit":
+                    self._counter_inc("jaxstream_warmpool_hits_total",
+                                      1, rung=rung)
+                elif event == "miss":
+                    self._counter_inc(
+                        "jaxstream_warmpool_misses_total", 1,
+                        reason=str(extra.get("reason", "absent")))
+                elif event == "save":
+                    self._counter_inc("jaxstream_warmpool_saves_total",
+                                      1, rung=rung)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------- paths
+    def _payload_path(self, key: str) -> str:
+        return os.path.join(self.path, f"{key}.bin")
+
+    def _meta_path(self, key: str) -> str:
+        return os.path.join(self.path, f"{key}.json")
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        from ..obs.flight import _atomic_write_bytes
+
+        _atomic_write_bytes(path, data)
+
+    # ------------------------------------------------------------ probing
+    def rung_verdict(self, rung: str) -> dict:
+        """The (cached) cross-process probe verdict of one rung.
+
+        Cached two ways: in-process per pool, and on disk next to the
+        entries keyed by (jaxlib, backend) — a fleet of servers
+        sharing one pool directory pays the probe's seconds once.  The
+        verdict lands in the sink as a typed ``probe`` record either
+        way, so every deployment's telemetry says which rungs were
+        trusted and why.
+        """
+        if rung in self._verdicts:
+            return self._verdicts[rung]
+        env = (self._environment if self._environment is not None
+               else _environment_fields())
+        tag = hashlib.sha256(json.dumps(
+            {"rung": rung, "jaxlib": env.get("jaxlib"),
+             "backend": env.get("backend")},
+            sort_keys=True).encode()).hexdigest()[:16]
+        vpath = os.path.join(self.path, f"probe_{rung}_{tag}.json")
+        verdict = None
+        if os.path.exists(vpath):
+            try:
+                with open(vpath) as fh:
+                    verdict = json.load(fh)
+                if verdict.get("rung") != rung:
+                    verdict = None
+            except Exception:
+                verdict = None
+        cached = verdict is not None
+        if verdict is None:
+            verdict = self._probe(
+                rung, os.path.join(self.path, f"_probe_{rung}"))
+            try:
+                self._atomic_write(
+                    vpath, json.dumps(verdict).encode())
+            except OSError as e:
+                log.warning("warmpool: probe verdict not cached "
+                            "(%s: %s)", type(e).__name__, e)
+        self._verdicts[rung] = verdict
+        self._record("probe", rung, None, ok=bool(verdict.get("ok")),
+                     detail=str(verdict.get("detail", "")),
+                     cached=cached)
+        return verdict
+
+    def enable_compile_cache(self) -> bool:
+        """Engage the ``compile_cache`` rung iff configured AND the
+        subprocess probe proved cross-process deserialization safe on
+        this toolchain.  Idempotent; returns whether the cache is on."""
+        if self._cache_enabled:
+            return True
+        if not self.compile_cache:
+            return False
+        verdict = self.rung_verdict("compile_cache")
+        if not verdict.get("ok"):
+            self._record("fallback", "compile_cache", None,
+                         reason=str(verdict.get("detail", "")))
+            return False
+        jax_compat.enable_compile_cache(self.compile_cache)
+        self._cache_enabled = True
+        return True
+
+    # ------------------------------------------------------------ loading
+    def load(self, key: str, plan_key: Optional[str] = None):
+        """One entry -> a :class:`WarmExecutable`, or None (= cold).
+
+        Every outcome is typed: a clean absent entry is a ``miss``
+        (reason 'absent'); a meta whose payload is missing, short, or
+        digest-mismatched is a torn/corrupt entry — deleted, recorded
+        (``corrupt``), and reported as a miss so the caller recompiles;
+        a payload that fails deserialization (e.g. a foreign jaxlib's
+        bytes that slipped past the key — should be impossible) is the
+        same corrupt path, never a crash.
+        """
+        with self._lock:
+            return self._load_locked(key, plan_key)
+
+    def _load_locked(self, key: str, plan_key: Optional[str]):
+        mpath, ppath = self._meta_path(key), self._payload_path(key)
+        if not os.path.exists(mpath):
+            self.stats["misses"] += 1
+            self._record("miss", "cold", plan_key, key=key,
+                         reason="absent")
+            return None
+        try:
+            with open(mpath) as fh:
+                meta = json.load(fh)
+            with open(ppath, "rb") as fh:
+                payload = fh.read()
+            if len(payload) != int(meta["length"]):
+                raise ValueError(
+                    f"payload is {len(payload)}B, meta says "
+                    f"{meta['length']}B")
+            digest = hashlib.sha256(payload).hexdigest()
+            if digest != meta["sha256"]:
+                raise ValueError("payload sha256 mismatch")
+            rung = meta["rung"]
+            if rung == "aot":
+                call = jax_compat.deserialize_executable(payload)
+                warm = WarmExecutable(call, "aot", compiles=0)
+            elif rung == "stablehlo":
+                call = jax_compat.deserialize_stablehlo(
+                    payload,
+                    donate_argnums=tuple(meta.get("donate", ())))
+                warm = WarmExecutable(call, "stablehlo")
+            else:
+                raise ValueError(f"unknown entry rung {rung!r}")
+        except Exception as e:
+            # Torn/corrupt entry: loud, deleted, recompiled.
+            self.stats["corrupt"] += 1
+            self.stats["misses"] += 1
+            for p in (mpath, ppath):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            log.warning(
+                "warmpool: entry %s is torn/corrupt (%s: %s) — "
+                "deleted; recompiling", key, type(e).__name__, e)
+            self._record("corrupt", "cold", plan_key, key=key,
+                         reason=f"{type(e).__name__}: {e}")
+            self._record("miss", "cold", plan_key, key=key,
+                         reason="corrupt")
+            return None
+        self.stats["hits"] += 1
+        self.stats["rungs"][rung] = self.stats["rungs"].get(rung, 0) + 1
+        self._record("hit", rung, plan_key, key=key)
+        return warm
+
+    # ------------------------------------------------------------- saving
+    def save(self, key: str, jitted, compiled, example_args,
+             plan_key: Optional[str] = None,
+             donate: tuple = ()) -> Optional[str]:
+        """Persist one freshly compiled executable at the best rung
+        this build supports.  ``compiled`` is the AOT ``Compiled``
+        (rung 1's payload); ``jitted`` + ``example_args`` feed the
+        StableHLO export when rung 1 is unavailable.  Returns the rung
+        saved at, or None (ladder exhausted — the typed ``fallback``
+        records say which rungs refused and why)."""
+        with self._lock:
+            return self._save_locked(key, jitted, compiled,
+                                     example_args, plan_key, donate)
+
+    def _save_locked(self, key, jitted, compiled, example_args,
+                     plan_key, donate):
+        payload = rung = None
+        # The aot/stablehlo rungs gate on API availability alone: their
+        # loads were verified safe on this toolchain (and a corrupt
+        # payload degrades through the typed torn-entry path anyway).
+        # Only the compile_cache rung carries the documented
+        # cross-process segfault class, so only it pays the subprocess
+        # probe (jax_compat.enable_compile_cache quarantine note).
+        if jax_compat.executable_serialization_available():
+            try:
+                payload = jax_compat.serialize_executable(compiled)
+                rung = "aot"
+            except RuntimeError as e:
+                self._record("fallback", "aot", plan_key,
+                             reason=str(e))
+        else:
+            self._record("fallback", "aot", plan_key,
+                         reason="unavailable: no serialize_executable "
+                                "in this jax build")
+        if payload is None and jax_compat.stablehlo_serialization_available():
+            try:
+                payload = jax_compat.serialize_stablehlo(
+                    jitted, *example_args)
+                rung = "stablehlo"
+            except RuntimeError as e:
+                self._record("fallback", "stablehlo", plan_key,
+                             reason=str(e))
+        if payload is None:
+            # Last resort below cold: the persistent compile cache
+            # (probe-gated) at least makes the next cold compile warm.
+            self.enable_compile_cache()
+            return None
+        meta = {"key": key, "rung": rung,
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "length": len(payload), "plan_key": plan_key,
+                "donate": list(donate)}
+        try:
+            # Flight-recorder commit discipline: payload first, the
+            # meta that makes the entry visible LAST — a kill between
+            # the two leaves an invisible payload, not a torn entry.
+            self._atomic_write(self._payload_path(key), payload)
+            self._atomic_write(self._meta_path(key),
+                               json.dumps(meta).encode())
+        except OSError as e:
+            self._record("fallback", rung, plan_key,
+                         reason=f"entry write failed "
+                                f"({type(e).__name__}: {e})")
+            return None
+        self.stats["saves"] += 1
+        self._record("save", rung, plan_key, key=key,
+                     bytes=len(payload))
+        return rung
+
+    def summary(self) -> dict:
+        """The ``/v1/stats`` payload: counters + probed verdicts."""
+        return {
+            "path": self.path,
+            "compile_cache": (self.compile_cache
+                              if self._cache_enabled else ""),
+            "hits": self.stats["hits"],
+            "misses": self.stats["misses"],
+            "saves": self.stats["saves"],
+            "corrupt": self.stats["corrupt"],
+            "rungs": dict(self.stats["rungs"]),
+            "probes": {r: {"ok": v.get("ok"),
+                           "detail": v.get("detail")}
+                       for r, v in sorted(self._verdicts.items())},
+        }
+
+
+# ------------------------------------------------- speculative compiler
+class SpeculativeCompiler:
+    """Background compilation of ADJACENT plans (round 21).
+
+    The autoscale policy moves the active cap one level at a time, so
+    the plans worth having warm are exactly the next configured bucket
+    up and down from the current cap.  ``nudge(cap)`` (called from
+    ``EnsembleServer.resize`` and at attach) wakes a worker thread
+    that builds those buckets through the server's own ``_bucket``
+    path — same build lock, same warm-pool save — so a later
+    ``resize()`` to a not-yet-warm size stops paying jit at a segment
+    boundary.  Headroom-refused targets are skipped with the same
+    typed record ``resize`` writes (the satellite's one enforcement).
+    """
+
+    THREAD_NAME = "jaxstream-serve-speculator"
+
+    def __init__(self, server):
+        self._server = server
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._targets: List[int] = []
+        self._lock = threading.Lock()
+        self.built: List[tuple] = []
+        self.skipped: List[dict] = []
+        self._thread = threading.Thread(
+            target=self._run, name=self.THREAD_NAME, daemon=True)
+        self._thread.start()
+
+    def nudge(self, cap: int) -> None:
+        srv = self._server
+        buckets = list(srv.buckets)
+        try:
+            i = buckets.index(int(cap))
+        except ValueError:
+            return
+        adjacent = [buckets[j] for j in (i + 1, i - 1)
+                    if 0 <= j < len(buckets)]
+        with self._lock:
+            self._targets = adjacent
+        self._wake.set()
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            with self._lock:
+                targets, self._targets = self._targets, []
+            for B in targets:
+                if self._stop.is_set():
+                    return
+                try:
+                    self._build(B)
+                except Exception as e:
+                    # A speculative compile failing must never hurt
+                    # the server — the cold path still works.
+                    log.warning(
+                        "warmpool: speculative build of B=%d failed "
+                        "(%s: %s)", B, type(e).__name__, e)
+
+    def _build(self, B: int) -> None:
+        srv = self._server
+        for group in srv.warm_groups():
+            if (group, B) in srv._buckets:
+                continue
+            refusal = srv.headroom_refusal(B)
+            if refusal is not None:
+                self.skipped.append(refusal)
+                srv.record_headroom_refusal(
+                    refusal, action="speculate_refused")
+                continue
+            srv._bucket(group, B)
+            self.built.append((group, B))

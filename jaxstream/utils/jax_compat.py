@@ -18,11 +18,14 @@ import os
 import jax
 
 __all__ = ["LEGACY_SHARD_MAP", "compile_count", "copy_to_host_async",
+           "deserialize_executable", "deserialize_stablehlo",
            "device_memory_stats", "enable_compile_cache",
+           "executable_serialization_available",
            "maybe_enable_compile_cache", "memory_analysis",
-           "named_scope", "profiler_available", "shard_map",
-           "start_profiler_trace", "stop_profiler_trace",
-           "tpu_compiler_params"]
+           "named_scope", "profiler_available", "serialize_executable",
+           "serialize_stablehlo", "shard_map",
+           "stablehlo_serialization_available", "start_profiler_trace",
+           "stop_profiler_trace", "tpu_compiler_params"]
 
 #: True on the 0.4.x line.  Besides the spelling differences shimmed
 #: below, that line's XLA trips an hlo-verifier bug ("tile_assignment
@@ -269,6 +272,124 @@ def maybe_enable_compile_cache(env: str = "JAXSTREAM_COMPILE_CACHE"):
     if not path:
         return None
     return enable_compile_cache(path)
+
+
+# ----------------------------------------------- executable serialization
+# Round 21 (warm pools): the two serialization surfaces the
+# jaxstream.serve.warmpool degradation ladder stands on.  Both are
+# version-portable shims with typed RuntimeErrors — a build that lacks
+# one rung must say so (the pool records the typed miss and drops to
+# the next rung), never AttributeError soup.
+
+def executable_serialization_available() -> bool:
+    """True when this jax build can serialize a COMPILED executable
+    (``jax.experimental.serialize_executable``) — the warm pool's top
+    rung: a load skips trace, lower AND backend compile entirely."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        return (hasattr(se, "serialize")
+                and hasattr(se, "deserialize_and_load"))
+    except Exception:
+        return False
+
+
+def serialize_executable(compiled) -> bytes:
+    """One compiled executable -> portable bytes (pickled payload).
+
+    ``jax.experimental.serialize_executable.serialize`` returns
+    ``(unloaded_bytes, in_tree, out_tree)``; the pytree defs are part
+    of the call contract, so the three are pickled together as ONE
+    opaque payload ``deserialize_executable`` reverses.  Raises the
+    typed RuntimeError on builds without the API.
+    """
+    if not executable_serialization_available():
+        raise RuntimeError(
+            "unavailable: this jax build exposes no "
+            "jax.experimental.serialize_executable")
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    try:
+        return pickle.dumps(se.serialize(compiled))
+    except Exception as e:
+        raise RuntimeError(
+            f"unavailable: executable serialization failed "
+            f"({type(e).__name__}: {e})")
+
+
+def deserialize_executable(payload: bytes):
+    """Bytes from :func:`serialize_executable` -> a loaded, callable
+    ``Compiled`` — ZERO XLA compiles (the warm pool's zero-compile
+    parity gate reads exactly this property).  The payload must come
+    from the same jaxlib/backend/device-count — the warm-pool cache
+    key enforces that; this function only reverses the encoding."""
+    if not executable_serialization_available():
+        raise RuntimeError(
+            "unavailable: this jax build exposes no "
+            "jax.experimental.serialize_executable")
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    try:
+        return se.deserialize_and_load(*pickle.loads(payload))
+    except Exception as e:
+        raise RuntimeError(
+            f"unavailable: executable deserialization failed "
+            f"({type(e).__name__}: {e})")
+
+
+def stablehlo_serialization_available() -> bool:
+    """True when this jax build has the ``jax.export`` StableHLO
+    round-trip — the warm pool's middle rung: a load re-runs the
+    backend compile but skips trace + lower."""
+    try:
+        import jax.export as jex
+
+        return hasattr(jex, "export") and hasattr(jex, "deserialize")
+    except Exception:
+        return False
+
+
+def serialize_stablehlo(jitted, *args, **kwargs) -> bytes:
+    """Trace + lower ``jitted(*args)`` once and serialize the exported
+    StableHLO module (``jax.export``) — portable across processes and
+    (unlike the executable rung) across jaxlib patch versions."""
+    if not stablehlo_serialization_available():
+        raise RuntimeError(
+            "unavailable: this jax build exposes no jax.export")
+    import jax.export as jex
+
+    try:
+        return jex.export(jitted)(*args, **kwargs).serialize()
+    except Exception as e:
+        raise RuntimeError(
+            f"unavailable: StableHLO export failed "
+            f"({type(e).__name__}: {e})")
+
+
+def deserialize_stablehlo(payload: bytes, donate_argnums=()):
+    """Bytes from :func:`serialize_stablehlo` -> a jitted callable.
+
+    The first call performs ONE backend compile (trace + lower are
+    skipped — that is the rung's value); ``donate_argnums`` re-applies
+    the original jit's donation, which the exported module does not
+    carry on its own."""
+    if not stablehlo_serialization_available():
+        raise RuntimeError(
+            "unavailable: this jax build exposes no jax.export")
+    import jax.export as jex
+
+    try:
+        exported = jex.deserialize(bytearray(payload))
+        return jax.jit(exported.call,
+                       donate_argnums=tuple(donate_argnums))
+    except Exception as e:
+        raise RuntimeError(
+            f"unavailable: StableHLO import failed "
+            f"({type(e).__name__}: {e})")
 
 
 def tpu_compiler_params(**kwargs):

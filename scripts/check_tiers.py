@@ -155,6 +155,25 @@ every gate run self-checking):
     and waits on it, which is exactly the cost profile the fast
     tier's budget excludes.
 
+15. **Warm-pool tests stay non-slow and in-process; cross-process
+    cache-deserialization tests stay slow** (round-21 compile-tax
+    satellite).  Two halves: (a) a test module importing the warm-pool
+    surface (``jaxstream.serve.warmpool``) must carry NO ``slow``
+    markers and must not launch subprocesses — the cache-key
+    invalidation proofs (rules-version bump / plan / toolchain string
+    MISS, never a stale hit), the torn-entry detection and the
+    zero-warm-compile restart claim are tier-1 acceptance criteria;
+    drive the rung probe through the pool's injectable ``probe=``
+    fake, never a real child process; (b) any test module that
+    launches subprocesses AND references the cross-process compile-
+    cache surface (``enable_compile_cache`` / ``probe_rung`` /
+    ``JAXSTREAM_COMPILE_CACHE``) must carry ``pytest.mark.slow`` —
+    cross-process CPU cache deserialization is the documented
+    jaxlib-0.4.37 segfault class the subprocess probe exists to
+    quarantine, and a real two-process probe costs tens of seconds of
+    child jax imports, which is exactly the cost profile the fast
+    tier's budget excludes.
+
 Exit status 0 = clean; 1 = violations (listed on stdout).
 """
 
@@ -248,6 +267,20 @@ _FLIGHT_IMPORT_RE = re.compile(
     r"|latest_bundle|TornBundleError)\b"
     r"|import\s+postmortem\b|from\s+postmortem\s+import\b)",
     re.MULTILINE)
+_WARMPOOL_IMPORT_RE = re.compile(
+    r"^\s*(from\s+jaxstream\.serve\.warmpool\b"
+    r"|import\s+jaxstream\.serve\.warmpool\b"
+    r"|from\s+jaxstream\.serve\s+import\s+[^\n]*"
+    r"\b(warmpool|WarmPool|WarmExecutable|HeadroomRefused"
+    r"|SpeculativeCompiler)\b)",
+    re.MULTILINE)
+#: The cross-process compile-cache surface: a subprocess-launching
+#: test referencing any of these is exercising the documented
+#: jaxlib-0.4.37 cache-deserialization segfault class and must ride
+#: the slow tier (rule 15b).
+_CACHE_XPROC_RE = re.compile(
+    r"\benable_compile_cache\b|\bprobe_rung\b"
+    r"|JAXSTREAM_COMPILE_CACHE|jax\.config.*compilation_cache")
 #: A hard-kill reference next to subprocess usage marks the SIGKILL
 #: crash-forensics capstone (and anything shaped like it) — those
 #: must ride the slow tier.
@@ -532,6 +565,38 @@ def lint_file(path: str, allowed: set):
                    f"main(); the subprocess SIGKILL capstone lives in "
                    f"a module that reads the bundle JSON directly "
                    f"without importing the surface)")
+    if _WARMPOOL_IMPORT_RE.search(src):
+        if "slow" in used:
+            yield (f"{rel}: imports the warm-pool surface "
+                   f"(jaxstream.serve.warmpool) but marks tests slow "
+                   f"— the cache-key invalidation proofs, the "
+                   f"torn-entry detection, the headroom refusals and "
+                   f"the zero-warm-compile restart claim are tier-1 "
+                   f"acceptance criteria and must run in every fast "
+                   f"gate; move the slow test to a module that does "
+                   f"not import the warm-pool surface")
+        if _SUBPROC_USE_RE.search(src):
+            yield (f"{rel}: imports the warm-pool surface but "
+                   f"launches subprocesses — warm-pool tests must run "
+                   f"IN-PROCESS (drive the rung probe through the "
+                   f"pool's injectable probe= fake; a real "
+                   f"two-process probe imports jax in a child and "
+                   f"would be forced slow by rule 2, dropping the "
+                   f"compile-tax proofs from the fast gate); "
+                   f"cross-process cache-deserialization tests live "
+                   f"in a slow-marked module that does not import "
+                   f"the surface (rule 15b)")
+    if _SUBPROC_USE_RE.search(src) and _CACHE_XPROC_RE.search(src) \
+            and "slow" not in used:
+        yield (f"{rel}: launches subprocesses and references the "
+               f"cross-process compile-cache surface "
+               f"(enable_compile_cache / probe_rung / "
+               f"JAXSTREAM_COMPILE_CACHE) but carries no "
+               f"pytest.mark.slow — cross-process CPU cache "
+               f"deserialization is the documented jaxlib "
+               f"segfault class the subprocess probe quarantines, "
+               f"and a real child-process jax import is exactly the "
+               f"cost profile the fast tier's budget excludes")
     if _SUBPROC_USE_RE.search(src) and _HARD_KILL_RE.search(src) \
             and "slow" not in used:
         yield (f"{rel}: launches subprocesses and references a hard "

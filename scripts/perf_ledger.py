@@ -11,10 +11,14 @@ Usage::
 ``render`` (the default) parses the recorded ``BENCH_r*.json`` history
 (every file under the repo root when no paths are given) into ONE
 canonical machine-normalized trajectory — per section:
-sim-days/sec/chip, % of roof, footprint bytes, compile seconds — and
-prints the trend table.  Hardware classes are inferred per the
-normalization rules in ``jaxstream.obs.perf.parse_bench_point``
-(CPU-smoke points are tagged ``reported-only`` and never gate).
+sim-days/sec/chip, % of roof, footprint bytes, compile seconds, and
+(round 21) the ``cold_start`` warm-pool section as warm-over-cold
+speedup ratios (``cold_start:warm_speedup`` /
+``cold_start:resize_speedup``, higher is better) so scale-up latency
+gates the way throughput does — and prints the trend table.  Hardware
+classes are inferred per the normalization rules in
+``jaxstream.obs.perf.parse_bench_point`` (CPU-smoke points are tagged
+``reported-only`` and never gate).
 
 ``check`` gates the LAST point (or ``--candidate FILE``, a bench
 stdout JSON line or a driver envelope) against the best recorded

@@ -27,7 +27,10 @@ them — and renders a live ANSI operator view:
     ``memory`` records; plus the **plan cost stamps** panel
     (footprint / compile seconds / flops-vs-analytic band /
     advisory headroom) from ``perf`` records
-    (``serve.cost_stamps``).
+    (``serve.cost_stamps``);
+  * **warm pool** (round 21, ``serve.warm_pool``) — entry hit/miss/
+    save counts per degradation rung from ``warmpool`` records, plus
+    any advisory-headroom refusals (``headroom`` records).
 
 ``--once`` renders one frame and exits; ``--json`` emits that frame as
 one machine-readable JSON object instead of ANSI (the form tests and
@@ -76,7 +79,7 @@ _PHASE_COLOR = {"ingress": 90, "queue": 33, "pack": 35, "compute": 32,
 RENDERED_KINDS = frozenset({
     "manifest", "span", "serve", "segment", "guard", "autoscale",
     "gateway", "loadgen", "bench", "da", "memory", "perf",
-    "flight", "crash", "resume",
+    "flight", "crash", "resume", "warmpool", "headroom",
 })
 
 SPARK = "▁▂▃▄▅▆▇█"
@@ -150,6 +153,8 @@ class Dashboard:
         self.memory_peak = []           # per-chip peak watermarks
         self.memory_unavailable = None  # typed no-allocator-stats note
         self.perf_stamps = {}           # plan -> latest 'perf' stamp
+        self.warmpool = {}              # event -> rung -> count (r21)
+        self.headroom = []              # advisory-headroom refusals
         self.outcomes = {}              # kind -> status -> count
         self.incidents = []             # flight/crash/resume records
         self.unknown = {}               # kind -> count (loud footer)
@@ -210,6 +215,16 @@ class Dashboard:
             key = (f"{rec.get('plan')}/{rec.get('group')}"
                    f"/B{rec.get('bucket')}")
             self.perf_stamps[key] = rec
+        elif kind == "warmpool":
+            # Round 21: warm-pool hit/miss/save/corrupt counters per
+            # degradation rung — the live answer to "is this fleet
+            # paying the compile tax or loading its pool".
+            by = self.warmpool.setdefault(str(rec.get("event", "?")),
+                                          {})
+            rg = str(rec.get("rung", "?"))
+            by[rg] = by.get(rg, 0) + 1
+        elif kind == "headroom":
+            self.headroom.append(rec)
         elif kind in ("guard", "autoscale"):
             self.events.append(rec)
         elif kind in ("gateway", "loadgen"):
@@ -294,6 +309,10 @@ class Dashboard:
             "perf": ([self.perf_stamps[k]
                       for k in sorted(self.perf_stamps)]
                      if self.perf_stamps else None),
+            "warm_pool": ({"events": self.warmpool,
+                           "refusals": self.headroom[-self.rows:]}
+                          if (self.warmpool or self.headroom)
+                          else None),
             "outcomes": self.outcomes,
             "incidents": self.incidents[-self.rows:],
             "unrendered_kinds": dict(sorted(self.unknown.items())),
@@ -437,6 +456,22 @@ def render(frame, color=True):
                 + (f", flops x{ratio}" if ratio is not None else "")
                 + band
                 + (f", headroom {hr:.1%}" if hr is not None else ""))
+        lines.append("")
+
+    if frame.get("warm_pool"):
+        wp = frame["warm_pool"]
+        lines.append(_c("warm pool (compile tax):", 4, color))
+        for ev in sorted(wp.get("events", {})):
+            rungs = wp["events"][ev]
+            parts = " ".join(f"{r}={n}"
+                             for r, n in sorted(rungs.items()))
+            lines.append(f"  {ev:<8} {parts}")
+        for r in wp.get("refusals", []):
+            lines.append(_c(
+                f"  headroom refusal: {r.get('action')} bucket "
+                f"{r.get('bucket')} (headroom "
+                f"{r.get('headroom_frac')} < min "
+                f"{r.get('min_headroom_frac')})", 33, color))
         lines.append("")
 
     if frame.get("assimilation"):

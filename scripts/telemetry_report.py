@@ -25,6 +25,9 @@ Prints, from the run's manifest + segment/guard/bench records:
     (footprint bytes, compile seconds, flops-vs-analytic ratio,
     advisory headroom, from ``perf`` records under
     ``serve.cost_stamps``);
+  * the warm-pool section (round 21): entry hit/miss/save counts per
+    degradation rung (``warmpool`` records under ``serve.warm_pool``)
+    and any advisory-headroom refusals (``headroom`` records);
   * bench records, if the file came from ``bench.py --telemetry``.
 
 ``--trace REQUEST_ID`` renders one request's span tree instead —
@@ -72,7 +75,7 @@ PHASES = ("ingress", "queue", "pack", "compute", "host_wait",
 RENDERED_KINDS = frozenset({
     "manifest", "segment", "guard", "bench", "serve", "gateway",
     "loadgen", "autoscale", "span", "da", "memory", "perf",
-    "flight", "crash", "resume",
+    "flight", "crash", "resume", "warmpool", "headroom",
 })
 
 
@@ -424,6 +427,33 @@ def summarize(records):
     spans = phase_decomposition(spans_by_request(records))
     if serving is not None and spans is not None:
         serving["phase_latency"] = spans
+    # Round 21: the warm-pool compile-tax columns.  'warmpool' records
+    # count entry hits/misses/saves per degradation rung (aot ->
+    # stablehlo -> compile_cache -> cold); 'headroom' records are the
+    # advisory-headroom refusals (a resize or speculative build the
+    # server declined because the stamped per-chip headroom breached
+    # serve.min_headroom_frac).
+    warmpools = [r for r in records if r.get("kind") == "warmpool"]
+    headrooms = [r for r in records if r.get("kind") == "headroom"]
+    warm_pool = None
+    if warmpools or headrooms:
+        by_event, rungs = {}, {}
+        for w in warmpools:
+            ev = str(w.get("event", "?"))
+            by_event[ev] = by_event.get(ev, 0) + 1
+            if ev in ("hit", "save"):
+                rg = str(w.get("rung", "?"))
+                rungs[rg] = rungs.get(rg, 0) + 1
+        warm_pool = {
+            "events": dict(sorted(by_event.items())),
+            "rungs": dict(sorted(rungs.items())),
+            "refusals": [{"action": h.get("action"),
+                          "bucket": h.get("bucket"),
+                          "headroom_frac": h.get("headroom_frac"),
+                          "min_headroom_frac":
+                              h.get("min_headroom_frac")}
+                         for h in headrooms],
+        }
     # Round 20: crash forensics.  'crash' records point at the flight-
     # recorder bundle a dying run committed, 'flight' records carry
     # the ring-dump accounting, 'resume' records stamp the lineage a
@@ -453,6 +483,7 @@ def summarize(records):
             "autoscale": autoscale, "spans": spans,
             "assimilation": assimilation,
             "memory": memory, "perf": perf, "forensics": forensics,
+            "warm_pool": warm_pool,
             "unrendered_kinds": dict(sorted(unrendered.items())),
             "n_segments": len(segments)}
 
@@ -599,6 +630,20 @@ def print_report(s):
                   f"{cs} {foot:>12} "
                   f"{'-' if p['flops_ratio'] is None else format(p['flops_ratio'], '>8.3f')} "
                   f"{band:>5} {hr}")
+
+    if s.get("warm_pool"):
+        wp = s["warm_pool"]
+        evs = " ".join(f"{k}={v}" for k, v in wp["events"].items())
+        rungs = " ".join(f"{k}={v}" for k, v in wp["rungs"].items())
+        print(f"\nwarm pool (compile tax):")
+        print(f"  events: {evs or 'none'}")
+        if rungs:
+            print(f"  rungs (hits+saves): {rungs}")
+        for r in wp["refusals"]:
+            print(f"  headroom refusal: {r['action']} bucket "
+                  f"{r['bucket']} (stamped headroom "
+                  f"{r['headroom_frac']} < min "
+                  f"{r['min_headroom_frac']})")
 
     for name in ("gateway", "loadgen"):
         sec = s.get(name)

@@ -290,6 +290,23 @@ def test_bench_smoke_runs_and_reports(monkeypatch, capsys, tmp_path):
     assert fo["records_per_window"] > 0
     assert 0.0 <= fo["overhead_pct"] < 3.0, fo
 
+    # The cold-start canary (round 21): the smoke runs the REAL
+    # bench_cold_start code path with its gates ENFORCED (gates=True —
+    # a breach surfaces as ok: False or "skipped" and fails here): a
+    # warm-pool-loaded server reaches its first result and resizes to
+    # a new bucket >= 3x faster than cold, performs ZERO XLA compiles,
+    # and its first-segment results byte-match the cold server's.
+    cs = rec["cold_start"]
+    assert "skipped" not in cs, cs
+    assert cs["ok"] is True, cs
+    assert cs["warm_compiles"] == 0
+    assert cs["byte_equal"] is True
+    assert cs["warm_speedup"] >= 3.0, cs
+    assert cs["resize_speedup"] >= 3.0, cs
+    assert cs["hits"] > 0
+    assert cs["cold_first_result_s"] > cs["warm_first_result_s"] > 0.0
+    assert cs["cold_resize_s"] > cs["warm_resize_s"] > 0.0
+
     # --telemetry writes a schema-valid obs-sink file alongside the
     # stdout JSON (round-8 satellite: bench rides the structured sink).
     from jaxstream.obs.sink import read_records

@@ -523,6 +523,66 @@ def test_flight_module_rules_detected(tmp_path):
     assert check_tiers.main(str(tmp_path)) == 0
 
 
+def test_warmpool_module_rules_detected(tmp_path):
+    """Rule 15 (round-21 satellite): warm-pool tests stay non-slow
+    and in-process, while cross-process cache-deserialization tests
+    must ride the slow tier — a module importing
+    jaxstream.serve.warmpool may not carry slow markers or launch
+    subprocesses, and a module that spawns subprocesses AND
+    references the cross-process compile-cache surface must carry
+    pytest.mark.slow."""
+    (tmp_path / "pytest.ini").write_text(
+        "[pytest]\nmarkers =\n    slow: the slow tier\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    # Slow-marked warmpool module trips the lint (15a).
+    (tests / "test_w.py").write_text(
+        "import pytest\n"
+        "from jaxstream.serve.warmpool import WarmPool\n"
+        "@pytest." + "mark.slow\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Subprocess USAGE in a warmpool-importing module trips it too.
+    (tests / "test_w.py").write_text(
+        "import subprocess\n"
+        "from jaxstream.serve import warmpool\n"
+        "def test_a():\n"
+        "    subprocess.run(['python', '-c', 'pass'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # Unmarked, in-process warmpool module is clean — including the
+    # from-serve symbol import forms.
+    (tests / "test_w.py").write_text(
+        "from jaxstream.serve import WarmPool, HeadroomRefused\n"
+        "def test_a():\n    pass\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+    # The cross-process half (15b): subprocess + the compile-cache
+    # surface without slow trips (no warmpool import here — this is
+    # the module shape rule 15a forces such tests INTO).
+    (tests / "test_x.py").write_text(
+        "import subprocess, sys\n"
+        "def test_a():\n"
+        "    subprocess.run([sys.executable, '-c', "
+        "'import jaxstream'],\n"
+        "        env={'JAXSTREAM_COMPILE" + "_CACHE': '/tmp/cc'})\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # ...and the probe_rung spelling is caught too.
+    (tests / "test_x.py").write_text(
+        "import subprocess\n"
+        "def test_a():\n"
+        "    pass  # drives probe" + "_rung cross-process\n"
+        "    subprocess.run(['true'])\n")
+    assert check_tiers.main(str(tmp_path)) == 1
+    # The same module slow-marked is clean.
+    (tests / "test_x.py").write_text(
+        "import pytest, subprocess, sys\n"
+        "pytestmark = pytest." + "mark.slow\n"
+        "def test_a():\n"
+        "    subprocess.run([sys.executable, '-c', "
+        "'import jaxstream'],\n"
+        "        env={'JAXSTREAM_COMPILE" + "_CACHE': '/tmp/cc'})\n")
+    assert check_tiers.main(str(tmp_path)) == 0
+
+
 def test_sink_kind_rendering_drift_detected(tmp_path):
     """Rule 13b: a sink kind registered in RECORD_KINDS but missing
     from either operator tool's RENDERED_KINDS fails the gate (the
